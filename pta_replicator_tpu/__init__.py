@@ -1,0 +1,59 @@
+"""pta_replicator_tpu — a TPU-native (JAX/XLA) framework for synthesizing
+simulated pulsar-timing-array datasets.
+
+Standalone re-design of the capabilities of ``bencebecsy/pta_replicator``:
+load or fabricate per-pulsar TOAs, zero the residuals, then inject white
+measurement noise (EFAC/EQUAD), epoch-correlated jitter (ECORR), power-law
+red noise, Hellings-Downs / anisotropic correlated GW backgrounds,
+continuous waves (single sources and large catalogs), bursts, bursts with
+memory, and arbitrary transients.
+
+Two execution paths share one set of math kernels:
+
+* the **CPU oracle path** (:mod:`.simulate` + the ``add_*`` operators)
+  mirrors the reference's mutate-and-ledger API and its legacy-RNG draw
+  order, for exact regression parity;
+* the **device path** (:mod:`.batch`) freezes pulsars into padded arrays
+  and evaluates every injection as a pure, key-driven JAX function
+  batched over (pulsar x realization) and sharded over a device mesh.
+"""
+
+__version__ = "0.1.0"
+
+from .simulate import (
+    SimulatedPulsar,
+    Residuals,
+    load_pulsar,
+    load_from_directories,
+    simulate_pulsar,
+    make_ideal,
+)
+from .models import (
+    add_measurement_noise,
+    add_jitter,
+    add_red_noise,
+    add_gwb,
+    add_cgw,
+    add_catalog_of_cws,
+    add_burst,
+    add_noise_transient,
+    add_gw_memory,
+)
+
+__all__ = [
+    "SimulatedPulsar",
+    "Residuals",
+    "load_pulsar",
+    "load_from_directories",
+    "simulate_pulsar",
+    "make_ideal",
+    "add_measurement_noise",
+    "add_jitter",
+    "add_red_noise",
+    "add_gwb",
+    "add_cgw",
+    "add_catalog_of_cws",
+    "add_burst",
+    "add_noise_transient",
+    "add_gw_memory",
+]

@@ -1,0 +1,25 @@
+"""Physical constants in the units used throughout the framework.
+
+Mirrors the constant set of the reference implementation
+(/root/reference/pta_replicator/constants.py:1-8) so that injected signal
+amplitudes agree numerically, but is computed from scipy.constants here.
+"""
+import scipy.constants as _sc
+
+DAY_IN_SEC = 86400.0
+YEAR_IN_SEC = 365.25 * DAY_IN_SEC
+
+#: Dispersion constant, MHz^2 cm^3 pc s
+DM_K = 4.15e3
+
+#: Geometrized solar mass: G M_sun / c^3 [s]
+SOLAR2S = _sc.G / _sc.c**3 * 1.98855e30
+#: kiloparsec in light-seconds
+KPC2S = _sc.parsec / _sc.c * 1e3
+#: megaparsec in light-seconds
+MPC2S = _sc.parsec / _sc.c * 1e6
+
+#: Speed of light [m/s] and derived helpers used by the population pipeline
+C_MS = _sc.c
+PC_M = _sc.parsec
+MSUN_KG = 1.98855e30

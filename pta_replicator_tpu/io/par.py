@@ -1,0 +1,152 @@
+"""Tempo/Tempo2/PINT-style ``.par`` timing-model file parser.
+
+The reference framework delegates par parsing to PINT
+(``pint.models.get_model``, /root/reference/pta_replicator/simulate.py:118,154).
+This framework is standalone: it carries its own parser that extracts the
+parameters the simulation layer needs (spin, astrometry, DM) while preserving
+every line verbatim for lossless round-tripping via :func:`ParModel.write`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+# Keys whose values are plain floats we want typed access to.
+_FLOAT_KEYS = {
+    "F0", "F1", "F2", "F3",
+    "PEPOCH", "POSEPOCH", "DMEPOCH",
+    "DM", "DM1", "DM2",
+    "PX", "PMRA", "PMDEC", "PMELONG", "PMELAT",
+    "ELONG", "ELAT",
+    "START", "FINISH", "TZRMJD", "NTOA", "CHI2R",
+}
+
+
+def _parse_hms(text: str) -> float:
+    """Parse ``hh:mm:ss.s`` into decimal hours (sign-aware)."""
+    sign = -1.0 if text.lstrip().startswith("-") else 1.0
+    parts = [abs(float(p)) for p in text.split(":")]
+    while len(parts) < 3:
+        parts.append(0.0)
+    return sign * (parts[0] + parts[1] / 60.0 + parts[2] / 3600.0)
+
+
+def _parse_dms(text: str) -> float:
+    """Parse ``dd:mm:ss.s`` into decimal degrees (sign-aware)."""
+    return _parse_hms(text)  # same sexagesimal structure
+
+
+@dataclass
+class ParModel:
+    """A parsed pulsar timing model.
+
+    Angles follow the conventions of the reference's ``loc`` dicts
+    (/root/reference/pta_replicator/simulate.py:127-132): RAJ in decimal
+    *hours*, DECJ in decimal *degrees*, ELONG/ELAT in decimal degrees.
+    """
+
+    name: str = ""
+    raj_hours: Optional[float] = None
+    decj_deg: Optional[float] = None
+    elong_deg: Optional[float] = None
+    elat_deg: Optional[float] = None
+    f0: float = 1.0
+    f1: float = 0.0
+    f2: float = 0.0
+    pepoch_mjd: float = 0.0
+    dm: float = 0.0
+    params: dict = field(default_factory=dict)
+    lines: list = field(default_factory=list)
+    path: Optional[str] = None
+
+    @property
+    def loc(self) -> dict:
+        """Sky-location dict in the reference's units convention."""
+        if self.raj_hours is not None and self.decj_deg is not None:
+            return {"RAJ": self.raj_hours, "DECJ": self.decj_deg}
+        if self.elong_deg is not None and self.elat_deg is not None:
+            return {"ELONG": self.elong_deg, "ELAT": self.elat_deg}
+        raise AttributeError(
+            "No pulsar location information (RAJ/DECJ or ELONG/ELAT) in parfile."
+        )
+
+    def set_param(self, key: str, value: float, fmt: str = ".20g") -> None:
+        """Update a parameter value, keeping typed fields and the verbatim
+        line store in sync (so :meth:`write` persists post-fit models)."""
+        key = key.upper()
+        text = format(value, fmt)
+        if key == "F0":
+            self.f0 = value
+        elif key == "F1":
+            self.f1 = value
+        elif key == "F2":
+            self.f2 = value
+        elif key == "PEPOCH":
+            self.pepoch_mjd = value
+        elif key == "DM":
+            self.dm = value
+        updated = False
+        for i, line in enumerate(self.lines):
+            tokens = line.split()
+            if tokens and tokens[0].upper() == key:
+                tokens[1] = text
+                self.lines[i] = "\t\t".join(tokens[:2]) + (
+                    ("\t" + " ".join(tokens[2:])) if len(tokens) > 2 else ""
+                )
+                updated = True
+                break
+        if not updated:
+            self.lines.append(f"{key}\t\t{text}")
+        self.params[key] = [text] + self.params.get(key, [None, None])[1:]
+
+    def write(self, path: str) -> None:
+        """Write the par file back out, preserving original content."""
+        with open(path, "w") as fh:
+            for line in self.lines:
+                fh.write(line.rstrip("\n") + "\n")
+
+
+def read_par(path: str) -> ParModel:
+    """Parse a ``.par`` file into a :class:`ParModel`."""
+    model = ParModel(path=path)
+    with open(path) as fh:
+        raw = fh.read().splitlines()
+    for line in raw:
+        model.lines.append(line)
+        stripped = line.strip()
+        if not stripped or stripped.startswith(("#", "C ")):
+            continue
+        tokens = stripped.split()
+        key = tokens[0].upper()
+        if len(tokens) < 2:
+            continue
+        value = tokens[1]
+        model.params[key] = tokens[1:]
+        if key in ("PSR", "PSRJ", "PSRB"):
+            model.name = value
+        elif key == "RAJ":
+            model.raj_hours = _parse_hms(value)
+        elif key == "DECJ":
+            model.decj_deg = _parse_dms(value)
+        elif key in _FLOAT_KEYS:
+            try:
+                fval = float(value.replace("D", "E").replace("d", "e"))
+            except ValueError:
+                continue
+            if key == "F0":
+                model.f0 = fval
+            elif key == "F1":
+                model.f1 = fval
+            elif key == "F2":
+                model.f2 = fval
+            elif key == "PEPOCH":
+                model.pepoch_mjd = fval
+            elif key == "DM":
+                model.dm = fval
+            elif key == "ELONG":
+                model.elong_deg = fval
+            elif key == "ELAT":
+                model.elat_deg = fval
+    return model

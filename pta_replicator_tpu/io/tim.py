@@ -1,0 +1,186 @@
+"""Tempo2-format ``.tim`` TOA file reader/writer.
+
+Replaces the reference's use of ``pint.toa.get_TOAs``
+(/root/reference/pta_replicator/simulate.py:155). TOA epochs are held as
+``np.longdouble`` MJDs (~18 significant digits, sub-nanosecond at MJD 5e4),
+the precision PINT achieves with its pair-of-doubles representation.
+
+Mutation model: the framework never rewrites parsed strings in place; TOA
+adjustments (`adjust_seconds`) accumulate in the longdouble MJD array, which
+is the single source of truth for epochs, and `write_tim` re-serializes it.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from ..constants import DAY_IN_SEC
+
+
+@dataclass
+class TOAData:
+    """Columnar TOA container (the device-independent CPU representation)."""
+
+    #: observation epochs, UTC MJD, longdouble
+    mjd: np.ndarray = None
+    #: TOA uncertainties [s], float64
+    errors_s: np.ndarray = None
+    #: observing radio frequency [MHz]
+    freqs_mhz: np.ndarray = None
+    #: observatory codes
+    observatories: List[str] = field(default_factory=list)
+    #: per-TOA flag dicts, e.g. {"pta": "PPTA", "f": "L-wide_PUPPI"}
+    flags: List[dict] = field(default_factory=list)
+    #: TOA label column (usually source file or profile name)
+    labels: List[str] = field(default_factory=list)
+
+    @property
+    def ntoas(self) -> int:
+        return 0 if self.mjd is None else len(self.mjd)
+
+    def get_mjds(self) -> np.ndarray:
+        """Epochs as float64 MJD (reference analog: ``toas.get_mjds().value``)."""
+        return np.asarray(self.mjd, dtype=np.float64)
+
+    def get_errors_s(self) -> np.ndarray:
+        return self.errors_s
+
+    def get_flag(self, flagid: str, default: str = "") -> np.ndarray:
+        """Vector of one flag's values across TOAs."""
+        return np.array([f.get(flagid, default) for f in self.flags])
+
+    @property
+    def first_mjd(self) -> float:
+        return float(self.mjd.min())
+
+    @property
+    def last_mjd(self) -> float:
+        return float(self.mjd.max())
+
+    def adjust_seconds(self, dt_s: np.ndarray) -> None:
+        """Shift TOA epochs by ``dt_s`` seconds (the injection primitive).
+
+        Reference analog: ``toas.adjust_TOAs(TimeDelta(...))``
+        (e.g. /root/reference/pta_replicator/white_noise.py:124).
+        """
+        dt_s = np.asarray(dt_s)
+        if dt_s.shape != self.mjd.shape:
+            raise ValueError(
+                f"delay shape {dt_s.shape} does not match ntoas {self.mjd.shape}"
+            )
+        self.mjd = self.mjd + dt_s.astype(np.longdouble) / np.longdouble(DAY_IN_SEC)
+
+    def copy(self) -> "TOAData":
+        return TOAData(
+            mjd=self.mjd.copy(),
+            errors_s=self.errors_s.copy(),
+            freqs_mhz=self.freqs_mhz.copy(),
+            observatories=list(self.observatories),
+            flags=[dict(f) for f in self.flags],
+            labels=list(self.labels),
+        )
+
+
+def read_tim(path: str) -> TOAData:
+    """Parse a Tempo2 ``FORMAT 1`` tim file."""
+    mjds: List[np.longdouble] = []
+    errs: List[float] = []
+    freqs: List[float] = []
+    obs: List[str] = []
+    flags: List[dict] = []
+    labels: List[str] = []
+
+    skipping = False
+    with open(path) as fh:
+        for line in fh:
+            stripped = line.strip()
+            if not stripped:
+                continue
+            upper = stripped.upper()
+            # SKIP ... NOSKIP blocks exclude the TOAs they enclose
+            if upper.startswith("NOSKIP"):
+                skipping = False
+                continue
+            if upper.startswith("SKIP"):
+                skipping = True
+                continue
+            if skipping:
+                continue
+            if upper.startswith(("FORMAT", "MODE", "TIME", "EFAC", "EQUAD",
+                                 "INCLUDE", "C ", "#", "JUMP")):
+                continue
+            tokens = stripped.split()
+            if len(tokens) < 5:
+                continue
+            labels.append(tokens[0])
+            freqs.append(float(tokens[1]))
+            # longdouble parse keeps ~18 digits (sub-ns at MJD ~5e4)
+            mjds.append(np.longdouble(tokens[2]))
+            errs.append(float(tokens[3]) * 1e-6)  # us -> s
+            obs.append(tokens[4])
+            flagdict = {}
+            it = iter(tokens[5:])
+            for tok in it:
+                if tok.startswith("-"):
+                    flagdict[tok[1:]] = next(it, "")
+            flags.append(flagdict)
+
+    return TOAData(
+        mjd=np.array(mjds, dtype=np.longdouble),
+        errors_s=np.array(errs, dtype=np.float64),
+        freqs_mhz=np.array(freqs, dtype=np.float64),
+        observatories=obs,
+        flags=flags,
+        labels=labels,
+    )
+
+
+def write_tim(toas: TOAData, path: str, name: Optional[str] = None) -> None:
+    """Serialize TOAs back to a Tempo2 ``FORMAT 1`` tim file.
+
+    Reference analog: ``toas.write_TOA_file(outtim, format='Tempo2')``
+    (/root/reference/pta_replicator/simulate.py:75).
+    """
+    with open(path, "w") as fh:
+        fh.write("FORMAT 1\nMODE 1\n")
+        for i in range(toas.ntoas):
+            label = name or (toas.labels[i] if toas.labels else "toa")
+            flag_str = "".join(
+                f" -{k} {v}" for k, v in (toas.flags[i] if toas.flags else {}).items()
+            )
+            mjd_str = np.format_float_positional(
+                toas.mjd[i], precision=17, unique=False, trim="k"
+            )
+            fh.write(
+                f" {label} {toas.freqs_mhz[i]:.8f} {mjd_str} "
+                f"{toas.errors_s[i]*1e6:.5f} {toas.observatories[i]}{flag_str}\n"
+            )
+
+
+def fabricate_toas(
+    mjds,
+    error_us,
+    freq_mhz=1440.0,
+    observatory: str = "AXIS",
+    flags: Optional[dict] = None,
+) -> TOAData:
+    """Build a synthetic evenly-specified TOA set.
+
+    Reference analog: ``pint.simulation.make_fake_toas_fromMJDs`` as used by
+    ``simulate_pulsar`` (/root/reference/pta_replicator/simulate.py:119-123).
+    """
+    mjds = np.asarray(mjds, dtype=np.longdouble)
+    n = len(mjds)
+    err = np.broadcast_to(np.asarray(error_us, dtype=np.float64) * 1e-6, (n,)).copy()
+    frq = np.broadcast_to(np.asarray(freq_mhz, dtype=np.float64), (n,)).copy()
+    flagdicts = [dict(flags) if flags else {} for _ in range(n)]
+    return TOAData(
+        mjd=mjds.copy(),
+        errors_s=err,
+        freqs_mhz=frq,
+        observatories=[observatory] * n,
+        flags=flagdicts,
+        labels=["fake"] * n,
+    )

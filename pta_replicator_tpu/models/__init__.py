@@ -1,0 +1,17 @@
+from .white_noise import add_measurement_noise, add_jitter
+from .red_noise import add_red_noise
+from .gwb import add_gwb
+from .cgw import add_cgw, add_catalog_of_cws
+from .bursts import add_burst, add_noise_transient, add_gw_memory
+
+__all__ = [
+    "add_measurement_noise",
+    "add_jitter",
+    "add_red_noise",
+    "add_gwb",
+    "add_cgw",
+    "add_catalog_of_cws",
+    "add_burst",
+    "add_noise_transient",
+    "add_gw_memory",
+]

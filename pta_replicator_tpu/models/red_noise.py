@@ -1,0 +1,89 @@
+"""Power-law red (timing) noise via the rank-reduced Fourier basis.
+
+Reference analog: ``add_red_noise``
+(/root/reference/pta_replicator/red_noise.py:106-135).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import DAY_IN_SEC
+from ..ops.fourier import fourier_basis, fourier_frequencies, powerlaw_prior
+from ..simulate import SimulatedPulsar
+
+
+# ----------------------------------------------------------------- pure math
+
+def red_noise_delay(
+    toas_s,
+    log10_amplitude: float,
+    gamma: float,
+    eps,
+    nmodes: int = 30,
+    tspan_s: float = None,
+    libstempo_convention: bool = False,
+    modes=None,
+    xp=np,
+):
+    """Red-noise delay [s]: F @ (sqrt(prior) * eps), eps ~ N(0,1)^(2K).
+
+    ``modes`` overrides the default k/T frequency grid with an explicit
+    list (then K = len(modes) and eps must have 2*len(modes) entries).
+    """
+    t = xp.asarray(toas_s)
+    T = tspan_s if tspan_s is not None else float(t.max() - t.min())
+    f = fourier_frequencies(T, nmodes=nmodes, modes=modes, xp=xp)
+    F = fourier_basis(t, f, libstempo_convention=libstempo_convention, xp=xp)
+    fdoubled = xp.repeat(f, 2)
+    prior = powerlaw_prior(fdoubled, log10_amplitude, gamma, T, xp=xp)
+    return F @ (xp.sqrt(prior) * eps)
+
+
+# ------------------------------------------------------- oracle (CPU) layer
+
+def add_red_noise(
+    psr: SimulatedPulsar,
+    log10_amplitude: float,
+    spectral_index: float,
+    components: int = 30,
+    seed: int = None,
+    modes=None,
+    Tspan: float = None,
+    libstempo_convention: bool = False,
+):
+    """Inject power-law red noise P(f) = A^2/(12 pi^2) (f yr)^-gamma yr^3.
+
+    Draw order matches the reference (red_noise.py:118-127): one
+    N(0,1)^(2*components) stream after optional seeding. Times are TOA
+    epochs in seconds (the reference uses the TDB timescale; the constant
+    ~69 s offset is irrelevant to the basis, exactly so under
+    ``libstempo_convention`` which references times to the first TOA).
+
+    Divergence from the reference: a caller-supplied ``Tspan`` is honored
+    (for pinning a common span across pulsars); the reference accepts the
+    argument but overwrites it from the TOAs (red_noise.py:124).
+    """
+    if seed is not None:
+        np.random.seed(seed)
+
+    toas_s = psr.toas.get_mjds() * DAY_IN_SEC
+    tspan = float(Tspan) if Tspan is not None else float(toas_s.max() - toas_s.min())
+    nmodes = components if modes is None else len(modes)
+    eps = np.random.randn(2 * nmodes)
+    dt = red_noise_delay(
+        toas_s,
+        log10_amplitude,
+        spectral_index,
+        eps,
+        nmodes=nmodes,
+        tspan_s=tspan,
+        libstempo_convention=libstempo_convention,
+        modes=modes,
+    )
+    psr.update_added_signals(
+        f"{psr.name}_red_noise",
+        {"amplitude": log10_amplitude, "spectral_index": spectral_index},
+        dt,
+    )
+    psr.toas.adjust_seconds(dt)
+    psr.update_residuals()

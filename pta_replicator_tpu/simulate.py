@@ -1,0 +1,242 @@
+"""Pulsar state container and dataset lifecycle (CPU frontier).
+
+API-compatible analog of the reference's ``simulate.py``
+(/root/reference/pta_replicator/simulate.py:23-202) with PINT replaced by the
+framework's own standalone IO + timing engine. This module is the *ingest /
+egress* layer of the TPU-first architecture: datasets are loaded (or
+fabricated) and idealized here once on CPU, then frozen into padded
+pulsar-batch arrays for batched device execution. The mutate-in-place
+operator API
+(``add_measurement_noise(psr, ...)`` etc.) is retained as the exact CPU
+oracle path that the device path is validated against.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .io.par import ParModel, read_par
+from .io.tim import TOAData, fabricate_toas, read_tim, write_tim
+from .timing.model import SpindownTiming, phase_residuals, weighted_mean
+from .timing.fit import design_matrix, wls_fit, gls_fit
+from .constants import DAY_IN_SEC
+
+
+class Residuals:
+    """Timing residuals of a TOA set against a spin-down model.
+
+    Mirrors the slice of PINT's ``Residuals`` the reference consumes:
+    ``time_resids`` / ``resids_value`` are phase-wrapped, weighted-mean
+    subtracted residuals in seconds.
+    """
+
+    def __init__(self, toas: TOAData, model: SpindownTiming):
+        self.time_resids = phase_residuals(model, toas.mjd, toas.errors_s)
+
+    @property
+    def resids_value(self) -> np.ndarray:
+        return self.time_resids
+
+
+@dataclass
+class SimulatedPulsar:
+    """Holds one simulated pulsar: model, TOAs, residuals, provenance ledger.
+
+    Reference analog: /root/reference/pta_replicator/simulate.py:23-95.
+    """
+
+    ephem: str = "DE440"
+    par: ParModel = None
+    model: SpindownTiming = None
+    toas: TOAData = None
+    residuals: Residuals = None
+    name: str = None
+    loc: dict = None
+    added_signals: Optional[dict] = None
+    added_signals_time: Optional[dict] = None
+
+    def __repr__(self) -> str:
+        return f"SimulatedPulsar({self.name})"
+
+    def update_residuals(self) -> None:
+        self.residuals = Residuals(self.toas, self.model)
+
+    def update_added_signals(self, signal_name: str, param_dict: dict, dt=None) -> None:
+        """Record an injected signal in the provenance ledger.
+
+        ``added_signals`` maps signal name -> parameter dict;
+        ``added_signals_time`` maps signal name -> per-TOA delay vector [s],
+        enabling exact decomposition of total residuals by cause (a
+        first-class feature of the reference, simulate.py:79-89).
+        """
+        if self.added_signals is None:
+            raise ValueError(
+                "make_ideal() must be called on SimulatedPulsar before adding new signals."
+            )
+        if signal_name in self.added_signals:
+            raise ValueError(f"{signal_name} already exists in the model.")
+        self.added_signals[signal_name] = param_dict
+        if dt is not None:
+            self.added_signals_time[signal_name] = np.asarray(dt, dtype=np.float64)
+
+    def inject(self, signal_name: str, param_dict: dict, dt_s: np.ndarray) -> None:
+        """Ledger -> adjust TOAs -> re-residualize: the invariant operator
+        contract shared by every injection (11 call sites in the reference)."""
+        self.update_added_signals(signal_name, param_dict, dt_s)
+        self.toas.adjust_seconds(dt_s)
+        self.update_residuals()
+
+    def fit(self, fitter: str = "auto", nspin: int = 2, cov: np.ndarray = None, **kwargs) -> None:
+        """Refit spin-down parameters post-injection (WLS or GLS).
+
+        Reference analog: simulate.py:44-69 (PINT fitter selection). Here
+        'wls'/'auto' run weighted least squares, 'gls'/'downhill' run
+        generalized least squares with covariance ``cov`` (defaults to
+        diag(errors^2)).
+        """
+        if fitter not in ("wls", "gls", "downhill", "auto"):
+            raise ValueError(f"fitter={fitter!r} must be one of 'wls', 'gls', 'downhill' or 'auto'")
+        self.update_residuals()
+        res = self.residuals.time_resids
+        # PEPOCH frame so spin-parameter updates apply without cross terms
+        toas_s = ((self.toas.get_mjds() - self.model.pepoch_mjd) * DAY_IN_SEC).astype(np.float64)
+        M = design_matrix(toas_s, self.model.f0, nspin=nspin)
+        if fitter in ("wls", "auto"):
+            p, post = wls_fit(res, self.toas.errors_s, M)
+        else:
+            C = cov if cov is not None else np.diag(self.toas.errors_s**2)
+            p, post = gls_fit(res, C, M)
+        # p = [offset_s, dF0, dF1, ...] in design_matrix's t^k/(k! F0) basis;
+        # subtracting moves model phase onto the data
+        p = np.asarray(p, dtype=np.float64)
+        self.model = SpindownTiming(
+            f0=self.model.f0 - (p[1] if nspin >= 1 else 0.0),
+            f1=self.model.f1 - (p[2] if nspin >= 2 else 0.0),
+            f2=self.model.f2 - (p[3] if nspin >= 3 else 0.0),
+            pepoch_mjd=self.model.pepoch_mjd,
+        )
+        # keep the par representation in sync so write_partim persists the
+        # fitted model (the reference writes the fitted PINT model,
+        # simulate.py:71-77)
+        if self.par is not None:
+            self.par.set_param("F0", self.model.f0)
+            if nspin >= 2:
+                self.par.set_param("F1", self.model.f1)
+            if nspin >= 3:
+                self.par.set_param("F2", self.model.f2)
+        self.update_residuals()
+
+    def write_partim(self, outpar: str, outtim: str, tempo2: bool = False) -> None:
+        """Persist the mutated dataset (reference analog simulate.py:71-77).
+
+        ``tempo2`` is accepted for reference API compatibility; this
+        framework's tim writer always emits Tempo2 ``FORMAT 1``, which both
+        PINT and Tempo2 read.
+        """
+        self.par.write(outpar)
+        write_tim(self.toas, outtim)
+
+    def to_arrays(self):
+        """Export (mjd_f64, residuals_s, errors_s, loc) for downstream
+        analysis packages. The reference's ``to_enterprise``
+        (simulate.py:91-95) requires `enterprise`, which is optional here."""
+        return (
+            self.toas.get_mjds(),
+            self.residuals.resids_value.copy(),
+            self.toas.errors_s.copy(),
+            dict(self.loc),
+        )
+
+    def to_enterprise(self, ephem: str = "DE440"):
+        """Reference analog simulate.py:91-95. Not supported: enterprise's
+        PintPulsar wraps a PINT model, which this standalone framework does
+        not carry. Export via :meth:`to_arrays` or :meth:`write_partim`
+        (the written par/tim pair loads directly into enterprise)."""
+        raise NotImplementedError(
+            "to_enterprise requires a PINT timing model; use to_arrays() or "
+            "write_partim() and load the par/tim pair into enterprise."
+        )
+
+
+def _locate(par: ParModel) -> dict:
+    return par.loc
+
+
+def simulate_pulsar(
+    parfile: str,
+    obstimes,
+    toaerr,
+    freq: float = 1440.0,
+    observatory: str = "AXIS",
+    flags: dict = None,
+    ephem: str = "DE440",
+) -> SimulatedPulsar:
+    """Create a SimulatedPulsar from a par file and fabricated TOAs.
+
+    Reference analog: simulate.py:98-135 (obstimes in MJD, toaerr in us).
+    """
+    if not os.path.isfile(parfile):
+        raise FileNotFoundError("par file does not exist.")
+    par = read_par(parfile)
+    model = SpindownTiming.from_par(par)
+    toas = fabricate_toas(obstimes, toaerr, freq_mhz=freq, observatory=observatory, flags=flags)
+    psr = SimulatedPulsar(
+        ephem=ephem, par=par, model=model, toas=toas, name=par.name, loc=_locate(par)
+    )
+    psr.update_residuals()
+    return psr
+
+
+def load_pulsar(parfile: str, timfile: str, ephem: str = "DE440") -> SimulatedPulsar:
+    """Load a SimulatedPulsar from par and tim files (reference simulate.py:138-167)."""
+    if not os.path.isfile(parfile):
+        raise FileNotFoundError("par file does not exist.")
+    if not os.path.isfile(timfile):
+        raise FileNotFoundError("tim file does not exist.")
+    par = read_par(parfile)
+    model = SpindownTiming.from_par(par)
+    toas = read_tim(timfile)
+    psr = SimulatedPulsar(
+        ephem=ephem, par=par, model=model, toas=toas, name=par.name, loc=_locate(par)
+    )
+    psr.update_residuals()
+    return psr
+
+
+def load_from_directories(
+    pardir: str, timdir: str, ephem: str = "DE440", num_psrs: int = None, debug: bool = False
+) -> list:
+    """Load a pulsar array from directories of par and tim files.
+
+    Reference analog: simulate.py:170-190 (".t2" par variants filtered out,
+    sorted par/tim lists zipped pairwise).
+    """
+    if not os.path.isdir(pardir):
+        raise FileNotFoundError("par directory does not exist.")
+    if not os.path.isdir(timdir):
+        raise FileNotFoundError("tim directory does not exist.")
+    pars = [p for p in sorted(glob.glob(os.path.join(pardir, "*.par"))) if ".t2" not in p]
+    tims = sorted(glob.glob(os.path.join(timdir, "*.tim")))
+    psrs = []
+    for parf, timf in zip(pars, tims):
+        if num_psrs and len(psrs) >= num_psrs:
+            break
+        if debug:
+            print(f"loading par={parf}, tim={timf}")
+        psrs.append(load_pulsar(parf, timf, ephem=ephem))
+    return psrs
+
+
+def make_ideal(psr: SimulatedPulsar, iterations: int = 2) -> None:
+    """Zero the residuals by absorbing them into the TOAs, then initialize
+    the provenance ledger (reference analog simulate.py:193-202)."""
+    for _ in range(iterations):
+        res = phase_residuals(psr.model, psr.toas.mjd, psr.toas.errors_s)
+        psr.toas.adjust_seconds(-res)
+    psr.added_signals = {}
+    psr.added_signals_time = {}
+    psr.update_residuals()

@@ -1,0 +1,11 @@
+from .model import SpindownTiming, phase_residuals, weighted_mean
+from .fit import design_matrix, wls_fit, gls_fit
+
+__all__ = [
+    "SpindownTiming",
+    "phase_residuals",
+    "weighted_mean",
+    "design_matrix",
+    "wls_fit",
+    "gls_fit",
+]

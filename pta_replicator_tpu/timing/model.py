@@ -1,0 +1,84 @@
+"""Minimal standalone timing engine.
+
+The reference delegates all timing-model physics to PINT
+(/root/reference/pta_replicator/simulate.py:13-16,40-42); PINT is not a
+dependency of this framework, so the pieces the simulation layer actually
+relies on are implemented here directly:
+
+* spin-down phase prediction (F0/F1/F2 Taylor expansion around PEPOCH),
+* phase-wrapped, weighted-mean-subtracted timing residuals (the quantity
+  PINT's ``Residuals.time_resids`` produces and ``make_ideal`` zeroes,
+  /root/reference/pta_replicator/simulate.py:193-202),
+* the residual fixed-point used by ``make_ideal``.
+
+Approximation note (documented, deliberate): no barycentering chain (clock
+corrections, Roemer/Shapiro/Einstein delays) is applied — this framework's
+job is *synthesis*: datasets start from `make_ideal`'d (zero-residual) TOAs,
+and every injected signal is tracked exactly by the provenance ledger, so
+absolute pre-ideal residuals never enter any result. After ``make_ideal``
+the phase-based residuals here agree with ledger-summed residuals to
+O(F1/F0 * dt * Tspan) ~ 1e-12 s.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..constants import DAY_IN_SEC
+from ..io.par import ParModel
+
+
+def weighted_mean(values: np.ndarray, errors_s: np.ndarray) -> float:
+    """Error-weighted mean (weights 1/sigma^2), the constant PINT subtracts."""
+    w = 1.0 / np.asarray(errors_s, dtype=np.float64) ** 2
+    return float(np.sum(w * np.asarray(values, dtype=np.float64)) / np.sum(w))
+
+
+@dataclass
+class SpindownTiming:
+    """Spin-down phase model phi(t) = F0 dt + F1 dt^2/2 + F2 dt^3/6."""
+
+    f0: float
+    f1: float = 0.0
+    f2: float = 0.0
+    pepoch_mjd: float = 0.0
+
+    @classmethod
+    def from_par(cls, par: ParModel) -> "SpindownTiming":
+        return cls(f0=par.f0, f1=par.f1, f2=par.f2, pepoch_mjd=par.pepoch_mjd)
+
+    def phase(self, mjd_ld: np.ndarray) -> np.ndarray:
+        """Pulse phase (turns) at longdouble MJD epochs, longdouble precision."""
+        dt = (np.asarray(mjd_ld, dtype=np.longdouble)
+              - np.longdouble(self.pepoch_mjd)) * np.longdouble(DAY_IN_SEC)
+        return (np.longdouble(self.f0) * dt
+                + np.longdouble(self.f1) / 2 * dt * dt
+                + np.longdouble(self.f2) / 6 * dt * dt * dt)
+
+    def spin_frequency(self, mjd_ld: np.ndarray) -> np.ndarray:
+        """Instantaneous spin frequency [Hz] (float64)."""
+        dt = ((np.asarray(mjd_ld, dtype=np.longdouble)
+               - np.longdouble(self.pepoch_mjd)) * DAY_IN_SEC).astype(np.float64)
+        return self.f0 + self.f1 * dt + 0.5 * self.f2 * dt * dt
+
+
+def phase_residuals(
+    model: SpindownTiming,
+    mjd_ld: np.ndarray,
+    errors_s: np.ndarray,
+    subtract_mean: bool = True,
+) -> np.ndarray:
+    """Phase-wrapped time residuals [s] of TOAs against a spin-down model.
+
+    Fractional phase is wrapped to [-0.5, 0.5) turns and divided by the
+    instantaneous spin frequency; the error-weighted mean is removed, as in
+    PINT residuals consumed by the reference at
+    /root/reference/pta_replicator/simulate.py:40-42.
+    """
+    phase = model.phase(mjd_ld)
+    frac = phase - np.rint(phase)
+    res = (frac / model.spin_frequency(mjd_ld)).astype(np.float64)
+    if subtract_mean:
+        res = res - weighted_mean(res, errors_s)
+    return res

@@ -1,0 +1,55 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX init.
+
+Device-path tests exercise multi-chip sharding on virtual CPU devices (the
+driver separately dry-runs the multi-chip path); numerical oracle tests are
+pure numpy and unaffected.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+REFERENCE = pathlib.Path("/root/reference")
+
+
+@pytest.fixture(scope="session")
+def partim_small():
+    """Reference fixture dataset: 3 fake pulsars x 122 TOAs."""
+    par = REFERENCE / "test_partim_small" / "par"
+    tim = REFERENCE / "test_partim_small" / "tim"
+    if not par.is_dir():
+        pytest.skip("reference test_partim_small not available")
+    return str(par), str(tim)
+
+
+@pytest.fixture(scope="module")
+def partim_small_module():
+    par = REFERENCE / "test_partim_small" / "par"
+    tim = REFERENCE / "test_partim_small" / "tim"
+    if not par.is_dir():
+        pytest.skip("reference test_partim_small not available")
+    return str(par), str(tim)
+
+
+@pytest.fixture()
+def psrs_small(partim_small):
+    from pta_replicator_tpu import load_from_directories, make_ideal
+
+    pardir, timdir = partim_small
+    psrs = load_from_directories(pardir, timdir, num_psrs=3)
+    for p in psrs:
+        make_ideal(p)
+    return psrs

@@ -1,0 +1,59 @@
+import numpy as np
+import pytest
+
+from pta_replicator_tpu.io import read_par, read_tim, write_tim
+from pta_replicator_tpu.io.tim import fabricate_toas
+
+
+def test_read_par_small(partim_small):
+    pardir, _ = partim_small
+    par = read_par(pardir + "/JPSR00.par")
+    assert par.name == "JPSR00"
+    # RAJ 9:19:49.05 -> hours
+    assert par.raj_hours == pytest.approx(9 + 19 / 60 + 49.05 / 3600, rel=1e-12)
+    assert par.decj_deg == pytest.approx(-(75 + 42 / 60 + 35.3 / 3600), rel=1e-12)
+    assert par.f0 == pytest.approx(205.53069608827312545)
+    assert par.f1 == pytest.approx(-4.3060388399134177208e-16)
+    assert par.pepoch_mjd == 53000
+    assert par.loc == {"RAJ": par.raj_hours, "DECJ": par.decj_deg}
+
+
+def test_read_tim_small(partim_small):
+    _, timdir = partim_small
+    toas = read_tim(timdir + "/fake_JPSR00_noiseonly.tim")
+    assert toas.ntoas == 122
+    assert np.all(toas.errors_s == 0.5e-6)
+    assert np.all(toas.freqs_mhz == 1440.0)
+    assert toas.observatories[0] == "AXIS"
+    assert toas.flags[0] == {"pta": "PPTA"}
+    # longdouble precision: fractional day of first TOA preserved to ~ns
+    frac = float((toas.mjd[0] - np.longdouble(53000)) * 86400)
+    assert abs(frac - 2.33e-05) < 1e-6
+
+
+def test_tim_roundtrip(tmp_path, partim_small):
+    _, timdir = partim_small
+    toas = read_tim(timdir + "/fake_JPSR00_noiseonly.tim")
+    out = tmp_path / "out.tim"
+    write_tim(toas, str(out))
+    back = read_tim(str(out))
+    assert back.ntoas == toas.ntoas
+    # sub-ns epoch round-trip
+    assert np.max(np.abs((back.mjd - toas.mjd).astype(float))) * 86400 < 1e-9
+    assert np.allclose(back.errors_s, toas.errors_s)
+
+
+def test_adjust_seconds_precision():
+    toas = fabricate_toas(np.linspace(53000, 56000, 100), 0.5)
+    before = toas.mjd.copy()
+    dt = np.full(100, 1e-6)
+    toas.adjust_seconds(dt)
+    shift = ((toas.mjd - before) * 86400).astype(float)
+    assert np.allclose(shift, 1e-6, rtol=1e-9)
+
+
+def test_fabricate_toas():
+    toas = fabricate_toas([53000, 53030], 1.5, freq_mhz=1400.0, flags={"pta": "X"})
+    assert toas.ntoas == 2
+    assert np.all(toas.errors_s == 1.5e-6)
+    assert toas.flags[1] == {"pta": "X"}
