@@ -1,0 +1,246 @@
+"""PulsarBatch: the frozen, device-resident representation of a pulsar array.
+
+This is the heart of the TPU-first inversion of the reference's design
+(SURVEY.md section 7). The reference mutates a stateful PINT TOAs object per
+injection and re-evaluates the full timing model each time
+(/root/reference/pta_replicator/simulate.py:40-42); here the dataset is
+ingested once on CPU, frozen into padded (Np, Nt) arrays, and every
+injection is a pure function producing per-TOA delays. The total residual
+is the (masked, weighted-mean-subtracted) sum of delays — which makes the
+reference's provenance ledger (`added_signals_time`) a zero-cost stacked
+array instead of a dict of mutations.
+
+Data-dependent structure (ECORR epoch binning, per-backend flag matching,
+ragged TOA counts) is resolved here at freeze time into integer index
+arrays, so everything under ``jit`` is static-shaped and gather-based.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .constants import DAY_IN_SEC
+from .ops.coords import pulsar_theta_phi, unit_vector
+from .ops.quantize import quantize
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class PulsarBatch:
+    """Padded/masked arrays describing Np pulsars with up to Nt TOAs each.
+
+    Times are seconds relative to ``tref_mjd`` (a global reference epoch
+    near the dataset centre) so that float32 device arithmetic retains
+    sub-millisecond time resolution (SURVEY.md "hard parts": precision).
+    """
+
+    #: (Np, Nt) TOA epochs [s relative to tref_mjd]
+    toas_s: jax.Array
+    #: (Np, Nt) TOA uncertainties [s] (1.0 in padding)
+    errors_s: jax.Array
+    #: (Np, Nt) 1.0 for real TOAs, 0.0 for padding
+    mask: jax.Array
+    #: (Np, 3) pulsar direction unit vectors
+    phat: jax.Array
+    #: (Np, Nt) ECORR epoch index (local per pulsar, 0..max_epochs-1)
+    epoch_index: jax.Array
+    #: (Np, max_epochs) 1.0 for real epochs
+    epoch_mask: jax.Array
+    #: (Np, max_epochs) backend index of each epoch (its first TOA's flag)
+    epoch_backend_index: jax.Array
+    #: (Np, Nt) backend/flag-group index (0..max_backends-1)
+    backend_index: jax.Array
+    #: (Np,) observation span [s] of each pulsar
+    tspan_s: jax.Array
+    #: (Np,) number of valid TOAs
+    ntoas: jax.Array
+
+    # -- static metadata (not traced)
+    tref_mjd: float = field(metadata=dict(static=True), default=0.0)
+    names: tuple = field(metadata=dict(static=True), default=())
+    backend_names: tuple = field(metadata=dict(static=True), default=())
+    start_s: float = field(metadata=dict(static=True), default=0.0)
+    stop_s: float = field(metadata=dict(static=True), default=0.0)
+
+    @property
+    def npsr(self) -> int:
+        return self.toas_s.shape[0]
+
+    @property
+    def ntoa_max(self) -> int:
+        return self.toas_s.shape[1]
+
+    @property
+    def max_epochs(self) -> int:
+        return self.epoch_mask.shape[1]
+
+    def astype(self, dtype) -> "PulsarBatch":
+        """Cast floating leaves (times stay in their relative frame)."""
+        cast = lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        return jax.tree_util.tree_map(cast, self)
+
+
+def synthetic_batch(
+    npsr: int = 68,
+    ntoa: int = 7758,
+    nbackend: int = 4,
+    span_days: float = 365.25 * 16,
+    toaerr_s: float = 0.5e-6,
+    epoch_days: float = 14.0,
+    seed: int = 0,
+    dtype=None,
+) -> PulsarBatch:
+    """Build an NG15-scale synthetic PulsarBatch directly from arrays
+    (random sky positions, ~epoch_days observing cadence with several TOAs
+    per epoch across nbackend backends). Used by the benchmark harness and
+    the graft entry points; mirrors the scale of the realistic workload
+    (69 pulsars, ~7.7k TOAs, noise_dicts/ng15_dict.json)."""
+    if dtype is None:
+        dtype = jnp.zeros(0).dtype
+    rng = np.random.default_rng(seed)
+    nepoch = max(1, int(span_days / epoch_days))
+    per_epoch = max(1, ntoa // nepoch)
+    nepoch = (ntoa + per_epoch - 1) // per_epoch
+
+    epoch_times = np.sort(rng.uniform(0.0, span_days, size=(npsr, nepoch)), axis=1)
+    offsets = rng.uniform(0.0, 0.2, size=(npsr, nepoch, per_epoch))
+    toas_d = (epoch_times[:, :, None] + offsets).reshape(npsr, -1)[:, :ntoa]
+    toas_d = np.sort(toas_d, axis=1)
+    toas_s = (toas_d - span_days / 2.0) * DAY_IN_SEC
+
+    epoch_idx = (np.arange(ntoa) // per_epoch)[None, :].repeat(npsr, axis=0)
+    nep = int(epoch_idx.max()) + 1
+    epoch_mask = np.ones((npsr, nep))
+    epoch_backend = rng.integers(0, nbackend, size=(npsr, nep))
+    backend_idx = np.take_along_axis(epoch_backend, epoch_idx, axis=1)
+
+    costheta = rng.uniform(-1, 1, npsr)
+    phi = rng.uniform(0, 2 * np.pi, npsr)
+    sintheta = np.sqrt(1 - costheta**2)
+    phat = np.stack(
+        [sintheta * np.cos(phi), sintheta * np.sin(phi), costheta], axis=1
+    )
+
+    return PulsarBatch(
+        toas_s=jnp.asarray(toas_s, dtype),
+        errors_s=jnp.full((npsr, ntoa), toaerr_s, dtype),
+        mask=jnp.ones((npsr, ntoa), dtype),
+        phat=jnp.asarray(phat, dtype),
+        epoch_index=jnp.asarray(epoch_idx, jnp.int32),
+        epoch_mask=jnp.asarray(epoch_mask, dtype),
+        epoch_backend_index=jnp.asarray(epoch_backend, jnp.int32),
+        backend_index=jnp.asarray(backend_idx, jnp.int32),
+        tspan_s=jnp.asarray(toas_s.max(axis=1) - toas_s.min(axis=1), dtype),
+        ntoas=jnp.full(npsr, ntoa, jnp.int32),
+        tref_mjd=55000.0,
+        names=tuple(f"SYN{i:04d}" for i in range(npsr)),
+        backend_names=tuple(f"backend{i}" for i in range(nbackend)),
+        start_s=float(toas_s.min() - DAY_IN_SEC),
+        stop_s=float(toas_s.max() + DAY_IN_SEC),
+    )
+
+
+def freeze(
+    psrs: List,
+    flagid: str = "f",
+    coarsegrain: float = 0.1,
+    tref_mjd: Optional[float] = None,
+    dtype=None,
+) -> PulsarBatch:
+    """Freeze a list of :class:`~pta_replicator_tpu.simulate.SimulatedPulsar`
+    (or anything with ``.toas``/``.loc``/``.name``) into a PulsarBatch.
+
+    Runs once per dataset on CPU: ragged TOA sets are padded to the max
+    count, ECORR epochs are binned (greedy ``coarsegrain``-day buckets, same
+    rule as the oracle path), and per-TOA backend flags become integer
+    groups shared across the array (so per-backend parameters are (Np,
+    n_backends) arrays gathered per TOA on device).
+    """
+    if dtype is None:
+        dtype = jnp.zeros(0).dtype  # jax default float (f64 under x64)
+    npsr = len(psrs)
+    ntoas = np.array([p.toas.ntoas for p in psrs], dtype=np.int32)
+    nt = int(ntoas.max())
+
+    mjds = [p.toas.get_mjds() for p in psrs]
+    if tref_mjd is None:
+        tref_mjd = float(
+            0.5 * (min(m.min() for m in mjds) + max(m.max() for m in mjds))
+        )
+
+    toas = np.zeros((npsr, nt))
+    errors = np.ones((npsr, nt))
+    mask = np.zeros((npsr, nt))
+    backend_idx = np.zeros((npsr, nt), dtype=np.int32)
+    epoch_idx = np.zeros((npsr, nt), dtype=np.int32)
+    phat = np.zeros((npsr, 3))
+    tspan = np.zeros(npsr)
+
+    # global backend vocabulary across pulsars
+    backend_names: List[str] = []
+    epoch_counts = []
+    epoch_indices = []
+    for i, p in enumerate(psrs):
+        n = p.toas.ntoas
+        rel = (mjds[i] - tref_mjd) * DAY_IN_SEC
+        toas[i, :n] = rel
+        toas[i, n:] = rel[-1] if n else 0.0  # benign padding values
+        errors[i, :n] = p.toas.errors_s
+        mask[i, :n] = 1.0
+        tspan[i] = rel[:n].max() - rel[:n].min() if n else 0.0
+        theta, phi = pulsar_theta_phi(p.loc, p.name)
+        phat[i] = unit_vector(theta, phi)
+
+        flags = p.toas.get_flag(flagid)
+        for j in range(n):
+            val = str(flags[j])
+            if val not in backend_names:
+                backend_names.append(val)
+            backend_idx[i, j] = backend_names.index(val)
+
+        bins = quantize(mjds[i], flags=flags, dt=coarsegrain)
+        epoch_indices.append(bins.epoch_index)
+        epoch_counts.append(bins.nepochs)
+
+    max_epochs = int(max(epoch_counts)) if epoch_counts else 1
+    epoch_mask = np.zeros((npsr, max_epochs))
+    epoch_backend = np.zeros((npsr, max_epochs), dtype=np.int32)
+    for i, p in enumerate(psrs):
+        idx, cnt = epoch_indices[i], epoch_counts[i]
+        epoch_idx[i, : len(idx)] = idx
+        epoch_mask[i, :cnt] = 1.0
+        # backend of each epoch = backend of its first TOA
+        first_toa_of_epoch = np.zeros(cnt, dtype=np.int64)
+        seen = np.zeros(cnt, dtype=bool)
+        order = np.argsort(mjds[i], kind="stable")
+        for j in order:
+            e = idx[j]
+            if not seen[e]:
+                seen[e] = True
+                first_toa_of_epoch[e] = j
+        epoch_backend[i, :cnt] = backend_idx[i, first_toa_of_epoch]
+
+    start = float(min(m.min() for m in mjds) - 1.0) * DAY_IN_SEC
+    stop = float(max(m.max() for m in mjds) + 1.0) * DAY_IN_SEC
+
+    return PulsarBatch(
+        toas_s=jnp.asarray(toas, dtype=dtype),
+        errors_s=jnp.asarray(errors, dtype=dtype),
+        mask=jnp.asarray(mask, dtype=dtype),
+        phat=jnp.asarray(phat, dtype=dtype),
+        epoch_index=jnp.asarray(epoch_idx),
+        epoch_mask=jnp.asarray(epoch_mask, dtype=dtype),
+        epoch_backend_index=jnp.asarray(epoch_backend),
+        backend_index=jnp.asarray(backend_idx),
+        tspan_s=jnp.asarray(tspan, dtype=dtype),
+        ntoas=jnp.asarray(ntoas),
+        tref_mjd=tref_mjd,
+        names=tuple(p.name for p in psrs),
+        backend_names=tuple(backend_names),
+        start_s=start - tref_mjd * DAY_IN_SEC,
+        stop_s=stop - tref_mjd * DAY_IN_SEC,
+    )

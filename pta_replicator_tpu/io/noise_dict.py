@@ -10,6 +10,7 @@ feed the flagged white-noise/jitter operators.
 from __future__ import annotations
 
 import json
+import os
 from collections import defaultdict
 from typing import Dict
 
@@ -26,14 +27,18 @@ def parse_noise_dict(src) -> Dict[str, dict]:
     "red_noise_gamma": g, "red_noise_log10_A": a}}`` where the per-backend
     lists are aligned with ``backends`` and missing entries are ``None``.
     """
-    if isinstance(src, str):
+    if isinstance(src, (str, os.PathLike)):
         with open(src) as fh:
             raw = json.load(fh)
     else:
         raw = dict(src)
 
     per_psr: Dict[str, dict] = defaultdict(
-        lambda: {"backends": [], **{p: [] for p in _WN_PARAMS}}
+        lambda: {
+            "backends": [],
+            **{p: [] for p in _WN_PARAMS},
+            **{p: None for p in _PSR_PARAMS},
+        }
     )
 
     for key, value in raw.items():

@@ -11,6 +11,7 @@ is the single source of truth for epochs, and `write_tim` re-serializes it.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import List, Optional
 
@@ -83,57 +84,93 @@ class TOAData:
         )
 
 
-def read_tim(path: str) -> TOAData:
-    """Parse a Tempo2 ``FORMAT 1`` tim file."""
-    mjds: List[np.longdouble] = []
-    errs: List[float] = []
-    freqs: List[float] = []
-    obs: List[str] = []
-    flags: List[dict] = []
-    labels: List[str] = []
+class _TimParserState:
+    """Mutable directive state threaded through INCLUDE recursion.
 
-    skipping = False
+    Tempo-style commands honored: SKIP/NOSKIP blocks, ``TIME <s>``
+    cumulative offsets, ``EFAC <k>`` / ``EQUAD <us>`` error rescaling, and
+    ``INCLUDE <file>`` (resolved relative to the including file).
+    """
+
+    def __init__(self):
+        self.skipping = False
+        self.time_offset_s = 0.0
+        self.efac = 1.0
+        self.equad_us = 0.0
+        self.mjds: List[np.longdouble] = []
+        self.errs: List[float] = []
+        self.freqs: List[float] = []
+        self.obs: List[str] = []
+        self.flags: List[dict] = []
+        self.labels: List[str] = []
+
+
+def _parse_tim_into(path: str, st: _TimParserState, depth: int = 0) -> None:
+    if depth > 10:
+        raise RecursionError(f"tim INCLUDE nesting too deep at {path}")
+    base = os.path.dirname(os.path.abspath(path))
     with open(path) as fh:
         for line in fh:
             stripped = line.strip()
             if not stripped:
                 continue
-            upper = stripped.upper()
-            # SKIP ... NOSKIP blocks exclude the TOAs they enclose
-            if upper.startswith("NOSKIP"):
-                skipping = False
-                continue
-            if upper.startswith("SKIP"):
-                skipping = True
-                continue
-            if skipping:
-                continue
-            if upper.startswith(("FORMAT", "MODE", "TIME", "EFAC", "EQUAD",
-                                 "INCLUDE", "C ", "#", "JUMP")):
-                continue
             tokens = stripped.split()
+            head = tokens[0].upper()
+            if head == "NOSKIP":
+                st.skipping = False
+                continue
+            if head == "SKIP":
+                st.skipping = True
+                continue
+            if st.skipping:
+                continue
+            if head == "INCLUDE" and len(tokens) >= 2:
+                _parse_tim_into(os.path.join(base, tokens[1]), st, depth + 1)
+                continue
+            if head == "TIME" and len(tokens) >= 2:
+                st.time_offset_s += float(tokens[1])
+                continue
+            if head == "EFAC" and len(tokens) >= 2:
+                st.efac = float(tokens[1])
+                continue
+            if head == "EQUAD" and len(tokens) >= 2:
+                st.equad_us = float(tokens[1])
+                continue
+            if head in ("FORMAT", "MODE", "JUMP") or stripped.startswith(("C ", "#")):
+                continue
             if len(tokens) < 5:
                 continue
-            labels.append(tokens[0])
-            freqs.append(float(tokens[1]))
+            st.labels.append(tokens[0])
+            st.freqs.append(float(tokens[1]))
             # longdouble parse keeps ~18 digits (sub-ns at MJD ~5e4)
-            mjds.append(np.longdouble(tokens[2]))
-            errs.append(float(tokens[3]) * 1e-6)  # us -> s
-            obs.append(tokens[4])
+            mjd = np.longdouble(tokens[2])
+            if st.time_offset_s:
+                mjd = mjd + np.longdouble(st.time_offset_s) / np.longdouble(DAY_IN_SEC)
+            st.mjds.append(mjd)
+            err_us = float(tokens[3])
+            err_us = np.hypot(st.efac * err_us, st.equad_us)
+            st.errs.append(err_us * 1e-6)  # us -> s
+            st.obs.append(tokens[4])
             flagdict = {}
             it = iter(tokens[5:])
             for tok in it:
                 if tok.startswith("-"):
                     flagdict[tok[1:]] = next(it, "")
-            flags.append(flagdict)
+            st.flags.append(flagdict)
 
+
+def read_tim(path: str) -> TOAData:
+    """Parse a Tempo2 ``FORMAT 1`` tim file (with SKIP/NOSKIP, INCLUDE,
+    TIME, EFAC, EQUAD command handling)."""
+    st = _TimParserState()
+    _parse_tim_into(path, st)
     return TOAData(
-        mjd=np.array(mjds, dtype=np.longdouble),
-        errors_s=np.array(errs, dtype=np.float64),
-        freqs_mhz=np.array(freqs, dtype=np.float64),
-        observatories=obs,
-        flags=flags,
-        labels=labels,
+        mjd=np.array(st.mjds, dtype=np.longdouble),
+        errors_s=np.array(st.errs, dtype=np.float64),
+        freqs_mhz=np.array(st.freqs, dtype=np.float64),
+        observatories=st.obs,
+        flags=st.flags,
+        labels=st.labels,
     )
 
 

@@ -1,0 +1,370 @@
+"""Batched, key-driven device ops: every injection as a pure JAX function.
+
+Each op maps ``(key, batch, params) -> delays`` of shape (Np, Nt); a
+realization is the sum of the ops a :class:`Recipe` enables, and a
+realization *batch* is ``jax.vmap`` of :func:`realization_delays` over PRNG
+keys — the realization axis the reference lacks entirely (its operators
+mutate one global dataset; SURVEY.md section 2, parallelism inventory).
+
+Per-backend parameters are (Np, n_backends) arrays gathered per TOA/epoch
+through the integer index arrays the freeze step produced — the device
+equivalent of the reference's string-flag loops
+(/root/reference/pta_replicator/white_noise.py:95-103).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..batch import PulsarBatch
+from ..constants import YEAR_IN_SEC
+from .cgw import cw_delay
+from .gwb import characteristic_strain, gwb_grid, residual_psd_coeff
+
+
+def _per_toa(params, index, mask):
+    """Gather per-backend parameters onto TOAs: (Np, NB) -> (Np, Nt)."""
+    params = jnp.asarray(params)
+    if params.ndim == 1:
+        return params[:, None] * mask
+    return jnp.take_along_axis(params, index, axis=1) * mask
+
+
+# ------------------------------------------------------------- injection ops
+
+def white_noise_delays(
+    key,
+    batch: PulsarBatch,
+    efac=1.0,
+    log10_equad=None,
+    tnequad: bool = False,
+):
+    """EFAC/EQUAD white noise. ``efac``/``log10_equad`` are scalars, (Np,)
+    vectors, or (Np, n_backends) per-backend tables."""
+    dtype = batch.toas_s.dtype
+    k1, k2 = jax.random.split(key)
+    shape = batch.toas_s.shape
+    eps1 = jax.random.normal(k1, shape, dtype)
+    eps2 = jax.random.normal(k2, shape, dtype)
+    ef = jnp.asarray(efac, dtype)
+    ef = jnp.broadcast_to(ef, (batch.npsr,)) if ef.ndim == 0 else ef
+    efac_t = _per_toa(ef, batch.backend_index, batch.mask)
+    if log10_equad is None:
+        equad_t = jnp.zeros(shape, dtype)
+    else:
+        eq = 10.0 ** jnp.asarray(log10_equad, dtype)
+        eq = jnp.broadcast_to(eq, (batch.npsr,)) if eq.ndim == 0 else eq
+        equad_t = _per_toa(eq, batch.backend_index, batch.mask)
+    dt = efac_t * batch.errors_s * eps1 * batch.mask
+    if tnequad:
+        return dt + equad_t * eps2
+    return dt + efac_t * equad_t * eps2
+
+
+def jitter_delays(key, batch: PulsarBatch, log10_ecorr):
+    """ECORR jitter: one draw per (pulsar, epoch), scaled per-epoch and
+    gathered onto TOAs. ``log10_ecorr``: scalar, (Np,), or (Np, NB)."""
+    eps = jax.random.normal(
+        key, (batch.npsr, batch.max_epochs), batch.toas_s.dtype
+    )
+    ec = 10.0 ** jnp.asarray(log10_ecorr, batch.toas_s.dtype)
+    if ec.ndim == 0:
+        per_epoch = ec * batch.epoch_mask
+    elif ec.ndim == 1:
+        per_epoch = ec[:, None] * batch.epoch_mask
+    else:
+        per_epoch = (
+            jnp.take_along_axis(ec, batch.epoch_backend_index, axis=1)
+            * batch.epoch_mask
+        )
+    val = per_epoch * eps
+    return jnp.take_along_axis(val, batch.epoch_index, axis=1) * batch.mask
+
+
+def red_noise_delays(
+    key,
+    batch: PulsarBatch,
+    log10_amplitude,
+    gamma,
+    nmodes: int = 30,
+):
+    """Per-pulsar power-law red noise on the rank-reduced Fourier basis.
+
+    The (Np, Nt, 2K) basis is built in-kernel from the frozen times (cheap,
+    XLA fuses the trig into the MXU contraction); frequencies are k/Tspan
+    per pulsar. Times are referenced to the batch epoch (a per-mode phase
+    convention — statistically identical to the oracle's absolute-time
+    convention, reference red_noise.py:92-101).
+    """
+    dtype = batch.toas_s.dtype
+    log10_amplitude = jnp.broadcast_to(jnp.asarray(log10_amplitude, dtype), (batch.npsr,))
+    gamma = jnp.broadcast_to(jnp.asarray(gamma, dtype), (batch.npsr,))
+    k = jnp.arange(1, nmodes + 1, dtype=dtype)
+    freqs = k[None, :] / batch.tspan_s[:, None]  # (Np, K)
+    arg = 2.0 * jnp.pi * freqs[:, None, :] * batch.toas_s[:, :, None]
+    F = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=-1)  # (Np, Nt, 2K)
+
+    fyr = 1.0 / YEAR_IN_SEC
+    amp = 10.0 ** log10_amplitude
+    prior = (
+        amp[:, None] ** 2
+        * (freqs / fyr) ** (-gamma[:, None])
+        / (12.0 * jnp.pi**2 * batch.tspan_s[:, None])
+        * YEAR_IN_SEC**3
+    )
+    prior2 = jnp.concatenate([prior, prior], axis=-1)  # sin and cos blocks
+    eps = jax.random.normal(key, prior2.shape, dtype)
+    coeff = jnp.sqrt(prior2) * eps
+    return jnp.einsum("pnk,pk->pn", F, coeff) * batch.mask
+
+
+def gwb_delays(
+    key,
+    batch: PulsarBatch,
+    log10_amplitude,
+    gamma,
+    orf_cholesky,
+    npts: int = 600,
+    howml: float = 10,
+    turnover: bool = False,
+    f0: float = 1e-9,
+    beta: float = 1.0,
+    power: float = 1.0,
+    user_spectrum=None,
+):
+    """Correlated GWB across the array: the one cross-pulsar op.
+
+    The (Np x Np) x (Np x Nf) mix is a single einsum against the Cholesky
+    factor of the ORF (computed once on CPU in f64 — see ops.orf); the
+    synthesis FFT and the per-pulsar interpolation are batched. Under a
+    sharded realization axis this whole function is embarrassingly
+    parallel; with the pulsar axis sharded, XLA turns the einsum into a
+    psum over the pulsar mesh axis (reference analog red_noise.py:265-287).
+    """
+    dtype = batch.toas_s.dtype
+    ut, dt_grid, f = gwb_grid(batch.start_s, batch.stop_s, npts, howml)
+    ut = jnp.asarray(ut, dtype)
+    f = jnp.asarray(f, dtype)
+    nf = f.shape[0]
+    dur = batch.stop_s - batch.start_s
+
+    w = jax.random.normal(key, (2, batch.npsr, nf), dtype)
+    w = jax.lax.complex(w[0], w[1])
+
+    hcf = characteristic_strain(
+        f,
+        log10_amplitude,
+        gamma,
+        turnover=turnover,
+        f0=f0,
+        beta=beta,
+        power=power,
+        user_spectrum=user_spectrum,
+        xp=jnp,
+    )
+    C = residual_psd_coeff(hcf, f, dur, howml, xp=jnp)
+
+    M = jnp.asarray(orf_cholesky, dtype)
+    res_f = jnp.einsum("ab,bf->af", M, w) * jnp.sqrt(C)
+    # zero DC and "Nyquist" bins, then inverse-FFT the hermitian spectrum:
+    # irfft(x, n=2*nf-2) == real(ifft(hermitian_pack(x)))
+    mask = jnp.concatenate([jnp.zeros(1, dtype), jnp.ones(nf - 2, dtype), jnp.zeros(1, dtype)])
+    res_t = jnp.fft.irfft(res_f * mask, n=2 * nf - 2, axis=-1) / dt_grid
+    grid_series = res_t[:, 10 : npts + 10].astype(dtype)
+
+    interp = jax.vmap(jnp.interp, in_axes=(0, None, 0))
+    return interp(batch.toas_s, ut, grid_series) * batch.mask
+
+
+def cgw_catalog_delays(
+    batch: PulsarBatch,
+    gwtheta,
+    gwphi,
+    mc,
+    dist,
+    fgw,
+    phase0,
+    psi,
+    inc,
+    pdist=1.0,
+    psr_term: bool = True,
+    evolve: bool = True,
+    phase_approx: bool = False,
+    tref_s: float = 0.0,
+    chunk: int = 512,
+):
+    """Summed response of a CW-source catalog, tiled over sources.
+
+    Replaces the reference's numba prange + 1e7-source python chunking
+    (deterministic.py:258-294, 321-440) with a ``lax.scan`` over
+    ``chunk``-sized source tiles: the (chunk x Nt) workspace stays in
+    VMEM-scale memory while the scan accumulates the (Np, Nt) sum.
+    Deterministic (no key): source parameters are data.
+    """
+    dtype = batch.toas_s.dtype
+    # absolute-seconds times as the reference kernels use them
+    toas_abs = batch.toas_s + jnp.asarray(
+        batch.tref_mjd * 86400.0 - tref_s, dtype
+    )
+    params = [
+        jnp.asarray(x, dtype)
+        for x in (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
+    ]
+    nsrc = params[0].shape[0]
+    npad = (-nsrc) % chunk
+    params = [jnp.concatenate([p, jnp.zeros(npad, dtype)]) for p in params]
+    valid = jnp.concatenate([jnp.ones(nsrc, dtype), jnp.zeros(npad, dtype)])
+    nchunks = (nsrc + npad) // chunk
+    stacked = jnp.stack(params + [valid])  # (9, nsrc+pad)
+    tiles = stacked.reshape(9, nchunks, chunk).transpose(1, 0, 2)
+
+    per_psr = jax.vmap(
+        lambda toas, phat, tile: jnp.sum(
+            cw_delay(
+                toas,
+                phat,
+                *[tile[i] for i in range(8)],
+                pdist=pdist,
+                psr_term=psr_term,
+                evolve=evolve,
+                phase_approx=phase_approx,
+                nan_to_zero=True,
+                xp=jnp,
+            )
+            * tile[8][:, None],
+            axis=0,
+        ),
+        in_axes=(0, 0, None),
+    )
+
+    def step(carry, tile):
+        return carry + per_psr(toas_abs, batch.phat, tile), None
+
+    init = jnp.zeros(batch.toas_s.shape, dtype)
+    total, _ = jax.lax.scan(step, init, tiles)
+    return total * batch.mask
+
+
+# ------------------------------------------------------------------ recipes
+
+@jax.tree_util.register_dataclass
+@dataclass
+class Recipe:
+    """Which signals to inject, with their (possibly per-backend) params.
+
+    Array leaves are traced (so parameter sweeps can be vmapped too);
+    structural switches are static.
+    """
+
+    efac: Optional[jax.Array] = None
+    log10_equad: Optional[jax.Array] = None
+    log10_ecorr: Optional[jax.Array] = None
+    rn_log10_amplitude: Optional[jax.Array] = None
+    rn_gamma: Optional[jax.Array] = None
+    gwb_log10_amplitude: Optional[jax.Array] = None
+    gwb_gamma: Optional[jax.Array] = None
+    orf_cholesky: Optional[jax.Array] = None
+    #: (8, Ns) stacked CW-catalog params in the order
+    #: (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc); deterministic,
+    #: shared by every realization (the population-synthesis outliers)
+    cgw_params: Optional[jax.Array] = None
+
+    tnequad: bool = field(metadata=dict(static=True), default=False)
+    rn_nmodes: int = field(metadata=dict(static=True), default=30)
+    gwb_npts: int = field(metadata=dict(static=True), default=600)
+    gwb_howml: float = field(metadata=dict(static=True), default=10.0)
+    cgw_tref_s: float = field(metadata=dict(static=True), default=0.0)
+    cgw_chunk: int = field(metadata=dict(static=True), default=512)
+
+
+def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
+    """One realization: (Np, Nt) summed delays from the enabled signals."""
+    k_wn, k_ec, k_rn, k_gwb = jax.random.split(key, 4)
+    total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
+    if recipe.efac is not None or recipe.log10_equad is not None:
+        total = total + white_noise_delays(
+            k_wn,
+            batch,
+            efac=recipe.efac if recipe.efac is not None else 1.0,
+            log10_equad=recipe.log10_equad,
+            tnequad=recipe.tnequad,
+        )
+    if recipe.log10_ecorr is not None:
+        total = total + jitter_delays(k_ec, batch, recipe.log10_ecorr)
+    if recipe.rn_log10_amplitude is not None:
+        total = total + red_noise_delays(
+            k_rn,
+            batch,
+            recipe.rn_log10_amplitude,
+            recipe.rn_gamma,
+            nmodes=recipe.rn_nmodes,
+        )
+    if recipe.gwb_log10_amplitude is not None:
+        total = total + gwb_delays(
+            k_gwb,
+            batch,
+            recipe.gwb_log10_amplitude,
+            recipe.gwb_gamma,
+            recipe.orf_cholesky,
+            npts=recipe.gwb_npts,
+            howml=recipe.gwb_howml,
+        )
+    return total
+
+
+def residualize(delays, batch: PulsarBatch):
+    """Delays -> timing residuals: subtract the per-pulsar error-weighted
+    mean over valid TOAs (what a timing-model phase fit absorbs first;
+    oracle analog timing.model.phase_residuals)."""
+    w = batch.mask / batch.errors_s**2
+    mean = jnp.sum(w * delays, axis=-1, keepdims=True) / jnp.sum(
+        w, axis=-1, keepdims=True
+    )
+    return (delays - mean) * batch.mask
+
+
+def quadratic_fit_subtract(delays, batch: PulsarBatch):
+    """Project out the weighted best-fit quadratic in time per pulsar — the
+    batched analog of the post-injection F0/F1 refit
+    (oracle analog SimulatedPulsar.fit, reference simulate.py:44-69)."""
+    t = batch.toas_s / jnp.maximum(batch.tspan_s[:, None], 1.0)
+    M = jnp.stack([jnp.ones_like(t), t, t**2], axis=-1)  # (Np, Nt, 3)
+    w = batch.mask / batch.errors_s**2
+    MtWM = jnp.einsum("pni,pn,pnj->pij", M, w, M)
+    MtWr = jnp.einsum("pni,pn,pn->pi", M, w, delays)
+    coef = jnp.linalg.solve(MtWM, MtWr[..., None])[..., 0]
+    return (delays - jnp.einsum("pni,pi->pn", M, coef)) * batch.mask
+
+
+def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
+    """Realization-independent delays (the CW outlier catalog): computed
+    once per batch, shared across the whole realization axis."""
+    if recipe.cgw_params is None:
+        return jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
+    return cgw_catalog_delays(
+        batch,
+        *[recipe.cgw_params[i] for i in range(8)],
+        tref_s=recipe.cgw_tref_s,
+        chunk=recipe.cgw_chunk,
+    )
+
+
+def realize(key, batch: PulsarBatch, recipe: Recipe, nreal: int, fit: bool = False):
+    """Batch of independent realizations: (R, Np, Nt) residuals.
+
+    vmap over PRNG keys gives the realization axis; shard it across
+    devices with parallel.sharded_realize.
+    """
+    keys = jax.random.split(key, nreal)
+    static = deterministic_delays(batch, recipe)
+
+    def one(k):
+        d = realization_delays(k, batch, recipe) + static
+        d = quadratic_fit_subtract(d, batch) if fit else d
+        return residualize(d, batch)
+
+    return jax.vmap(one)(keys)

@@ -301,6 +301,12 @@ def assemble_orf(psr_locs: np.ndarray, clm=None, lmax: int = 0) -> np.ndarray:
     """
     if clm is None:
         clm = [np.sqrt(4.0 * np.pi)]
+    clm = np.asarray(clm, dtype=np.float64)
+    nlm = (lmax + 1) ** 2
+    if clm.shape != (nlm,):
+        raise ValueError(
+            f"clm must have (lmax+1)^2 = {nlm} coefficients for lmax={lmax}, "
+            f"got {clm.shape}"
+        )
     basis = correlated_basis(psr_locs, lmax)
-    orf = np.tensordot(np.asarray(clm, dtype=np.float64), basis[: len(clm)], axes=1)
-    return 2.0 * orf
+    return 2.0 * np.tensordot(clm, basis, axes=1)

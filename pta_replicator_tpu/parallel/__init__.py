@@ -1,0 +1,3 @@
+from .mesh import make_mesh, sharded_realize, shard_batch
+
+__all__ = ["make_mesh", "sharded_realize", "shard_batch"]

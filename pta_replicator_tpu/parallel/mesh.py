@@ -1,0 +1,110 @@
+"""Device-mesh parallelism: shard realizations (and optionally pulsars).
+
+The reference's only parallelism is shared-memory numba ``prange`` over CW
+sources (/root/reference/pta_replicator/deterministic.py:321-328); it has
+no distributed backend at all (SURVEY.md section 2). Here scale-out is the
+TPU-native recipe: a 2-D ``jax.sharding.Mesh`` with axes
+
+* ``real`` — independent realizations (pure data parallel; zero
+  collectives, rides ICI/DCN only for the initial broadcast), and
+* ``psr``  — the pulsar axis (model parallel; the GWB's Np x Np ORF mix
+  is the one op that couples pulsars, and XLA lowers its einsum to a
+  psum over this axis when sharded).
+
+Everything is expressed through ``jax.jit`` + ``NamedSharding``
+constraints; XLA inserts the collectives (scaling-book style), so the same
+code runs single-chip, v5e-8, or multi-host without change.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..batch import PulsarBatch
+from ..models.batched import (
+    Recipe,
+    deterministic_delays,
+    quadratic_fit_subtract,
+    realization_delays,
+    residualize,
+)
+
+
+def make_mesh(
+    n_real: Optional[int] = None,
+    n_psr: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ('real', 'psr') mesh over the available devices.
+
+    Default: all devices on the realization axis (the right choice until
+    Np or memory forces pulsar sharding).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_real is None:
+        n_real = len(devices) // n_psr
+    needed = n_real * n_psr
+    if needed > len(devices):
+        raise ValueError(
+            f"mesh {n_real}x{n_psr} needs {needed} devices, "
+            f"only {len(devices)} available"
+        )
+    dev_array = np.array(devices[:needed]).reshape(n_real, n_psr)
+    return Mesh(dev_array, axis_names=("real", "psr"))
+
+
+def shard_batch(batch: PulsarBatch, mesh: Mesh) -> PulsarBatch:
+    """Place the frozen batch on the mesh: pulsar-major leaves are sharded
+    along 'psr' (replicated over 'real'); scalars replicate everywhere."""
+
+    def place(x):
+        if hasattr(x, "ndim") and x.ndim >= 1:
+            spec = P("psr", *([None] * (x.ndim - 1)))
+            return jax.device_put(x, NamedSharding(mesh, spec))
+        return x
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def sharded_realize(
+    key,
+    batch: PulsarBatch,
+    recipe: Recipe,
+    nreal: int,
+    mesh: Optional[Mesh] = None,
+    fit: bool = False,
+):
+    """(R, Np, Nt) residual realizations with R sharded over 'real' and the
+    pulsar axis sharded over 'psr'.
+
+    Returns a jitted, committed global array; per-device shards hold
+    R/n_real realizations of Np/n_psr pulsars. nreal must divide evenly.
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    n_real_axis = mesh.shape["real"]
+    if nreal % n_real_axis:
+        raise ValueError(f"nreal={nreal} not divisible by mesh 'real'={n_real_axis}")
+
+    keys = jax.random.split(key, nreal)
+    keys = jax.device_put(keys, NamedSharding(mesh, P("real")))
+    batch = shard_batch(batch, mesh)
+    out_spec = NamedSharding(mesh, P("real", "psr", None))
+
+    @jax.jit
+    def run(keys, batch, recipe):
+        static = deterministic_delays(batch, recipe)
+
+        def one(k):
+            d = realization_delays(k, batch, recipe) + static
+            d = quadratic_fit_subtract(d, batch) if fit else d
+            return residualize(d, batch)
+
+        out = jax.vmap(one)(keys)
+        return jax.lax.with_sharding_constraint(out, out_spec)
+
+    return run(keys, batch, recipe)

@@ -90,13 +90,15 @@ class SimulatedPulsar:
         self.toas.adjust_seconds(dt_s)
         self.update_residuals()
 
-    def fit(self, fitter: str = "auto", nspin: int = 2, cov: np.ndarray = None, **kwargs) -> None:
+    def fit(self, fitter: str = "auto", nspin: int = 2, cov: np.ndarray = None) -> None:
         """Refit spin-down parameters post-injection (WLS or GLS).
 
         Reference analog: simulate.py:44-69 (PINT fitter selection). Here
         'wls'/'auto' run weighted least squares, 'gls'/'downhill' run
         generalized least squares with covariance ``cov`` (defaults to
-        diag(errors^2)).
+        diag(errors^2)). PINT-specific fitter kwargs of the reference
+        (e.g. max_chi2_increase) have no analog and are deliberately not
+        accepted, so ported calls fail loudly instead of silently no-oping.
         """
         if fitter not in ("wls", "gls", "downhill", "auto"):
             raise ValueError(f"fitter={fitter!r} must be one of 'wls', 'gls', 'downhill' or 'auto'")

@@ -15,6 +15,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax
 
+# Force the CPU backend even when a TPU plugin pre-registered itself and
+# overrode jax_platforms at interpreter start (the env var alone is not
+# enough then, and initializing the remote TPU backend can block).
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pathlib

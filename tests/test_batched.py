@@ -1,0 +1,180 @@
+"""Device-path tests: PulsarBatch freeze + batched injection ops.
+
+Statistical validation strategy per SURVEY.md section 4: the device path
+uses jax.random (different streams than the oracle's legacy RNG), so
+agreement is checked on distributional properties (variances, epoch
+correlation structure, HD cross-correlations) and on exact values for the
+deterministic ops (CW catalog).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pta_replicator_tpu.batch import freeze
+from pta_replicator_tpu.models import batched as B
+from pta_replicator_tpu.models.cgw import add_catalog_of_cws, cw_delay
+from pta_replicator_tpu.models.gwb import gwb_time_series
+from pta_replicator_tpu.ops.orf import assemble_orf
+from pta_replicator_tpu.ops.quantize import quantize
+
+
+@pytest.fixture(scope="module")
+def batch(partim_small_module):
+    from pta_replicator_tpu import load_from_directories, make_ideal
+
+    pardir, timdir = partim_small_module
+    psrs = load_from_directories(pardir, timdir, num_psrs=3)
+    for p in psrs:
+        make_ideal(p)
+    return freeze(psrs), psrs
+
+
+def test_freeze_shapes_and_masks(batch):
+    b, psrs = batch
+    assert b.npsr == 3 and b.ntoa_max == 122
+    assert np.all(np.asarray(b.mask) == 1.0)  # equal-length fixture
+    assert b.names == ("JPSR00", "JPSR01", "JPSR02")
+    # epoch structure matches the oracle quantization
+    bins = quantize(psrs[0].toas.get_mjds(), dt=0.1)
+    assert int(b.epoch_mask[0].sum()) == bins.nepochs
+    np.testing.assert_array_equal(np.asarray(b.epoch_index[0]), bins.epoch_index)
+    # unit phat
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(b.phat), axis=1), 1.0)
+
+
+def test_white_noise_variance(batch):
+    b, _ = batch
+    keys = jax.random.split(jax.random.PRNGKey(0), 3000)
+    d = jax.vmap(lambda k: B.white_noise_delays(k, b, efac=1.5, log10_equad=-6.0))(keys)
+    var = np.var(np.asarray(d), axis=0)
+    expect = 1.5**2 * np.asarray(b.errors_s) ** 2 + 1.5**2 * (1e-6) ** 2
+    np.testing.assert_allclose(var, expect, rtol=0.15)
+
+
+def test_jitter_epoch_structure(batch):
+    b, _ = batch
+    d = B.jitter_delays(jax.random.PRNGKey(1), b, log10_ecorr=np.log10(3e-7))
+    d = np.asarray(d)
+    idx = np.asarray(b.epoch_index)
+    for p in range(b.npsr):
+        for e in np.unique(idx[p]):
+            vals = d[p][idx[p] == e]
+            assert np.allclose(vals, vals[0])  # shared draw within epoch
+    keys = jax.random.split(jax.random.PRNGKey(2), 4000)
+    dd = jax.vmap(lambda k: B.jitter_delays(k, b, np.log10(3e-7)))(keys)
+    np.testing.assert_allclose(np.asarray(dd).var(axis=0).mean(), (3e-7) ** 2, rtol=0.1)
+
+
+def test_red_noise_variance(batch):
+    """Per-TOA variance of the red-noise delay equals the summed prior."""
+    b, _ = batch
+    keys = jax.random.split(jax.random.PRNGKey(3), 4000)
+    d = jax.vmap(lambda k: B.red_noise_delays(k, b, -14.0, 4.33, nmodes=30))(keys)
+    var = np.asarray(d).var(axis=0).mean(axis=1)  # (Np,)
+    from pta_replicator_tpu.constants import YEAR_IN_SEC
+
+    T = np.asarray(b.tspan_s)
+    f = np.arange(1, 31)[None, :] / T[:, None]
+    prior = (
+        1e-28 * (f * YEAR_IN_SEC) ** (-4.33) / (12 * np.pi**2 * T[:, None])
+        * YEAR_IN_SEC**3
+    )
+    # each mode contributes prior_k * (sin^2 + cos^2) = prior_k per TOA
+    np.testing.assert_allclose(var, prior.sum(axis=1), rtol=0.1)
+
+
+def test_gwb_hellings_downs_correlations(batch):
+    """Realization-averaged cross-pulsar correlations recover the ORF."""
+    b, psrs = batch
+    orf = assemble_orf(_locs(psrs), lmax=0)
+    M = np.linalg.cholesky(orf)
+    keys = jax.random.split(jax.random.PRNGKey(4), 1500)
+    d = jax.vmap(
+        lambda k: B.gwb_delays(k, b, -14.0, 4.33, M, npts=200, howml=4)
+    )(keys)
+    d = np.asarray(d)  # (R, Np, Nt)
+    cov = np.einsum("ran,rbn->ab", d, d) / d.shape[0] / d.shape[2]
+    corr = cov / np.sqrt(np.outer(np.diag(cov), np.diag(cov)))
+    expect = orf / 2.0
+    np.testing.assert_allclose(corr, expect, atol=0.08)
+
+
+def _locs(psrs):
+    from pta_replicator_tpu.ops.coords import pulsar_ra_dec
+
+    locs = np.zeros((len(psrs), 2))
+    for i, p in enumerate(psrs):
+        ra, dec = pulsar_ra_dec(p.loc, p.name)
+        locs[i] = ra, np.pi / 2 - dec
+    return locs
+
+
+def test_irfft_equals_hermitian_pack_ifft():
+    """The device path's irfft shortcut matches the oracle's packing."""
+    rng = np.random.default_rng(0)
+    nf = 65
+    w = rng.normal(size=(2, nf)) + 1j * rng.normal(size=(2, nf))
+    w[:, 0] = 0.0
+    w[:, -1] = 0.0
+    oracle = gwb_time_series(w, np.eye(2), np.ones(nf), dt_grid=1.0, npts=100)
+    direct = np.fft.irfft(w, n=2 * nf - 2, axis=-1)[:, 10:110]
+    np.testing.assert_allclose(oracle, direct, atol=1e-12)
+
+
+def test_cgw_catalog_matches_oracle(batch):
+    """Deterministic op: device catalog == oracle catalog, exactly."""
+    b, psrs = batch
+    n = 700
+    rng = np.random.default_rng(5)
+    cat = dict(
+        gwtheta=np.arccos(rng.uniform(-1, 1, n)),
+        gwphi=rng.uniform(0, 2 * np.pi, n),
+        mc=10 ** rng.uniform(8, 9.5, n),
+        dist=rng.uniform(10, 500, n),
+        fgw=10 ** rng.uniform(-8.8, -7.5, n),
+        phase0=rng.uniform(0, 2 * np.pi, n),
+        psi=rng.uniform(0, np.pi, n),
+        inc=np.arccos(rng.uniform(-1, 1, n)),
+    )
+    tref = 53000 * 86400
+    dev = B.cgw_catalog_delays(b, *cat.values(), tref_s=tref, chunk=128)
+    for i, p in enumerate(psrs):
+        add_catalog_of_cws(
+            p,
+            gwtheta_list=cat["gwtheta"], gwphi_list=cat["gwphi"],
+            mc_list=cat["mc"], dist_list=cat["dist"], fgw_list=cat["fgw"],
+            phase0_list=cat["phase0"], psi_list=cat["psi"], inc_list=cat["inc"],
+            tref=tref,
+        )
+        oracle = p.added_signals_time[f"{p.name}_cw_catalog"]
+        np.testing.assert_allclose(np.asarray(dev[i]), oracle, rtol=1e-8, atol=1e-15)
+
+
+def test_recipe_realize_shapes(batch):
+    b, psrs = batch
+    orf = assemble_orf(_locs(psrs), lmax=0)
+    recipe = B.Recipe(
+        efac=jnp.ones(3),
+        log10_ecorr=jnp.full(3, -6.5),
+        rn_log10_amplitude=jnp.full(3, -14.0),
+        rn_gamma=jnp.full(3, 4.33),
+        gwb_log10_amplitude=jnp.asarray(-14.0),
+        gwb_gamma=jnp.asarray(4.33),
+        orf_cholesky=jnp.asarray(np.linalg.cholesky(orf)),
+    )
+    res = B.realize(jax.random.PRNGKey(7), b, recipe, nreal=4)
+    assert res.shape == (4, 3, 122)
+    assert bool(jnp.all(jnp.isfinite(res)))
+    # residualized: weighted mean ~ 0 per pulsar
+    w = np.asarray(b.mask / b.errors_s**2)
+    means = np.einsum("rpn,pn->rp", np.asarray(res), w) / w.sum(axis=1)
+    assert np.abs(means).max() < 1e-18
+
+
+def test_fit_subtract_removes_quadratic(batch):
+    b, _ = batch
+    t = np.asarray(b.toas_s)
+    fake = 1e-6 + 3e-14 * t + 5e-22 * t**2
+    out = np.asarray(B.quadratic_fit_subtract(jnp.asarray(fake), b))
+    assert np.abs(out).max() < 1e-12

@@ -1,5 +1,6 @@
 """Regression tests for review findings on the oracle layer."""
 import numpy as np
+import pytest
 
 from pta_replicator_tpu import add_red_noise, load_pulsar, make_ideal
 from pta_replicator_tpu.io import read_tim
@@ -54,3 +55,44 @@ def test_tim_skip_blocks(tmp_path):
     toas = read_tim(str(p))
     full = read_tim(TIM)
     assert toas.ntoas == full.ntoas - 2
+
+
+def test_tim_include_time_efac_equad(tmp_path):
+    """INCLUDE pulls TOAs from other files; TIME/EFAC/EQUAD commands apply."""
+    child = tmp_path / "child.tim"
+    child.write_text(
+        "FORMAT 1\n a 1440.0 53000.0 1.00000 AXIS\n a 1440.0 53010.0 1.00000 AXIS\n"
+    )
+    master = tmp_path / "master.tim"
+    master.write_text(
+        "FORMAT 1\nTIME 2.0\nEFAC 3.0\nEQUAD 4.0\nINCLUDE child.tim\n"
+        " b 1440.0 53020.0 2.00000 AXIS\n"
+    )
+    toas = read_tim(str(master))
+    assert toas.ntoas == 3
+    # TIME offset: +2 s on every TOA
+    assert abs(float((toas.mjd[0] - 53000.0) * 86400) - 2.0) < 1e-6
+    # errors: hypot(efac * err, equad) in us
+    assert toas.errors_s[0] == pytest.approx(np.hypot(3.0, 4.0) * 1e-6)
+    assert toas.errors_s[2] == pytest.approx(np.hypot(6.0, 4.0) * 1e-6)
+
+
+def test_assemble_orf_clm_length_validated():
+    from pta_replicator_tpu.ops.orf import assemble_orf
+
+    locs = np.array([[0.3, 1.0], [2.0, 2.0]])
+    with pytest.raises(ValueError, match="coefficients"):
+        assemble_orf(locs, clm=[1.0, 0.5], lmax=2)
+
+
+def test_noise_dict_path_and_defaults(tmp_path):
+    import json
+    import pathlib
+    from pta_replicator_tpu.io import parse_noise_dict
+
+    p = tmp_path / "nd.json"
+    p.write_text(json.dumps({"J0613-0200_430_ASP_efac": 1.1}))
+    nd = parse_noise_dict(pathlib.Path(p))
+    entry = nd["J0613-0200"]
+    assert entry["backends"] == ["430_ASP"]
+    assert entry["red_noise_gamma"] is None  # promised key, even if absent
