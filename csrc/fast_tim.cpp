@@ -1,0 +1,145 @@
+// Fast Tempo2 FORMAT-1 tim-file tokenizer.
+//
+// Native ingest path for the framework's CPU frontier: the reference
+// delegates TOA parsing to PINT (simulate.py:155), whose Python-level
+// line handling dominates cold-start for ~7.7k-TOA pulsars (SURVEY.md
+// section 3.1). This tokenizer handles the plain-TOA fast path in one
+// pass; files using stateful directives (INCLUDE/SKIP/TIME/EFAC/EQUAD)
+// make it return DIRECTIVE_FOUND so the Python parser, which implements
+// their full semantics, takes over.
+//
+// Epochs are split into (integer MJD, long-double fractional day) so the
+// fraction survives a double return slot at ~2e-11 s resolution.
+//
+// Exposed via ctypes (no pybind11 in the build image).
+
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr int64_t ERR_OPEN = -1;
+constexpr int64_t DIRECTIVE_FOUND = -2;
+constexpr int64_t ERR_TEXT_OVERFLOW = -3;
+
+struct Reader {
+    FILE* f;
+    char line[8192];
+};
+
+bool is_directive(const char* tok) {
+    static const char* kDirectives[] = {
+        "INCLUDE", "SKIP", "NOSKIP", "TIME", "EFAC", "EQUAD",
+    };
+    for (const char* d : kDirectives) {
+        if (strcasecmp(tok, d) == 0) return true;
+    }
+    return false;
+}
+
+bool is_ignorable(const char* tok) {
+    return strcasecmp(tok, "FORMAT") == 0 || strcasecmp(tok, "MODE") == 0 ||
+           strcasecmp(tok, "JUMP") == 0 || tok[0] == '#' ||
+           (tok[0] == 'C' && tok[1] == '\0');
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1: count TOA lines. Returns count >= 0, ERR_OPEN, or
+// DIRECTIVE_FOUND if the file needs the stateful Python parser.
+int64_t fast_tim_count(const char* path) {
+    FILE* f = fopen(path, "r");
+    if (!f) return ERR_OPEN;
+    char line[8192];
+    int64_t n = 0;
+    while (fgets(line, sizeof line, f)) {
+        char head[64];
+        if (sscanf(line, " %63s", head) != 1) continue;
+        if (is_directive(head)) {
+            fclose(f);
+            return DIRECTIVE_FOUND;
+        }
+        if (is_ignorable(head)) continue;
+        // a TOA line has at least 5 whitespace-separated fields
+        int fields = 0;
+        bool in_tok = false;
+        for (const char* p = line; *p; ++p) {
+            if (isspace(static_cast<unsigned char>(*p))) {
+                in_tok = false;
+            } else if (!in_tok) {
+                in_tok = true;
+                ++fields;
+            }
+        }
+        if (fields >= 5) ++n;
+    }
+    fclose(f);
+    return n;
+}
+
+// Pass 2: parse into caller-allocated arrays of length n (from pass 1).
+// text buffer receives "label\x1fobs\x1fflagtext\n" per TOA. Returns the
+// number parsed, or a negative error code.
+int64_t fast_tim_parse(const char* path, int64_t n, int64_t* mjd_day,
+                       double* mjd_frac, double* err_us, double* freq_mhz,
+                       char* text, int64_t text_cap) {
+    FILE* f = fopen(path, "r");
+    if (!f) return ERR_OPEN;
+    char line[8192];
+    int64_t i = 0;
+    int64_t tpos = 0;
+    while (fgets(line, sizeof line, f) && i < n) {
+        // tokenize in place
+        char* saveptr = nullptr;
+        char* tok[6];
+        char work[8192];
+        strncpy(work, line, sizeof work - 1);
+        work[sizeof work - 1] = '\0';
+        char* first = strtok_r(work, " \t\r\n", &saveptr);
+        if (!first) continue;
+        if (is_ignorable(first)) continue;
+        tok[0] = first;
+        int ntok = 1;
+        while (ntok < 5) {
+            char* t = strtok_r(nullptr, " \t\r\n", &saveptr);
+            if (!t) break;
+            tok[ntok++] = t;
+        }
+        if (ntok < 5) continue;
+
+        freq_mhz[i] = strtod(tok[1], nullptr);
+        // split epoch at the decimal point for lossless storage
+        const char* dot = strchr(tok[2], '.');
+        if (dot) {
+            mjd_day[i] = strtoll(tok[2], nullptr, 10);
+            long double frac = strtold(dot, nullptr);
+            mjd_frac[i] = static_cast<double>(frac);
+        } else {
+            mjd_day[i] = strtoll(tok[2], nullptr, 10);
+            mjd_frac[i] = 0.0;
+        }
+        err_us[i] = strtod(tok[3], nullptr);
+
+        // label, observatory, and the raw flag tail
+        const char* rest = strtok_r(nullptr, "\r\n", &saveptr);
+        int64_t need = static_cast<int64_t>(strlen(tok[0])) + 1 +
+                       static_cast<int64_t>(strlen(tok[4])) + 1 +
+                       (rest ? static_cast<int64_t>(strlen(rest)) : 0) + 1;
+        if (tpos + need >= text_cap) {
+            fclose(f);
+            return ERR_TEXT_OVERFLOW;
+        }
+        tpos += snprintf(text + tpos, text_cap - tpos, "%s\x1f%s\x1f%s\n",
+                         tok[0], tok[4], rest ? rest : "");
+        ++i;
+    }
+    fclose(f);
+    return i;
+}
+
+}  // extern "C"

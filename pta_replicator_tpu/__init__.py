@@ -38,6 +38,9 @@ from .models import (
     add_burst,
     add_noise_transient,
     add_gw_memory,
+    add_gwb_plus_outlier_cws,
+    population_recipe,
+    split_population,
 )
 
 __all__ = [
@@ -56,4 +59,7 @@ __all__ = [
     "add_burst",
     "add_noise_transient",
     "add_gw_memory",
+    "add_gwb_plus_outlier_cws",
+    "population_recipe",
+    "split_population",
 ]

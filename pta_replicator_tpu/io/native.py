@@ -1,0 +1,108 @@
+"""ctypes bindings for the native (C++) IO fast paths.
+
+The shared library is compiled on first use with g++ into the package's
+``_native`` cache directory and loaded via ctypes (the build image has no
+pybind11; SURVEY.md's native-component policy). Every entry point
+degrades gracefully: if the toolchain or compile is unavailable,
+callers fall back to the pure-Python implementations.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "fast_tim.cpp")
+
+ERR_OPEN = -1
+DIRECTIVE_FOUND = -2
+ERR_TEXT_OVERFLOW = -3
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native library; None if unavailable."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.isfile(_SRC):
+            return None
+        so_path = os.path.join(_build_dir(), "libfastio.so")
+        try:
+            if (not os.path.isfile(so_path)
+                    or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O3", "-fPIC", "-shared", "-o", so_path, _SRC],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(so_path)
+            lib.fast_tim_count.restype = ctypes.c_int64
+            lib.fast_tim_count.argtypes = [ctypes.c_char_p]
+            lib.fast_tim_parse.restype = ctypes.c_int64
+            lib.fast_tim_parse.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_char_p,
+                ctypes.c_int64,
+            ]
+            _LIB = lib
+        except Exception as err:  # toolchain missing, compile failure, ...
+            print(f"pta_replicator_tpu: native IO unavailable ({err}); "
+                  "using the Python tim parser.", file=sys.stderr)
+            _LIB = None
+        return _LIB
+
+
+def fast_read_tim(path: str):
+    """Parse a plain tim file natively.
+
+    Returns (mjd_longdouble, errors_s, freqs_mhz, labels, observatories,
+    flag_strings) or None when the native path is unavailable or the file
+    uses stateful directives (INCLUDE/SKIP/TIME/EFAC/EQUAD).
+    """
+    lib = load_library()
+    if lib is None:
+        return None
+    n = lib.fast_tim_count(path.encode())
+    if n < 0:
+        return None  # unreadable or needs the stateful Python parser
+    mjd_day = np.empty(n, dtype=np.int64)
+    mjd_frac = np.empty(n, dtype=np.float64)
+    err_us = np.empty(n, dtype=np.float64)
+    freq = np.empty(n, dtype=np.float64)
+    text_cap = max(4096, 256 * int(n))
+    text = ctypes.create_string_buffer(text_cap)
+    got = lib.fast_tim_parse(path.encode(), n, mjd_day, mjd_frac, err_us,
+                             freq, text, text_cap)
+    if got != n:
+        return None
+    mjd = mjd_day.astype(np.longdouble) + mjd_frac.astype(np.longdouble)
+    labels, obs, flag_strs = [], [], []
+    raw = text.value.decode(errors="replace")
+    for rec in raw.splitlines():
+        parts = rec.split("\x1f", 2)
+        labels.append(parts[0] if len(parts) > 0 else "")
+        obs.append(parts[1] if len(parts) > 1 else "")
+        flag_strs.append(parts[2] if len(parts) > 2 else "")
+    return mjd, err_us * 1e-6, freq, labels, obs, flag_strs

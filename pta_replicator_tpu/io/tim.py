@@ -151,17 +151,58 @@ def _parse_tim_into(path: str, st: _TimParserState, depth: int = 0) -> None:
             err_us = np.hypot(st.efac * err_us, st.equad_us)
             st.errs.append(err_us * 1e-6)  # us -> s
             st.obs.append(tokens[4])
-            flagdict = {}
-            it = iter(tokens[5:])
-            for tok in it:
-                if tok.startswith("-"):
-                    flagdict[tok[1:]] = next(it, "")
-            st.flags.append(flagdict)
+            st.flags.append(_parse_flag_tail(" ".join(tokens[5:])))
 
 
-def read_tim(path: str) -> TOAData:
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def _parse_flag_tail(text: str) -> dict:
+    """'-key value ...' pairs; '-1.5e-6'-style negative numbers are values,
+    not keys (shared by the Python and native parse paths)."""
+    out = {}
+    toks = text.split()
+    i = 0
+    while i < len(toks):
+        tok = toks[i]
+        if tok.startswith("-") and not _is_number(tok):
+            nxt = toks[i + 1] if i + 1 < len(toks) else None
+            if nxt is not None and not (nxt.startswith("-") and not _is_number(nxt)):
+                out[tok[1:]] = nxt
+                i += 2
+                continue
+            out[tok[1:]] = ""
+        i += 1
+    return out
+
+
+def read_tim(path: str, use_native: bool = True) -> TOAData:
     """Parse a Tempo2 ``FORMAT 1`` tim file (with SKIP/NOSKIP, INCLUDE,
-    TIME, EFAC, EQUAD command handling)."""
+    TIME, EFAC, EQUAD command handling).
+
+    Plain files (no stateful directives) go through the native C++
+    tokenizer when available (csrc/fast_tim.cpp); directive-bearing files
+    and toolchain-less environments use the Python parser.
+    """
+    if use_native:
+        from .native import fast_read_tim
+
+        fast = fast_read_tim(path)
+        if fast is not None:
+            mjd, errs, freqs, labels, obs, flag_strs = fast
+            return TOAData(
+                mjd=mjd,
+                errors_s=errs,
+                freqs_mhz=freqs,
+                observatories=obs,
+                flags=[_parse_flag_tail(s) for s in flag_strs],
+                labels=labels,
+            )
     st = _TimParserState()
     _parse_tim_into(path, st)
     return TOAData(

@@ -3,6 +3,7 @@ from .red_noise import add_red_noise
 from .gwb import add_gwb
 from .cgw import add_cgw, add_catalog_of_cws
 from .bursts import add_burst, add_noise_transient, add_gw_memory
+from .population import add_gwb_plus_outlier_cws, population_recipe, split_population
 
 __all__ = [
     "add_measurement_noise",
@@ -14,4 +15,7 @@ __all__ = [
     "add_burst",
     "add_noise_transient",
     "add_gw_memory",
+    "add_gwb_plus_outlier_cws",
+    "population_recipe",
+    "split_population",
 ]

@@ -268,6 +268,9 @@ class Recipe:
     gwb_log10_amplitude: Optional[jax.Array] = None
     gwb_gamma: Optional[jax.Array] = None
     orf_cholesky: Optional[jax.Array] = None
+    #: (F, 2) [freq_hz, hc] user characteristic-strain spectrum; overrides
+    #: the power-law when present (population free-spec injection)
+    gwb_user_spectrum: Optional[jax.Array] = None
     #: (8, Ns) stacked CW-catalog params in the order
     #: (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc); deterministic,
     #: shared by every realization (the population-synthesis outliers)
@@ -303,7 +306,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             recipe.rn_gamma,
             nmodes=recipe.rn_nmodes,
         )
-    if recipe.gwb_log10_amplitude is not None:
+    if recipe.gwb_log10_amplitude is not None or recipe.gwb_user_spectrum is not None:
         total = total + gwb_delays(
             k_gwb,
             batch,
@@ -312,6 +315,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             recipe.orf_cholesky,
             npts=recipe.gwb_npts,
             howml=recipe.gwb_howml,
+            user_spectrum=recipe.gwb_user_spectrum,
         )
     return total
 

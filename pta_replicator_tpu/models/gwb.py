@@ -64,7 +64,9 @@ def characteristic_strain(
     f = xp.asarray(f)
     if user_spectrum is not None:
         uf = xp.asarray(user_spectrum[:, 0])
-        uh = xp.asarray(user_spectrum[:, 1])
+        # clamp so zero/underflowed strain entries cannot put -inf nodes
+        # into the log-log interpolation (f32 device path)
+        uh = xp.maximum(xp.asarray(user_spectrum[:, 1]), 1e-30)
         logh = xp.interp(xp.log10(f), xp.log10(uf), xp.log10(uh))
         return 10.0**logh
     amp = 10.0**log10_amplitude

@@ -1,0 +1,13 @@
+from .cosmology import (
+    chirp_mass,
+    comoving_distance_cm,
+    gw_strain_source,
+    m1m2_from_mtmr,
+)
+
+__all__ = [
+    "chirp_mass",
+    "comoving_distance_cm",
+    "gw_strain_source",
+    "m1m2_from_mtmr",
+]

@@ -1,0 +1,126 @@
+"""Dataset/state persistence beyond par/tim round-trips.
+
+The reference's only persistence is ``write_partim`` (simulate.py:71-77),
+which loses the provenance ledger on round-trip (SURVEY.md section 5).
+Here both sides survive:
+
+* :func:`save_pulsar` / :func:`load_pulsar_checkpoint` — one
+  ``SimulatedPulsar`` including its ledger (params + per-TOA delays);
+* :func:`save_batch` / :func:`load_batch` — a frozen
+  :class:`~pta_replicator_tpu.batch.PulsarBatch` (npz of leaves + static
+  metadata), so large arrays freeze once and reload instantly.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from ..batch import PulsarBatch
+from ..io.par import ParModel
+from ..io.tim import TOAData
+from ..simulate import SimulatedPulsar
+from ..timing.model import SpindownTiming
+
+
+def save_pulsar(psr: SimulatedPulsar, path: str) -> None:
+    """Persist a SimulatedPulsar (model, TOAs, flags, ledger) to one npz."""
+    meta = {
+        "name": psr.name,
+        "ephem": psr.ephem,
+        "loc": psr.loc,
+        "model": dataclasses.asdict(psr.model),
+        "par_lines": psr.par.lines if psr.par else [],
+        "flags": psr.toas.flags,
+        "observatories": psr.toas.observatories,
+        "labels": psr.toas.labels,
+        "added_signals": _jsonable(psr.added_signals),
+        "ledger_keys": list((psr.added_signals_time or {}).keys()),
+    }
+    arrays = {
+        "mjd_day": np.floor(psr.toas.mjd).astype(np.int64),
+        "mjd_frac": (psr.toas.mjd - np.floor(psr.toas.mjd)).astype(np.float64),
+        "errors_s": psr.toas.errors_s,
+        "freqs_mhz": psr.toas.freqs_mhz,
+    }
+    for i, key in enumerate(meta["ledger_keys"]):
+        arrays[f"ledger_{i}"] = psr.added_signals_time[key]
+    np.savez_compressed(path, meta=json.dumps(meta), **arrays)
+
+
+def load_pulsar_checkpoint(path: str) -> SimulatedPulsar:
+    data = np.load(path, allow_pickle=False)
+    meta = json.loads(str(data["meta"]))
+    mjd = data["mjd_day"].astype(np.longdouble) + data["mjd_frac"].astype(np.longdouble)
+    toas = TOAData(
+        mjd=mjd,
+        errors_s=data["errors_s"],
+        freqs_mhz=data["freqs_mhz"],
+        observatories=list(meta["observatories"]),
+        flags=[dict(f) for f in meta["flags"]],
+        labels=list(meta["labels"]),
+    )
+    par = ParModel()
+    par.lines = list(meta["par_lines"])
+    psr = SimulatedPulsar(
+        ephem=meta["ephem"],
+        par=par,
+        model=SpindownTiming(**meta["model"]),
+        toas=toas,
+        name=meta["name"],
+        loc=meta["loc"],
+        added_signals=meta["added_signals"],
+        added_signals_time={
+            key: data[f"ledger_{i}"] for i, key in enumerate(meta["ledger_keys"])
+        },
+    )
+    psr.update_residuals()
+    return psr
+
+
+def _jsonable(obj):
+    if obj is None:
+        return None
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    if isinstance(obj, (str, int, float, bool)):
+        return obj
+    return repr(obj)  # callables (burst waveforms) recorded by name
+
+
+def save_batch(batch: PulsarBatch, path: str) -> None:
+    """Persist a frozen PulsarBatch (arrays + static metadata) to npz."""
+    arrays = {}
+    static = {}
+    for f in dataclasses.fields(PulsarBatch):
+        val = getattr(batch, f.name)
+        if f.metadata.get("static"):
+            static[f.name] = list(val) if isinstance(val, tuple) else val
+        else:
+            arrays[f.name] = np.asarray(val)
+    np.savez_compressed(path, static=json.dumps(static), **arrays)
+
+
+def load_batch(path: str, dtype=None) -> PulsarBatch:
+    import jax.numpy as jnp
+
+    data = np.load(path, allow_pickle=False)
+    static = json.loads(str(data["static"]))
+    kwargs = {}
+    for f in dataclasses.fields(PulsarBatch):
+        if f.metadata.get("static"):
+            val = static[f.name]
+            kwargs[f.name] = tuple(val) if isinstance(val, list) else val
+        else:
+            arr = data[f.name]
+            if dtype is not None and np.issubdtype(arr.dtype, np.floating):
+                arr = arr.astype(dtype)
+            kwargs[f.name] = jnp.asarray(arr)
+    return PulsarBatch(**kwargs)

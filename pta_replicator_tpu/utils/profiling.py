@@ -1,0 +1,49 @@
+"""Lightweight tracing/profiling hooks.
+
+The reference has none (a commented-out @profile and debug prints,
+SURVEY.md section 5). Device-side profiling delegates to jax.profiler
+(XLA traces viewable in TensorBoard/Perfetto); host-side stages get a
+simple timer registry.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict
+
+_TIMINGS: Dict[str, list] = defaultdict(list)
+
+
+@contextlib.contextmanager
+def stage(name: str):
+    """Time a host-side stage: ``with stage('ingest'): ...``"""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _TIMINGS[name].append(time.perf_counter() - t0)
+
+
+def timings() -> Dict[str, dict]:
+    """Summary of recorded stages: calls, total and mean seconds."""
+    return {
+        k: {"calls": len(v), "total_s": sum(v), "mean_s": sum(v) / len(v)}
+        for k, v in _TIMINGS.items()
+    }
+
+
+def reset() -> None:
+    _TIMINGS.clear()
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str):
+    """Capture an XLA device trace (TensorBoard/Perfetto format)."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

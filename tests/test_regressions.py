@@ -96,3 +96,45 @@ def test_noise_dict_path_and_defaults(tmp_path):
     entry = nd["J0613-0200"]
     assert entry["backends"] == ["430_ASP"]
     assert entry["red_noise_gamma"] is None  # promised key, even if absent
+
+
+def test_flag_tail_negative_values(tmp_path):
+    """Negative numeric flag values are values, not new flag keys."""
+    p = tmp_path / "neg.tim"
+    p.write_text("FORMAT 1\n a 1440.0 53000.0 0.5 AXIS -padd -1.5e-6 -be GUPPI\n")
+    toas = read_tim(str(p))
+    assert toas.flags[0] == {"padd": "-1.5e-6", "be": "GUPPI"}
+
+
+def test_user_spectrum_recipe_injects_gwb():
+    """A Recipe with only a user spectrum (no power-law amplitude) injects."""
+    import jax
+    import jax.numpy as jnp
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models.batched import Recipe, realize
+
+    b = synthetic_batch(npsr=3, ntoa=64, seed=4)
+    spec = np.column_stack([np.logspace(-9.2, -7.4, 12), np.full(12, 1e-14)])
+    recipe = Recipe(
+        gwb_user_spectrum=jnp.asarray(spec),
+        orf_cholesky=jnp.asarray(np.sqrt(2.0) * np.eye(3)),
+        gwb_npts=100,
+        gwb_howml=4.0,
+    )
+    res = realize(jax.random.PRNGKey(0), b, recipe, nreal=4)
+    assert bool(np.all(np.isfinite(np.asarray(res))))
+    assert float(np.std(np.asarray(res))) > 0
+
+
+def test_split_population_drops_zero_weight_outliers():
+    from pta_replicator_tpu.models.population import split_population
+    from pta_replicator_tpu.utils.cosmology import MSOL_G
+
+    n = 10
+    vals = [np.full(n, 1e9 * MSOL_G), np.full(n, 0.5), np.full(n, 0.5),
+            np.full(n, 3e-9 + 1e-12 * np.arange(n))]
+    weights = np.zeros(n)
+    weights[3] = 5.0  # only one physical entry
+    fobs = np.array([1e-9, 1e-8])
+    split = split_population(vals, weights, fobs, 1e8, outlier_per_bin=4)
+    assert split.outlier_fo.size == 1  # zero-weight entries filtered
