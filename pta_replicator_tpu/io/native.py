@@ -44,8 +44,8 @@ def load_library() -> Optional[ctypes.CDLL]:
         _TRIED = True
         if not os.path.isfile(_SRC):
             return None
-        so_path = os.path.join(_build_dir(), "libfastio.so")
         try:
+            so_path = os.path.join(_build_dir(), "libfastio.so")
             if (not os.path.isfile(so_path)
                     or os.path.getmtime(so_path) < os.path.getmtime(_SRC)):
                 subprocess.run(
@@ -100,7 +100,11 @@ def fast_read_tim(path: str):
     mjd = mjd_day.astype(np.longdouble) + mjd_frac.astype(np.longdouble)
     labels, obs, flag_strs = [], [], []
     raw = text.value.decode(errors="replace")
-    for rec in raw.splitlines():
+    # split on the exact record separator fast_tim_parse writes ('\n');
+    # splitlines() would also break on \x0b/\x0c/\x85 inside flag tails
+    for rec in raw.split("\n"):
+        if not rec:
+            continue
         parts = rec.split("\x1f", 2)
         labels.append(parts[0] if len(parts) > 0 else "")
         obs.append(parts[1] if len(parts) > 1 else "")

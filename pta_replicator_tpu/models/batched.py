@@ -23,7 +23,12 @@ import jax.numpy as jnp
 from ..batch import PulsarBatch
 from ..constants import YEAR_IN_SEC
 from .cgw import cw_delay
-from .gwb import characteristic_strain, gwb_grid, residual_psd_coeff
+from .gwb import (
+    characteristic_strain,
+    dft_synthesis_matrices,
+    gwb_grid,
+    residual_psd_coeff,
+)
 
 
 def _per_toa(params, index, mask):
@@ -122,6 +127,23 @@ def red_noise_delays(
     return jnp.einsum("pnk,pk->pn", F, coeff) * batch.mask
 
 
+def uniform_grid_interp(t, start, stop, series):
+    """Linear interpolation of (..., npts) series sampled on a *uniform*
+    grid [start, stop] onto (..., Nt) query times.
+
+    Equivalent to ``jnp.interp`` for in-range queries but with direct index
+    arithmetic instead of a searchsorted binary search (the grid spacing is
+    known), which removes the gather-heavy log(npts) search from the GWB's
+    per-TOA resampling."""
+    npts = series.shape[-1]
+    pos = (t - start) / (stop - start) * (npts - 1)
+    idx = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, npts - 2)
+    frac = jnp.clip(pos - idx, 0.0, 1.0)
+    lo = jnp.take_along_axis(series, idx, axis=-1)
+    hi = jnp.take_along_axis(series, idx + 1, axis=-1)
+    return lo + frac * (hi - lo)
+
+
 def gwb_delays(
     key,
     batch: PulsarBatch,
@@ -135,6 +157,7 @@ def gwb_delays(
     beta: float = 1.0,
     power: float = 1.0,
     user_spectrum=None,
+    synthesis: str = "auto",
 ):
     """Correlated GWB across the array: the one cross-pulsar op.
 
@@ -170,14 +193,28 @@ def gwb_delays(
 
     M = jnp.asarray(orf_cholesky, dtype)
     res_f = jnp.einsum("ab,bf->af", M, w) * jnp.sqrt(C)
-    # zero DC and "Nyquist" bins, then inverse-FFT the hermitian spectrum:
-    # irfft(x, n=2*nf-2) == real(ifft(hermitian_pack(x)))
+    # zero DC and "Nyquist" bins, then synthesize the hermitian spectrum on
+    # the time grid. Only npts+10 of the 2*nf-2 output samples are used, so
+    # when the grid is oversampled (howml > ~1, always in practice) a direct
+    # (Np, nf) x (nf, npts) MXU contraction beats the FFT — whose length
+    # 2*nf-2 is a terrible radix for the default config (5998 = 2 x 2999,
+    # prime => Bluestein). 'fft' is kept for cross-checking.
     mask = jnp.concatenate([jnp.zeros(1, dtype), jnp.ones(nf - 2, dtype), jnp.zeros(1, dtype)])
-    res_t = jnp.fft.irfft(res_f * mask, n=2 * nf - 2, axis=-1) / dt_grid
-    grid_series = res_t[:, 10 : npts + 10].astype(dtype)
+    res_f = res_f * mask
+    if synthesis == "auto":
+        synthesis = "matmul" if npts + 10 < 2 * nf - 2 else "fft"
+    if synthesis == "matmul":
+        cos_m, sin_m = dft_synthesis_matrices(nf, npts)
+        scale = 2.0 / ((2 * nf - 2) * dt_grid)
+        grid_series = (
+            jnp.real(res_f) @ jnp.asarray(cos_m, dtype)
+            - jnp.imag(res_f) @ jnp.asarray(sin_m, dtype)
+        ) * jnp.asarray(scale, dtype)
+    else:
+        res_t = jnp.fft.irfft(res_f, n=2 * nf - 2, axis=-1) / dt_grid
+        grid_series = res_t[:, 10 : npts + 10].astype(dtype)
 
-    interp = jax.vmap(jnp.interp, in_axes=(0, None, 0))
-    return interp(batch.toas_s, ut, grid_series) * batch.mask
+    return uniform_grid_interp(batch.toas_s, ut[0], ut[-1], grid_series) * batch.mask
 
 
 def cgw_catalog_delays(

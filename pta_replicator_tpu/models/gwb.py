@@ -13,6 +13,8 @@ cross-pulsar mix as a single einsum.
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 from ..constants import DAY_IN_SEC
@@ -83,6 +85,33 @@ def residual_psd_coeff(hcf, f, dur: float, howml: float, xp=np):
     """C(f) = hc^2 / (96 pi^2 f^3) * dur * howml — the variance scaling
     turning strain into timing-residual Fourier amplitudes."""
     return 1.0 / (96.0 * xp.pi**2) * hcf**2 / xp.asarray(f) ** 3 * dur * howml
+
+
+@functools.lru_cache(maxsize=8)
+def dft_synthesis_matrices(nf: int, npts: int, drop: int = 10):
+    """(nf, npts) cosine/sine matrices evaluating the hermitian-packed
+    inverse FFT at output samples ``drop .. drop+npts`` only.
+
+    The synthesis FFT length is N = 2*nf-2, which for the reference's
+    default grid (npts=600, howml=10 -> N=5998 = 2 x 2999, prime) forces a
+    Bluestein FFT — while only npts+drop of the N output samples are ever
+    used (reference red_noise.py:275-287 computes the full ifft and slices).
+    Evaluating those samples directly is a dense (Np, nf) x (nf, npts)
+    contraction: fewer FLOPs than Bluestein and it runs on the MXU.
+
+    Because the DC and Nyquist bins are zeroed by the caller,
+
+        x[n] = (2/N) * sum_k [Re X[k] cos(2 pi k n / N)
+                              - Im X[k] sin(2 pi k n / N)]
+
+    The phase is reduced with exact integer arithmetic (k*n mod N) so the
+    trig arguments stay in [0, 2 pi) — f32-safe on device.
+    """
+    N = 2 * nf - 2
+    k = np.arange(nf, dtype=np.int64)[:, None]
+    n = np.arange(drop, drop + npts, dtype=np.int64)[None, :]
+    phase = 2.0 * np.pi * ((k * n) % N) / N
+    return np.cos(phase), np.sin(phase)
 
 
 def gwb_time_series(w, M, C, dt_grid: float, npts: int, xp=np):

@@ -122,6 +122,29 @@ def test_irfft_equals_hermitian_pack_ifft():
     np.testing.assert_allclose(oracle, direct, atol=1e-12)
 
 
+def test_gwb_matmul_synthesis_matches_fft(batch):
+    """The MXU matmul synthesis is the same linear map as the Bluestein
+    irfft it replaces (exact in f64)."""
+    b, psrs = batch
+    orf = assemble_orf(_locs(psrs), lmax=0)
+    M = np.linalg.cholesky(orf)
+    key = jax.random.PRNGKey(11)
+    a = B.gwb_delays(key, b, -14.0, 4.33, M, npts=150, howml=6.0, synthesis="fft")
+    c = B.gwb_delays(key, b, -14.0, 4.33, M, npts=150, howml=6.0, synthesis="matmul")
+    rms = float(jnp.sqrt(jnp.mean(a**2)))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-9 * rms)
+
+
+def test_uniform_grid_interp_matches_np_interp():
+    rng = np.random.default_rng(9)
+    series = rng.normal(size=(4, 50))
+    grid = np.linspace(-3.0, 7.0, 50)
+    t = np.sort(rng.uniform(-3.0, 7.0, size=(4, 200)), axis=1)
+    out = np.asarray(B.uniform_grid_interp(jnp.asarray(t), -3.0, 7.0, jnp.asarray(series)))
+    for i in range(4):
+        np.testing.assert_allclose(out[i], np.interp(t[i], grid, series[i]), atol=1e-12)
+
+
 def test_cgw_catalog_matches_oracle(batch):
     """Deterministic op: device catalog == oracle catalog, exactly."""
     b, psrs = batch

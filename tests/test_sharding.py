@@ -2,7 +2,8 @@
 
 Validates the full TPU scale-out story without TPU hardware: the 2-D
 ('real', 'psr') mesh, sharded realization batches, and that sharding is a
-pure layout choice (bit-identical results to the single-device path).
+pure layout choice (results identical to the single-device path up to
+float reduction order in partitioned contractions).
 """
 import numpy as np
 import jax
@@ -56,8 +57,12 @@ def test_sharded_matches_single_device(small_setup):
     mesh = make_mesh(4, 2)
     out = sharded_realize(key, batch, recipe, nreal=8, mesh=mesh, fit=True)
     assert out.shape == (8, 4, 64)
-    # sharding is layout only: same keys -> same numbers
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-12, atol=1e-20)
+    # sharding is layout only: same keys -> same numbers, up to float
+    # reduction order in the partitioned contractions (GWB synthesis matmul)
+    rms = float(np.sqrt(np.mean(np.asarray(ref) ** 2)))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-9 * rms
+    )
     # output really is distributed over the mesh
     assert len(out.sharding.device_set) == 8
 
