@@ -91,15 +91,19 @@ def main():
             jnp.sum(res**2 * batch.mask, axis=-1) / jnp.sum(batch.mask, axis=-1)
         )
 
-    # warm-up / compile
+    # warm-up / compile. NOTE: sync via host readback of the (chunk, Np)
+    # reduction, not block_until_ready() — on the remote-tunneled TPU
+    # backend block_until_ready returns at dispatch, before execution.
+    # Device execution is FIFO, so reading the last chunk's result back
+    # fences every queued chunk.
     out = run_chunk(jax.random.PRNGKey(0))
-    out.block_until_ready()
+    np.asarray(out)
 
     nrep = 5
     t0 = time.perf_counter()
     for i in range(nrep):
         out = run_chunk(jax.random.PRNGKey(i + 1))
-    out.block_until_ready()
+    np.asarray(out)
     elapsed = time.perf_counter() - t0
 
     rate = nrep * chunk / elapsed

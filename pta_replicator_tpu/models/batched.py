@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from ..batch import PulsarBatch
 from ..constants import YEAR_IN_SEC
-from .cgw import cw_delay
+from .cgw import cw_delay, principal_axes
 from .gwb import (
     characteristic_strain,
     dft_synthesis_matrices,
@@ -233,13 +233,21 @@ def cgw_catalog_delays(
     phase_approx: bool = False,
     tref_s: float = 0.0,
     chunk: int = 512,
+    backend: str = "auto",
 ):
     """Summed response of a CW-source catalog, tiled over sources.
 
     Replaces the reference's numba prange + 1e7-source python chunking
-    (deterministic.py:258-294, 321-440) with a ``lax.scan`` over
-    ``chunk``-sized source tiles: the (chunk x Nt) workspace stays in
-    VMEM-scale memory while the scan accumulates the (Np, Nt) sum.
+    (deterministic.py:258-294, 321-440) with explicit memory tiling of the
+    (Nsrc x Nt) product. Two interchangeable backends:
+
+    * ``"pallas"`` — the TPU kernel in ops.pallas_cw: a (Np, Nt/T, Ns/S)
+      grid holding one (S, T) workspace tile in VMEM per program;
+    * ``"scan"``   — a portable ``lax.scan`` over ``chunk``-sized source
+      tiles (the (chunk x Nt) workspace stays VMEM-scale while the scan
+      accumulates the (Np, Nt) sum).
+
+    ``"auto"`` picks pallas on TPU backends, scan elsewhere.
     Deterministic (no key): source parameters are data.
     """
     dtype = batch.toas_s.dtype
@@ -247,6 +255,29 @@ def cgw_catalog_delays(
     toas_abs = batch.toas_s + jnp.asarray(
         batch.tref_mjd * 86400.0 - tref_s, dtype
     )
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "scan"
+    if backend not in ("pallas", "pallas_interpret", "scan"):
+        raise ValueError(f"unknown CW-catalog backend {backend!r}")
+    if backend in ("pallas", "pallas_interpret"):
+        from ..ops.pallas_cw import cw_catalog_coefficients, cw_catalog_response
+
+        src_c, psr_c = cw_catalog_coefficients(
+            batch.phat, gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc,
+            pdist=pdist, dtype=dtype,
+        )
+        return (
+            cw_catalog_response(
+                toas_abs,
+                src_c,
+                psr_c,
+                psr_term=psr_term,
+                evolve=evolve,
+                phase_approx=phase_approx,
+                interpret=backend == "pallas_interpret",
+            )
+            * batch.mask
+        )
     params = [
         jnp.asarray(x, dtype)
         for x in (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
@@ -286,6 +317,75 @@ def cgw_catalog_delays(
     return total * batch.mask
 
 
+def _batch_antenna(gwtheta, gwphi, phat):
+    """F+, Fx for one source direction against all pulsars: (Np,) each."""
+    m, n, omhat = principal_axes(gwtheta, gwphi, xp=jnp)
+    mp, np_, op = phat @ m, phat @ n, phat @ omhat
+    fplus = 0.5 * (mp**2 - np_**2) / (1.0 + op)
+    fcross = mp * np_ / (1.0 + op)
+    return fplus, fcross
+
+
+def gw_memory_delays(batch: PulsarBatch, strain, gwtheta, gwphi, bwm_pol,
+                     t0_mjd):
+    """Burst-with-memory across the array: polarization-projected strain
+    ramp from epoch t0 (batched analog of bursts.add_gw_memory, reference
+    deterministic.py:822-884 — whose per-TOA Python loop becomes one
+    masked ramp over (Np, Nt))."""
+    dtype = batch.toas_s.dtype
+    fplus, fcross = _batch_antenna(
+        jnp.asarray(gwtheta, dtype), jnp.asarray(gwphi, dtype), batch.phat
+    )
+    pol = jnp.cos(2.0 * jnp.asarray(bwm_pol, dtype)) * fplus + jnp.sin(
+        2.0 * jnp.asarray(bwm_pol, dtype)
+    ) * fcross
+    t0_s = (jnp.asarray(t0_mjd, dtype) - batch.tref_mjd) * 86400.0
+    ramp = jnp.maximum(batch.toas_s - t0_s, 0.0)
+    return jnp.asarray(strain, dtype) * pol[:, None] * ramp * batch.mask
+
+
+def burst_delays(batch: PulsarBatch, gwtheta, gwphi, hplus_grid, hcross_grid,
+                 grid_start_s, grid_stop_s, psi=0.0):
+    """Arbitrary elliptically-polarized burst across the array.
+
+    The reference takes waveform *callables* evaluated per TOA
+    (deterministic.py:718-793) — data-dependent control flow a traced
+    program can't host. Device form: the waveforms arrive pre-sampled on a
+    uniform (G,) grid over [grid_start_s, grid_stop_s] (times relative to
+    the batch epoch, zero outside), and are linearly interpolated onto
+    each pulsar's TOAs. Pair with quadratic_fit_subtract for the
+    reference's remove_quad option.
+    """
+    dtype = batch.toas_s.dtype
+    hp = jnp.asarray(hplus_grid, dtype)
+    hc = jnp.asarray(hcross_grid, dtype)
+    c2, s2 = jnp.cos(2.0 * jnp.asarray(psi, dtype)), jnp.sin(
+        2.0 * jnp.asarray(psi, dtype)
+    )
+    rp, rc = hp * c2 - hc * s2, hp * s2 + hc * c2
+    fplus, fcross = _batch_antenna(
+        jnp.asarray(gwtheta, dtype), jnp.asarray(gwphi, dtype), batch.phat
+    )
+    series = -fplus[:, None] * rp[None, :] - fcross[:, None] * rc[None, :]
+    out = uniform_grid_interp(batch.toas_s, grid_start_s, grid_stop_s, series)
+    inside = (batch.toas_s >= grid_start_s) & (batch.toas_s <= grid_stop_s)
+    return jnp.where(inside, out, 0.0) * batch.mask
+
+
+def transient_delays(batch: PulsarBatch, psr_index: int, waveform_grid,
+                     grid_start_s, grid_stop_s):
+    """Un-projected arbitrary transient in a single pulsar (glitch-like;
+    batched analog of bursts.add_noise_transient, reference
+    deterministic.py:796-819), pre-sampled like burst_delays."""
+    dtype = batch.toas_s.dtype
+    wf = jnp.asarray(waveform_grid, dtype)
+    t = batch.toas_s[psr_index]
+    row = uniform_grid_interp(t, grid_start_s, grid_stop_s, wf)
+    inside = (t >= grid_start_s) & (t <= grid_stop_s)
+    row = jnp.where(inside, row, 0.0) * batch.mask[psr_index]
+    return jnp.zeros(batch.toas_s.shape, dtype).at[psr_index].set(row)
+
+
 # ------------------------------------------------------------------ recipes
 
 @jax.tree_util.register_dataclass
@@ -312,6 +412,19 @@ class Recipe:
     #: (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc); deterministic,
     #: shared by every realization (the population-synthesis outliers)
     cgw_params: Optional[jax.Array] = None
+    #: (5,) burst-with-memory params (strain, gwtheta, gwphi, bwm_pol,
+    #: t0_mjd)
+    gwm_params: Optional[jax.Array] = None
+    #: (3,) burst sky/polarization (gwtheta, gwphi, psi) with the (G,)
+    #: pre-sampled waveforms and (2,) [start_s, stop_s] grid window
+    burst_sky: Optional[jax.Array] = None
+    burst_hplus: Optional[jax.Array] = None
+    burst_hcross: Optional[jax.Array] = None
+    burst_grid: Optional[jax.Array] = None
+    #: (G,) single-pulsar transient waveform on the (2,) grid window,
+    #: injected into pulsar ``transient_psr``
+    transient_waveform: Optional[jax.Array] = None
+    transient_grid: Optional[jax.Array] = None
 
     tnequad: bool = field(metadata=dict(static=True), default=False)
     rn_nmodes: int = field(metadata=dict(static=True), default=30)
@@ -319,6 +432,10 @@ class Recipe:
     gwb_howml: float = field(metadata=dict(static=True), default=10.0)
     cgw_tref_s: float = field(metadata=dict(static=True), default=0.0)
     cgw_chunk: int = field(metadata=dict(static=True), default=512)
+    #: CW-catalog backend: "auto" (pallas on TPU, scan elsewhere),
+    #: "pallas", "pallas_interpret", or "scan"
+    cgw_backend: str = field(metadata=dict(static=True), default="auto")
+    transient_psr: int = field(metadata=dict(static=True), default=0)
 
 
 def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
@@ -382,16 +499,40 @@ def quadratic_fit_subtract(delays, batch: PulsarBatch):
 
 
 def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
-    """Realization-independent delays (the CW outlier catalog): computed
-    once per batch, shared across the whole realization axis."""
-    if recipe.cgw_params is None:
-        return jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
-    return cgw_catalog_delays(
-        batch,
-        *[recipe.cgw_params[i] for i in range(8)],
-        tref_s=recipe.cgw_tref_s,
-        chunk=recipe.cgw_chunk,
-    )
+    """Realization-independent delays (CW outlier catalog, bursts, memory,
+    transients): computed once per batch, shared across the whole
+    realization axis."""
+    total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
+    if recipe.cgw_params is not None:
+        total = total + cgw_catalog_delays(
+            batch,
+            *[recipe.cgw_params[i] for i in range(8)],
+            tref_s=recipe.cgw_tref_s,
+            chunk=recipe.cgw_chunk,
+            backend=recipe.cgw_backend,
+        )
+    if recipe.gwm_params is not None:
+        total = total + gw_memory_delays(batch, *recipe.gwm_params)
+    if recipe.burst_sky is not None:
+        total = total + burst_delays(
+            batch,
+            recipe.burst_sky[0],
+            recipe.burst_sky[1],
+            recipe.burst_hplus,
+            recipe.burst_hcross,
+            recipe.burst_grid[0],
+            recipe.burst_grid[1],
+            psi=recipe.burst_sky[2],
+        )
+    if recipe.transient_waveform is not None:
+        total = total + transient_delays(
+            batch,
+            recipe.transient_psr,
+            recipe.transient_waveform,
+            recipe.transient_grid[0],
+            recipe.transient_grid[1],
+        )
+    return total
 
 
 def realize(key, batch: PulsarBatch, recipe: Recipe, nreal: int, fit: bool = False):

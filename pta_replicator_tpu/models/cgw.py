@@ -25,18 +25,25 @@ from ..simulate import SimulatedPulsar
 
 # ----------------------------------------------------------------- pure math
 
-def antenna_pattern(gwtheta, gwphi, phat, xp=np):
-    """Antenna responses F+, Fx and cos(mu) for source direction(s) against
-    one pulsar direction ``phat`` (3,). Source angles may carry a leading
-    source axis."""
+def principal_axes(gwtheta, gwphi, xp=np):
+    """GW principal axes m, n and propagation direction omhat (each
+    (..., 3)) for source sky position(s) — the single home of the
+    polarization-frame convention every projection site shares."""
     gwtheta = xp.asarray(gwtheta)
     gwphi = xp.asarray(gwphi)
     ct, st = xp.cos(gwtheta), xp.sin(gwtheta)
     cp, sp_ = xp.cos(gwphi), xp.sin(gwphi)
-    # GW principal axes m, n and propagation direction omhat
     m = xp.stack([sp_, -cp, xp.zeros_like(cp)], axis=-1)
     n = xp.stack([-ct * cp, -ct * sp_, st], axis=-1)
     omhat = xp.stack([-st * cp, -st * sp_, -ct], axis=-1)
+    return m, n, omhat
+
+
+def antenna_pattern(gwtheta, gwphi, phat, xp=np):
+    """Antenna responses F+, Fx and cos(mu) for source direction(s) against
+    one pulsar direction ``phat`` (3,). Source angles may carry a leading
+    source axis."""
+    m, n, omhat = principal_axes(gwtheta, gwphi, xp=xp)
 
     mp = m @ phat
     np_ = n @ phat

@@ -174,6 +174,112 @@ def test_cgw_catalog_matches_oracle(batch):
         np.testing.assert_allclose(np.asarray(dev[i]), oracle, rtol=1e-8, atol=1e-15)
 
 
+@pytest.mark.parametrize(
+    "mode",
+    [
+        dict(evolve=True, phase_approx=False),
+        dict(evolve=False, phase_approx=True),
+        dict(evolve=False, phase_approx=False),
+        dict(evolve=True, phase_approx=False, psr_term=False),
+    ],
+)
+def test_cgw_pallas_kernel_matches_scan(batch, mode):
+    """The Pallas kernel (interpret mode on CPU) is the same linear map as
+    the portable scan backend, for every evolution mode."""
+    b, _ = batch
+    n = 300
+    rng = np.random.default_rng(6)
+    cat = dict(
+        gwtheta=np.arccos(rng.uniform(-1, 1, n)),
+        gwphi=rng.uniform(0, 2 * np.pi, n),
+        mc=10 ** rng.uniform(8, 9.8, n),
+        dist=rng.uniform(10, 500, n),
+        fgw=10 ** rng.uniform(-8.8, -7.5, n),
+        phase0=rng.uniform(0, 2 * np.pi, n),
+        psi=rng.uniform(0, np.pi, n),
+        inc=np.arccos(rng.uniform(-1, 1, n)),
+    )
+    tref = 53000 * 86400
+    kw = dict(tref_s=tref, pdist=1.3, **mode)
+    scan = B.cgw_catalog_delays(b, *cat.values(), chunk=64, backend="scan", **kw)
+    pallas = B.cgw_catalog_delays(
+        b, *cat.values(), backend="pallas_interpret", **kw
+    )
+    rms = float(jnp.sqrt(jnp.mean(scan**2)))
+    np.testing.assert_allclose(
+        np.asarray(pallas), np.asarray(scan), atol=1e-9 * rms, rtol=1e-7
+    )
+
+
+def test_cgw_pallas_nan_guard(batch):
+    """Merged binaries (past-merger chirp) inject zeros, not NaNs, in both
+    backends (reference deterministic.py:433-438)."""
+    b, _ = batch
+    cat = dict(
+        gwtheta=np.array([1.0, 2.0]),
+        gwphi=np.array([0.5, 4.0]),
+        mc=np.array([5e9, 1e9]),  # first source merges before the data end
+        dist=np.array([20.0, 100.0]),
+        fgw=np.array([3e-7, 1e-8]),
+        phase0=np.array([0.3, 2.0]),
+        psi=np.array([0.1, 1.1]),
+        inc=np.array([0.7, 2.2]),
+    )
+    scan = B.cgw_catalog_delays(b, *cat.values(), backend="scan")
+    pallas = B.cgw_catalog_delays(b, *cat.values(), backend="pallas_interpret")
+    assert bool(jnp.all(jnp.isfinite(scan)))
+    assert bool(jnp.all(jnp.isfinite(pallas)))
+    np.testing.assert_allclose(np.asarray(pallas), np.asarray(scan), rtol=1e-7)
+
+
+def test_gw_memory_matches_oracle(batch):
+    b, psrs = batch
+    from pta_replicator_tpu.models.bursts import add_gw_memory
+
+    args = dict(strain=5e-15, gwtheta=1.1, gwphi=2.3, bwm_pol=0.7)
+    t0 = float(psrs[0].toas.get_mjds()[40])
+    dev = B.gw_memory_delays(b, args["strain"], args["gwtheta"],
+                             args["gwphi"], args["bwm_pol"], t0)
+    for i, p in enumerate(psrs):
+        add_gw_memory(p, t0_mjd=t0, **args)
+        oracle = p.added_signals_time[f"{p.name}_gw_memory"]
+        # atol floor: earlier tests in this module injected signals into
+        # the shared pulsars, shifting their TOAs at the microsecond
+        # level relative to the frozen batch (strain * 1e-6 s ~ 5e-21)
+        np.testing.assert_allclose(np.asarray(dev[i]), oracle, rtol=1e-9,
+                                   atol=1e-19)
+
+
+def test_burst_and_transient_match_oracle(batch):
+    b, psrs = batch
+    from pta_replicator_tpu.models.bursts import add_burst, add_noise_transient
+
+    t0 = float(np.asarray(b.toas_s).mean())
+    width = 100 * 86400.0
+    hp = lambda t: 4e-9 * np.exp(-0.5 * ((t - t0) / width) ** 2)
+    hc = lambda t: 2e-9 * np.sin((t - t0) / width) * np.exp(
+        -0.5 * ((t - t0) / width) ** 2
+    )
+    lo, hi = t0 - 8 * width, t0 + 8 * width
+    grid = np.linspace(lo, hi, 16384)
+    dev = B.burst_delays(b, 0.9, 4.1, hp(grid), hc(grid), lo, hi, psi=0.6)
+    tref = float(b.tref_mjd) * 86400.0
+    for i, p in enumerate(psrs):
+        add_burst(p, 0.9, 4.1, hp, hc, psi=0.6, tref=tref)
+        oracle = p.added_signals_time[f"{p.name}_burst"]
+        rms = max(np.sqrt(np.mean(oracle**2)), 1e-30)
+        np.testing.assert_allclose(np.asarray(dev[i]), oracle,
+                                   atol=1e-5 * rms)
+
+    devt = B.transient_delays(b, 1, hp(grid), lo, hi)
+    assert np.allclose(np.asarray(devt[0]), 0.0)
+    add_noise_transient(psrs[1], hp, tref=tref)
+    oracle = psrs[1].added_signals_time[f"{psrs[1].name}_noise_transient"]
+    np.testing.assert_allclose(
+        np.asarray(devt[1]), oracle, atol=1e-5 * np.sqrt(np.mean(oracle**2))
+    )
+
+
 def test_recipe_realize_shapes(batch):
     b, psrs = batch
     orf = assemble_orf(_locs(psrs), lmax=0)
