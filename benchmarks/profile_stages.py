@@ -1,0 +1,92 @@
+"""Per-stage device timings for the NG15-scale benchmark workload.
+
+Times each injection op (and the end-to-end chunk) separately on the
+current backend, syncing by host readback of a small reduction (on the
+tunneled TPU backend ``block_until_ready`` returns at dispatch — see
+bench.py). Prints one JSON line per stage to stdout.
+
+Usage:  python benchmarks/profile_stages.py [--nreal 20] [--small]
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nreal", type=int, default=20)
+    ap.add_argument("--small", action="store_true",
+                    help="3x122 toy shapes instead of NG15 scale")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.ops.orf import hellings_downs_matrix
+
+    if args.small:
+        npsr, ntoa, nbackend, ncw = 3, 122, 2, 16
+        npts, howml = 120, 4.0
+    else:
+        npsr, ntoa, nbackend, ncw = 68, 7758, 4, 100
+        npts, howml = 600, 10.0
+
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=nbackend, seed=0)
+    rng = np.random.default_rng(0)
+    phat = np.asarray(batch.phat, dtype=np.float64)
+    locs = np.stack(
+        [np.arctan2(phat[:, 1], phat[:, 0]),
+         np.arccos(np.clip(phat[:, 2], -1, 1))], axis=1,
+    )
+    M = jnp.asarray(np.linalg.cholesky(hellings_downs_matrix(locs)))
+    cat = jnp.asarray(np.stack([
+        np.arccos(rng.uniform(-1, 1, ncw)), rng.uniform(0, 2 * np.pi, ncw),
+        10 ** rng.uniform(8, 9.5, ncw), rng.uniform(50, 1000, ncw),
+        10 ** rng.uniform(-8.8, -7.6, ncw), rng.uniform(0, 2 * np.pi, ncw),
+        rng.uniform(0, np.pi, ncw), np.arccos(rng.uniform(-1, 1, ncw)),
+    ]))
+
+    R = args.nreal
+    keys = jax.random.split(jax.random.PRNGKey(0), R)
+
+    def vm(f):
+        return jax.jit(lambda ks: jax.vmap(f)(ks))
+
+    stages = {
+        "white_noise": vm(lambda k: B.white_noise_delays(
+            k, batch, efac=1.1, log10_equad=-6.5)),
+        "jitter": vm(lambda k: B.jitter_delays(k, batch, -6.5)),
+        "red_noise": vm(lambda k: B.red_noise_delays(k, batch, -14.0, 4.33)),
+        "gwb": vm(lambda k: B.gwb_delays(
+            k, batch, -14.0, 4.33, M, npts=npts, howml=howml)),
+        "quad_fit": vm(lambda k: B.quadratic_fit_subtract(
+            jax.random.normal(k, batch.toas_s.shape, batch.toas_s.dtype),
+            batch)),
+        "cgw_catalog(once)": jax.jit(lambda ks: B.cgw_catalog_delays(
+            batch, *[cat[i] for i in range(8)], chunk=ncw)
+            + 0.0 * ks[0, 0].astype(batch.toas_s.dtype)),
+    }
+
+    def run(f):
+        t0 = time.perf_counter()
+        out = f(keys)
+        float(jnp.sum(jnp.abs(out)))  # readback fence
+        return time.perf_counter() - t0
+
+    for name, f in stages.items():
+        t_compile = run(f)
+        t_run = min(run(f) for _ in range(3))
+        print(json.dumps({
+            "stage": name,
+            "compile_plus_run_s": round(t_compile, 3),
+            "run_s": round(t_run, 4),
+            "per_realization_ms": round(1e3 * t_run / R, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Population-derived PTA dataset: loudest SMBHBs as resolvable CWs,
+the rest as a free-spectrum GWB, realized at scale on a device mesh.
+
+Script analog of the reference's `add_gwb_plus_outlier_cws` workflow
+(/root/reference/pta_replicator/deterministic.py:565-715, Becsy, Cornish
+& Kelley 2022): a synthetic SMBHB population stands in for the
+holodeck-generated one (same `vals`/`weights` interface), is split into
+per-frequency-bin loudest binaries + a residual spectrum, then
+
+  Part A injects it through the mutate-and-ledger oracle path, and
+  Part B freezes the array and realizes N independent datasets of the
+         same population on a ('real', 'psr') jax.sharding.Mesh.
+
+Run:  python examples/population_dataset.py            # real backend
+      JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+          python examples/population_dataset.py        # 8 virtual chips
+"""
+import numpy as np
+
+import pta_replicator_tpu as ptr
+from pta_replicator_tpu.models.population import (
+    add_gwb_plus_outlier_cws,
+    population_recipe,
+    split_population,
+)
+
+PAR_DIR = "/root/reference/test_partim_small/par"
+TIM_DIR = "/root/reference/test_partim_small/tim"
+
+
+def synthetic_population(n=40_000, seed=0):
+    """A toy SMBHB population in the reference's `vals`/`weights` layout:
+    [Mtot_g, Mrat, redz, Fobs_gw_hz] per binary + represented counts."""
+    rng = np.random.default_rng(seed)
+    msol = 1.988409871e33
+    mtot = 10 ** rng.uniform(8.0, 10.0, n) * msol
+    mrat = 10 ** rng.uniform(-1.5, 0.0, n)
+    redz = rng.uniform(0.05, 1.5, n)
+    # population dN/dln f ~ f^{-8/3}: draw via inverse CDF on [1/T, 3e-8]
+    u = rng.uniform(size=n)
+    flo, fhi = 2e-9, 3e-8
+    fo = (flo ** (-5 / 3) + u * (fhi ** (-5 / 3) - flo ** (-5 / 3))) ** (-3 / 5)
+    weights = rng.poisson(2.0, n).astype(float)
+    return [mtot, mrat, redz, fo], weights
+
+
+def main():
+    psrs = ptr.load_from_directories(PAR_DIR, TIM_DIR, num_psrs=3)
+    for p in psrs:
+        ptr.make_ideal(p)
+
+    T_obs = (psrs[0].toas.last_mjd - psrs[0].toas.first_mjd) * 86400.0
+    fobs = np.arange(1, 25) / T_obs  # bin edges up to the 24th harmonic
+    vals, weights = synthetic_population()
+
+    split = split_population(vals, weights, fobs, T_obs, outlier_per_bin=5)
+    print(
+        f"population split: {split.outlier_fo.size} outlier CWs, "
+        f"free-spectrum GWB over {split.f_centers.size} bins "
+        f"(hc[0]={split.user_spectrum[0, 1]:.2e})"
+    )
+
+    # ---- Part A: oracle path (mutates the pulsars, fills the ledger)
+    add_gwb_plus_outlier_cws(
+        psrs, vals, weights, fobs, T_obs, outlier_per_bin=5, seed=7
+    )
+    for p in psrs:
+        rms = 1e6 * float(np.sqrt(np.mean(p.residuals.resids_value ** 2)))
+        print(f"  {p.name}: residual RMS {rms:8.3f} us, "
+              f"ledger = {list(p.added_signals_time)}")
+
+    # ---- Part B: device path — same population, N realizations, sharded
+    import jax
+
+    from pta_replicator_tpu.batch import freeze
+    from pta_replicator_tpu.ops.coords import pulsar_ra_dec
+    from pta_replicator_tpu.ops.orf import assemble_orf
+    from pta_replicator_tpu.parallel import make_mesh, sharded_realize
+
+    batch = freeze(psrs)
+    locs = np.array(
+        [pulsar_ra_dec(p.loc, p.name) for p in psrs], dtype=np.float64
+    )
+    locs[:, 1] = np.pi / 2 - locs[:, 1]  # dec -> polar angle
+    orf = assemble_orf(locs, lmax=0)  # Hellings-Downs
+    recipe = population_recipe(
+        vals, weights, fobs, T_obs,
+        orf_cholesky=np.linalg.cholesky(orf),
+        outlier_per_bin=5, seed=7, gwb_npts=200, howml=4.0,
+    )
+
+    mesh = make_mesh(n_real=len(jax.devices()), n_psr=1)
+    nreal = 8 * mesh.shape["real"]
+    res = sharded_realize(
+        jax.random.PRNGKey(0), batch, recipe, nreal=nreal, mesh=mesh
+    )
+    res = np.asarray(res)
+    print(
+        f"device path: {nreal} realizations on mesh {dict(mesh.shape)} -> "
+        f"residuals {res.shape}, per-realization RMS "
+        f"{1e6 * np.sqrt((res**2).mean()):.3f} us"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,3 +1,4 @@
+from . import distributed
 from .mesh import make_mesh, sharded_realize, shard_batch
 
-__all__ = ["make_mesh", "sharded_realize", "shard_batch"]
+__all__ = ["distributed", "make_mesh", "sharded_realize", "shard_batch"]
