@@ -91,7 +91,9 @@ def fast_read_tim(path: str):
     mjd_frac = np.empty(n, dtype=np.float64)
     err_us = np.empty(n, dtype=np.float64)
     freq = np.empty(n, dtype=np.float64)
-    text_cap = max(4096, 256 * int(n))
+    # the stored text (label\x1fobs\x1fflags\n per TOA) is bounded by the
+    # file itself plus the per-record separators
+    text_cap = max(4096, os.path.getsize(path) + 4 * int(n))
     text = ctypes.create_string_buffer(text_cap)
     got = lib.fast_tim_parse(path.encode(), n, mjd_day, mjd_frac, err_us,
                              freq, text, text_cap)
