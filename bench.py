@@ -13,9 +13,14 @@ is single-chip-rate / 16.67: a value >= 1 means ONE chip beats the target
 set for eight (the realization axis is embarrassingly parallel, so 8 chips
 scale this ~8x further; tests/test_sharding.py validates that path).
 
-Prints exactly one JSON line.
+Prints exactly one JSON line (stdout). Tuning knobs via env:
+BENCH_CHUNK (realizations per jitted call, default 100), BENCH_NREP
+(timed repetitions, default 5), BENCH_PRNG ('threefry' default; 'rbg'
+uses the hardware RngBitGenerator for the per-realization draws —
+faster on TPU, still threefry-quality key splits).
 """
 import json
+import os
 import time
 
 import numpy as np
@@ -23,6 +28,12 @@ import numpy as np
 
 def main():
     import jax
+
+    prng = os.environ.get("BENCH_PRNG", "threefry")
+    if prng not in ("threefry", "rbg"):
+        raise SystemExit(f"BENCH_PRNG must be 'threefry' or 'rbg', got {prng!r}")
+    if prng == "rbg":
+        jax.config.update("jax_default_prng_impl", "rbg")
     import jax.numpy as jnp
 
     from pta_replicator_tpu.batch import synthetic_batch
@@ -72,7 +83,7 @@ def main():
         cgw_chunk=100,
     )
 
-    chunk = 100  # realizations per jitted call
+    chunk = int(os.environ.get("BENCH_CHUNK", "100"))  # realizations/call
 
     @jax.jit
     def run_chunk(key):
@@ -99,7 +110,7 @@ def main():
     out = run_chunk(jax.random.PRNGKey(0))
     np.asarray(out)
 
-    nrep = 5
+    nrep = int(os.environ.get("BENCH_NREP", "5"))
     t0 = time.perf_counter()
     for i in range(nrep):
         out = run_chunk(jax.random.PRNGKey(i + 1))

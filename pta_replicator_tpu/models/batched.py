@@ -96,6 +96,7 @@ def red_noise_delays(
     log10_amplitude,
     gamma,
     nmodes: int = 30,
+    modes=None,
 ):
     """Per-pulsar power-law red noise on the rank-reduced Fourier basis.
 
@@ -108,8 +109,16 @@ def red_noise_delays(
     dtype = batch.toas_s.dtype
     log10_amplitude = jnp.broadcast_to(jnp.asarray(log10_amplitude, dtype), (batch.npsr,))
     gamma = jnp.broadcast_to(jnp.asarray(gamma, dtype), (batch.npsr,))
-    k = jnp.arange(1, nmodes + 1, dtype=dtype)
-    freqs = k[None, :] / batch.tspan_s[:, None]  # (Np, K)
+    if modes is not None:
+        # explicit mode frequencies [Hz], shared across the array
+        # (oracle analog red_noise.add_red_noise(modes=...),
+        # reference red_noise.py:71-74)
+        freqs = jnp.broadcast_to(
+            jnp.asarray(modes, dtype)[None, :], (batch.npsr, len(modes))
+        )
+    else:
+        k = jnp.arange(1, nmodes + 1, dtype=dtype)
+        freqs = k[None, :] / batch.tspan_s[:, None]  # (Np, K)
     arg = 2.0 * jnp.pi * freqs[:, None, :] * batch.toas_s[:, :, None]
     F = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=-1)  # (Np, Nt, 2K)
 
@@ -402,6 +411,8 @@ class Recipe:
     log10_ecorr: Optional[jax.Array] = None
     rn_log10_amplitude: Optional[jax.Array] = None
     rn_gamma: Optional[jax.Array] = None
+    #: explicit red-noise mode frequencies [Hz] (overrides rn_nmodes)
+    rn_modes: Optional[jax.Array] = None
     gwb_log10_amplitude: Optional[jax.Array] = None
     gwb_gamma: Optional[jax.Array] = None
     orf_cholesky: Optional[jax.Array] = None
@@ -459,6 +470,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             recipe.rn_log10_amplitude,
             recipe.rn_gamma,
             nmodes=recipe.rn_nmodes,
+            modes=recipe.rn_modes,
         )
     if recipe.gwb_log10_amplitude is not None or recipe.gwb_user_spectrum is not None:
         total = total + gwb_delays(

@@ -1,4 +1,10 @@
 from . import distributed
-from .mesh import make_mesh, sharded_realize, shard_batch
+from .mesh import make_mesh, shard_batch, sharded_realize, shardmap_realize
 
-__all__ = ["distributed", "make_mesh", "sharded_realize", "shard_batch"]
+__all__ = [
+    "distributed",
+    "make_mesh",
+    "shard_batch",
+    "sharded_realize",
+    "shardmap_realize",
+]

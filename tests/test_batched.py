@@ -232,6 +232,26 @@ def test_cgw_pallas_nan_guard(batch):
     np.testing.assert_allclose(np.asarray(pallas), np.asarray(scan), rtol=1e-7)
 
 
+def test_red_noise_explicit_modes_device(batch):
+    """Explicit mode frequencies drive the device basis (variance equals
+    the summed prior at those frequencies)."""
+    b, _ = batch
+    from pta_replicator_tpu.constants import YEAR_IN_SEC
+
+    modes = np.linspace(2e-9, 2e-8, 10)
+    keys = jax.random.split(jax.random.PRNGKey(8), 4000)
+    d = jax.vmap(
+        lambda k: B.red_noise_delays(k, b, -14.0, 4.33, modes=modes)
+    )(keys)
+    var = np.asarray(d).var(axis=0).mean(axis=1)
+    T = np.asarray(b.tspan_s)
+    prior = (
+        1e-28 * (modes[None, :] * YEAR_IN_SEC) ** (-4.33)
+        / (12 * np.pi**2 * T[:, None]) * YEAR_IN_SEC**3
+    )
+    np.testing.assert_allclose(var, prior.sum(axis=1), rtol=0.1)
+
+
 def test_gw_memory_matches_oracle(batch):
     b, psrs = batch
     from pta_replicator_tpu.models.bursts import add_gw_memory

@@ -126,6 +126,55 @@ def test_user_spectrum_recipe_injects_gwb():
     assert float(np.std(np.asarray(res))) > 0
 
 
+def test_measurement_noise_flag_validation():
+    from pta_replicator_tpu import add_measurement_noise
+
+    psr = load_pulsar(PAR, TIM)
+    make_ideal(psr)
+    with pytest.raises(ValueError, match="must be scalars"):
+        add_measurement_noise(psr, efac=[1.0, 1.1])
+    with pytest.raises(ValueError, match="same length"):
+        add_measurement_noise(psr, efac=[1.0, 1.1, 1.2], flags=["A", "B"])
+
+
+def test_equad_convention_variances():
+    """t2equad (default): EFAC scales (sigma and) EQUAD; tnequad: EQUAD
+    adds unscaled (reference white_noise.py:64-76)."""
+    from pta_replicator_tpu.models.white_noise import measurement_noise_delay
+
+    rng = np.random.default_rng(0)
+    n = 200_000
+    err = np.full(n, 1e-7)
+    ef, eq = np.full(n, 2.0), np.full(n, 3e-7)
+    e1, e2 = rng.standard_normal(n), rng.standard_normal(n)
+    t2 = measurement_noise_delay(err, ef, eq, e1, e2, tnequad=False)
+    tn = measurement_noise_delay(err, ef, eq, e1, e2, tnequad=True)
+    assert np.var(t2) == pytest.approx(4 * (1e-14 + 9e-14), rel=0.02)
+    assert np.var(tn) == pytest.approx(4e-14 + 9e-14, rel=0.02)
+
+
+def test_gwb_turnover_and_no_correlations():
+    """Turnover suppresses hc below f0; no_correlations skips the ORF mix
+    (reference red_noise.py:200-201, 246-252)."""
+    from pta_replicator_tpu.models.gwb import characteristic_strain
+    from pta_replicator_tpu import add_gwb
+
+    f = np.logspace(-9.5, -7.5, 50)
+    plain = characteristic_strain(f, -14.0, 13.0 / 3.0)
+    turn = characteristic_strain(f, -14.0, 13.0 / 3.0, turnover=True,
+                                 f0=1e-8, beta=1.0, power=2.0)
+    lo, hi = f < 3e-9, f > 3e-8
+    assert np.all(turn[lo] < 0.5 * plain[lo])   # suppressed below f0
+    np.testing.assert_allclose(turn[hi], plain[hi], rtol=0.3)
+
+    psrs = [load_pulsar(PAR, TIM)]
+    make_ideal(psrs[0])
+    add_gwb(psrs, -14.0, 4.33, no_correlations=True, seed=11, npts=100,
+            howml=4)
+    dt = psrs[0].added_signals_time[f"{psrs[0].name}_gwb"]
+    assert dt.shape == (psrs[0].toas.ntoas,) and np.std(dt) > 0
+
+
 def test_split_population_drops_zero_weight_outliers():
     from pta_replicator_tpu.models.population import split_population
     from pta_replicator_tpu.utils.cosmology import MSOL_G

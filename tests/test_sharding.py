@@ -82,6 +82,24 @@ def test_nreal_divisibility_error(small_setup):
         sharded_realize(jax.random.PRNGKey(0), batch, recipe, nreal=6, mesh=mesh)
 
 
+def test_shardmap_matches_constraint_path(small_setup):
+    """The explicit-SPMD shard_map engine produces the same realizations
+    as the sharding-constraint engine on a realization-only mesh."""
+    from pta_replicator_tpu.parallel import shardmap_realize
+
+    batch, recipe = small_setup
+    key = jax.random.PRNGKey(9)
+    mesh = make_mesh(8, 1)
+    a = sharded_realize(key, batch, recipe, nreal=16, mesh=mesh, fit=True)
+    b = shardmap_realize(key, batch, recipe, nreal=16, mesh=mesh, fit=True)
+    rms = float(np.sqrt(np.mean(np.asarray(a) ** 2)))
+    np.testing.assert_allclose(
+        np.asarray(b), np.asarray(a), rtol=1e-9, atol=1e-9 * rms
+    )
+    with pytest.raises(ValueError, match="n_psr=1"):
+        shardmap_realize(key, batch, recipe, nreal=16, mesh=make_mesh(4, 2))
+
+
 def test_distributed_helpers(small_setup):
     """Single-process topology, per-host key folding, and local-shard
     materialization of a globally-sharded realization array."""
