@@ -419,6 +419,11 @@ class Recipe:
     #: (F, 2) [freq_hz, hc] user characteristic-strain spectrum; overrides
     #: the power-law when present (population free-spec injection)
     gwb_user_spectrum: Optional[jax.Array] = None
+    #: turnover-spectrum shape parameters (used when gwb_turnover is set;
+    #: reference red_noise.py:246-252). Defaults mirror gwb_delays'.
+    gwb_f0: float = 1e-9
+    gwb_beta: float = 1.0
+    gwb_power: float = 1.0
     #: (8, Ns) stacked CW-catalog params in the order
     #: (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc); deterministic,
     #: shared by every realization (the population-synthesis outliers)
@@ -438,6 +443,7 @@ class Recipe:
     transient_grid: Optional[jax.Array] = None
 
     tnequad: bool = field(metadata=dict(static=True), default=False)
+    gwb_turnover: bool = field(metadata=dict(static=True), default=False)
     rn_nmodes: int = field(metadata=dict(static=True), default=30)
     gwb_npts: int = field(metadata=dict(static=True), default=600)
     gwb_howml: float = field(metadata=dict(static=True), default=10.0)
@@ -482,6 +488,10 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             npts=recipe.gwb_npts,
             howml=recipe.gwb_howml,
             user_spectrum=recipe.gwb_user_spectrum,
+            turnover=recipe.gwb_turnover,
+            f0=recipe.gwb_f0,
+            beta=recipe.gwb_beta,
+            power=recipe.gwb_power,
         )
     return total
 

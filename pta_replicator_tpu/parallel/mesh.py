@@ -17,6 +17,7 @@ code runs single-chip, v5e-8, or multi-host without change.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence
 
 import numpy as np
@@ -93,14 +94,7 @@ def sharded_realize(
     keys = jax.random.split(key, nreal)
     keys = jax.device_put(keys, NamedSharding(mesh, P("real")))
     batch = shard_batch(batch, mesh)
-    out_spec = NamedSharding(mesh, P("real", "psr", None))
-
-    @jax.jit
-    def run(keys, batch, recipe):
-        out = _realize_block(keys, batch, recipe, fit)
-        return jax.lax.with_sharding_constraint(out, out_spec)
-
-    return run(keys, batch, recipe)
+    return _constraint_engine(mesh, fit)(keys, batch, recipe)
 
 
 def _realize_block(keys, batch: PulsarBatch, recipe: Recipe, fit: bool):
@@ -113,6 +107,43 @@ def _realize_block(keys, batch: PulsarBatch, recipe: Recipe, fit: bool):
         return residualize(d, batch)
 
     return jax.vmap(one)(keys)
+
+
+@functools.lru_cache(maxsize=64)
+def _constraint_engine(mesh: Mesh, fit: bool):
+    """Jitted constraint-based engine, cached per (mesh, fit) so repeated
+    sweep calls hit jax's compile cache instead of retracing a fresh
+    closure every invocation."""
+    out_spec = NamedSharding(mesh, P("real", "psr", None))
+
+    @jax.jit
+    def run(keys, batch, recipe):
+        out = _realize_block(keys, batch, recipe, fit)
+        return jax.lax.with_sharding_constraint(out, out_spec)
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _shardmap_engine(mesh: Mesh, fit: bool):
+    """Jitted shard_map engine, cached per (mesh, fit). P() acts as a
+    prefix spec: the whole batch/recipe trees replicate."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    def local(keys_shard, batch, recipe):
+        return _realize_block(keys_shard, batch, recipe, fit)
+
+    return jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P("real"), P(), P()),
+            out_specs=P("real"),
+        )
+    )
 
 
 def shardmap_realize(
@@ -131,11 +162,6 @@ def shardmap_realize(
     scaling-book style). Results are identical to the constraint-based
     path for any mesh with an unsharded pulsar axis.
     """
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-
     if mesh is None:
         mesh = make_mesh()
     n_real_axis = mesh.shape["real"]
@@ -148,15 +174,4 @@ def shardmap_realize(
         )
 
     keys = jax.random.split(key, nreal)
-    replicated = jax.tree_util.tree_map(lambda _: P(), (batch, recipe))
-
-    def local(keys_shard, batch, recipe):
-        return _realize_block(keys_shard, batch, recipe, fit)
-
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P("real"), *replicated),
-        out_specs=P("real"),
-    )
-    return jax.jit(fn)(keys, batch, recipe)
+    return _shardmap_engine(mesh, fit)(keys, batch, recipe)

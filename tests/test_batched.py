@@ -52,6 +52,37 @@ def test_white_noise_variance(batch):
     np.testing.assert_allclose(var, expect, rtol=0.15)
 
 
+def test_white_noise_per_backend_gather():
+    """Distinct per-backend EFACs land on the right TOAs through the
+    freeze-built integer gather tables (device analog of the reference's
+    string-flag loops, white_noise.py:95-103)."""
+    from types import SimpleNamespace
+
+    from pta_replicator_tpu.io.tim import fabricate_toas
+
+    psrs = []
+    for i in range(2):
+        toas = fabricate_toas(np.linspace(53000, 55000, 80), 0.5)
+        for j in range(toas.ntoas):  # alternate two backends
+            toas.flags[j] = {"f": "RCVR_A" if j % 2 == 0 else "RCVR_B"}
+        psrs.append(SimpleNamespace(
+            toas=toas, loc={"RAJ": 1.0 + i, "DECJ": 10.0 * i}, name=f"T{i}"
+        ))
+    from pta_replicator_tpu.batch import freeze
+
+    b = freeze(psrs, flagid="f")
+    assert b.backend_names == ("RCVR_A", "RCVR_B")
+    efac = jnp.asarray([[1.0, 4.0], [2.0, 8.0]])  # (Np, NB)
+    keys = jax.random.split(jax.random.PRNGKey(10), 3000)
+    d = jax.vmap(lambda k: B.white_noise_delays(k, b, efac=efac))(keys)
+    std = np.asarray(d).std(axis=0) / np.asarray(b.errors_s)
+    idx = np.asarray(b.backend_index)
+    for p in range(2):
+        for bk in range(2):
+            got = std[p][idx[p] == bk].mean()
+            np.testing.assert_allclose(got, float(efac[p, bk]), rtol=0.05)
+
+
 def test_jitter_epoch_structure(batch):
     b, _ = batch
     d = B.jitter_delays(jax.random.PRNGKey(1), b, log10_ecorr=np.log10(3e-7))
@@ -319,6 +350,35 @@ def test_recipe_realize_shapes(batch):
     w = np.asarray(b.mask / b.errors_s**2)
     means = np.einsum("rpn,pn->rp", np.asarray(res), w) / w.sum(axis=1)
     assert np.abs(means).max() < 1e-18
+
+
+def test_recipe_gwb_turnover(batch):
+    """Turnover recipe suppresses low-frequency GWB power relative to the
+    plain power law (same keys, same draws)."""
+    b, psrs = batch
+    orf = assemble_orf(_locs(psrs), lmax=0)
+    M = jnp.asarray(np.linalg.cholesky(orf))
+    base = dict(
+        gwb_log10_amplitude=jnp.asarray(-14.0),
+        gwb_gamma=jnp.asarray(4.33),
+        orf_cholesky=M,
+        gwb_npts=150,
+        gwb_howml=4.0,
+    )
+    keys = jax.random.split(jax.random.PRNGKey(13), 60)
+    plain = jax.vmap(lambda k: B.realization_delays(k, b, B.Recipe(**base)))(keys)
+    turn = jax.vmap(
+        lambda k: B.realization_delays(
+            k, b, B.Recipe(
+                gwb_turnover=True,
+                gwb_f0=jnp.asarray(2e-8),
+                gwb_power=jnp.asarray(2.0),
+                **base,
+            )
+        )
+    )(keys)
+    # the turnover removes most low-frequency (dominant) power
+    assert float(jnp.mean(turn**2)) < 0.5 * float(jnp.mean(plain**2))
 
 
 def test_fit_subtract_removes_quadratic(batch):
