@@ -233,7 +233,7 @@ def write_tim(toas: TOAData, path: str, name: Optional[str] = None) -> None:
             )
             fh.write(
                 f" {label} {toas.freqs_mhz[i]:.8f} {mjd_str} "
-                f"{toas.errors_s[i]*1e6:.5f} {toas.observatories[i]}{flag_str}\n"
+                f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}\n"
             )
 
 

@@ -479,12 +479,18 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             modes=recipe.rn_modes,
         )
     if recipe.gwb_log10_amplitude is not None or recipe.gwb_user_spectrum is not None:
+        if recipe.orf_cholesky is None:
+            # uncorrelated common process: ORF = 2*I (the reference's
+            # no_correlations mode, red_noise.py:200-201)
+            orf_chol = jnp.sqrt(2.0) * jnp.eye(batch.npsr, dtype=batch.toas_s.dtype)
+        else:
+            orf_chol = recipe.orf_cholesky
         total = total + gwb_delays(
             k_gwb,
             batch,
             recipe.gwb_log10_amplitude,
             recipe.gwb_gamma,
-            recipe.orf_cholesky,
+            orf_chol,
             npts=recipe.gwb_npts,
             howml=recipe.gwb_howml,
             user_spectrum=recipe.gwb_user_spectrum,

@@ -77,6 +77,49 @@ def test_native_parser_matches_python_on_nanograv_tim():
     np.testing.assert_array_equal(a.observatories, b.observatories)
 
 
+def test_tim_roundtrip_randomized(tmp_path):
+    """Randomized write->read round trip: longdouble epochs to sub-ns,
+    errors, freqs, observatories, and flag tails survive exactly."""
+    rng = np.random.default_rng(12)
+    for trial in range(5):
+        n = int(rng.integers(1, 40))
+        toas = fabricate_toas(
+            np.sort(53000 + rng.uniform(0, 5000, n)),
+            0.1 + rng.uniform(0, 3),
+            freq_mhz=float(rng.choice([430.0, 820.0, 1440.0])),
+        )
+        # per-TOA jittered epochs at sub-us scale + odd flags
+        toas.adjust_seconds(rng.uniform(-1e-3, 1e-3, n))
+        for j in range(n):
+            toas.flags[j] = {
+                "fe": f"R{j % 3}", "pta": "NG", "ver": f"v{trial}.{j}",
+                "padd": f"{rng.uniform(-1e-6, 1e-6):.3e}",
+            }
+        p = tmp_path / f"t{trial}.tim"
+        write_tim(toas, str(p))
+        back = read_tim(str(p))
+        assert back.ntoas == n
+        assert np.max(np.abs((back.mjd - toas.mjd).astype(float))) * 86400 < 1e-9
+        # errors serialize at 10 significant digits (micro-second field)
+        np.testing.assert_allclose(back.errors_s, toas.errors_s, rtol=1e-9)
+        np.testing.assert_array_equal(back.freqs_mhz, toas.freqs_mhz)
+        assert back.flags == toas.flags
+
+
+def test_par_set_param_precision_roundtrip(tmp_path, partim_small):
+    """set_param/write/read preserves F0 at full double precision."""
+    from pta_replicator_tpu.io import read_par
+
+    pardir, _ = partim_small
+    par = read_par(pardir + "/JPSR00.par")
+    new_f0 = 205.530696088273125 + 1.23456789e-13
+    par.set_param("F0", new_f0)
+    p = tmp_path / "o.par"
+    par.write(str(p))
+    back = read_par(str(p))
+    assert back.f0 == new_f0
+
+
 def test_fabricate_toas():
     toas = fabricate_toas([53000, 53030], 1.5, freq_mhz=1400.0, flags={"pta": "X"})
     assert toas.ntoas == 2
