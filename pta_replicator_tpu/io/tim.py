@@ -151,7 +151,7 @@ def _parse_tim_into(path: str, st: _TimParserState, depth: int = 0) -> None:
             err_us = np.hypot(st.efac * err_us, st.equad_us)
             st.errs.append(err_us * 1e-6)  # us -> s
             st.obs.append(tokens[4])
-            st.flags.append(_parse_flag_tail(" ".join(tokens[5:])))
+            st.flags.append(_parse_flag_tail(tokens[5:]))
 
 
 def _is_number(tok: str) -> bool:
@@ -162,18 +162,31 @@ def _is_number(tok: str) -> bool:
         return False
 
 
-def _parse_flag_tail(text: str) -> dict:
-    """'-key value ...' pairs; '-1.5e-6'-style negative numbers are values,
-    not keys (shared by the Python and native parse paths)."""
+def _is_flag_key(tok: str) -> bool:
+    """'-fe' is a flag key; '-1.5e-6'-style negative numbers are values.
+    The char-class prefilter keeps the exception-driven float() probe off
+    the hot path (keys start with letters in practice)."""
+    if len(tok) < 2 or tok[0] != "-":
+        return False
+    c = tok[1]
+    # only '-<digit>', '-.', '-inf'/'-nan' spellings can parse as floats;
+    # anything else is a key without paying the float() probe
+    if not (c.isdigit() or c in ".iInN"):
+        return True
+    return not _is_number(tok)
+
+
+def _parse_flag_tail(toks) -> dict:
+    """'-key value ...' pairs from a token list (or raw string)."""
+    if isinstance(toks, str):
+        toks = toks.split()
     out = {}
-    toks = text.split()
-    i = 0
-    while i < len(toks):
+    i, n = 0, len(toks)
+    while i < n:
         tok = toks[i]
-        if tok.startswith("-") and not _is_number(tok):
-            nxt = toks[i + 1] if i + 1 < len(toks) else None
-            if nxt is not None and not (nxt.startswith("-") and not _is_number(nxt)):
-                out[tok[1:]] = nxt
+        if _is_flag_key(tok):
+            if i + 1 < n and not _is_flag_key(toks[i + 1]):
+                out[tok[1:]] = toks[i + 1]
                 i += 2
                 continue
             out[tok[1:]] = ""

@@ -1,0 +1,165 @@
+"""Resumable realization sweeps: incremental computation with
+checkpoint/resume — the aux subsystem SURVEY.md §5 records as absent in
+the reference (its only persistence is write_partim, which forgets the
+ledger and cannot resume anything).
+
+A sweep is deterministic given (key, batch, recipe, nreal, chunk): chunk
+``i`` always uses ``fold_in(key, i)``, so a crashed or preempted sweep
+resumes from the last completed chunk and produces bit-identical results
+to an uninterrupted run. Per-chunk results pass through a ``reduce_fn``
+(default: per-realization, per-pulsar RMS) so the on-disk state stays
+small even for million-realization sweeps; pass ``reduce_fn=None`` to
+keep full residual cubes.
+
+On-disk layout: one ``.npy`` per completed chunk (written once — O(1)
+I/O per chunk) plus a ``.meta.json`` sidecar carrying the sweep
+fingerprint (key, sizes, and a content hash of batch+recipe, so resuming
+with different physics raises instead of mixing results). When the sweep
+finishes, chunks consolidate into the single ``checkpoint_path`` npz and
+the per-chunk files are removed.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def _default_reduce(res, batch):
+    import jax.numpy as jnp
+
+    return jnp.sqrt(
+        jnp.sum(res**2 * batch.mask, axis=-1) / jnp.sum(batch.mask, axis=-1)
+    )
+
+
+def _fingerprint(*trees) -> str:
+    """Content hash over pytree structure + leaf bytes (batch, recipe)."""
+    import jax
+
+    h = hashlib.sha256()
+    for tree in trees:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        h.update(repr(treedef).encode())
+        for leaf in leaves:
+            arr = np.asarray(leaf)
+            h.update(arr.dtype.str.encode())
+            h.update(str(arr.shape).encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _chunk_path(checkpoint_path: str, i: int) -> str:
+    return f"{checkpoint_path}.chunk{i:06d}.npy"
+
+
+def _atomic_write(write_fn, final_path: str, suffix: str):
+    fd, tmp = tempfile.mkstemp(
+        suffix=suffix, dir=os.path.dirname(final_path) or "."
+    )
+    os.close(fd)
+    write_fn(tmp)
+    os.replace(tmp, final_path)
+
+
+def sweep(
+    key,
+    batch,
+    recipe,
+    nreal: int,
+    checkpoint_path: str,
+    chunk: int = 256,
+    reduce_fn: Optional[Callable] = _default_reduce,
+    fit: bool = False,
+    mesh=None,
+    progress: Optional[Callable[[int, int], None]] = None,
+) -> np.ndarray:
+    """Run ``nreal`` realizations in resumable chunks.
+
+    Returns the stacked reduced results, shape (nreal, ...). A rerun with
+    the same arguments resumes after the last completed chunk; a finished
+    sweep returns instantly from the consolidated checkpoint; mismatched
+    arguments (including different batch/recipe contents) raise.
+    """
+    import jax
+
+    from ..models.batched import realize
+    from ..parallel.mesh import sharded_realize
+
+    if nreal % chunk:
+        raise ValueError(f"nreal={nreal} must be a multiple of chunk={chunk}")
+    nchunks = nreal // chunk
+
+    meta = {
+        "key": np.asarray(jax.random.key_data(key)).tolist(),
+        "nreal": nreal,
+        "chunk": chunk,
+        "fit": bool(fit),
+        "physics": _fingerprint(batch, recipe),
+        "reduce": getattr(reduce_fn, "__qualname__", None)
+        if reduce_fn is not None
+        else None,
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+    }
+    meta_path = checkpoint_path + ".meta.json"
+    done = 0
+    if os.path.exists(meta_path):
+        with open(meta_path) as fh:
+            on_disk = json.load(fh)
+        saved_done = on_disk.pop("done", 0)
+        if on_disk != meta:
+            raise ValueError(
+                f"checkpoint at {checkpoint_path} belongs to a different "
+                f"sweep: {on_disk} != {meta}"
+            )
+        done = saved_done
+
+    if done == nchunks and os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path) as z:
+            return np.concatenate(
+                [z[f"chunk{i}"] for i in range(nchunks)], axis=0
+            )
+
+    blocks = [np.load(_chunk_path(checkpoint_path, i)) for i in range(done)]
+
+    for i in range(done, nchunks):
+        k = jax.random.fold_in(key, i)
+        if mesh is not None:
+            res = sharded_realize(k, batch, recipe, nreal=chunk, mesh=mesh, fit=fit)
+        else:
+            res = realize(k, batch, recipe, nreal=chunk, fit=fit)
+        out = reduce_fn(res, batch) if reduce_fn is not None else res
+        block = np.asarray(out)  # readback = the sync fence
+        blocks.append(block)
+
+        # chunk file first, sidecar last: a crash between the two only
+        # recomputes this chunk on resume
+        _atomic_write(
+            lambda p: np.save(p, block, allow_pickle=False),
+            _chunk_path(checkpoint_path, i),
+            ".npy",
+        )
+        _atomic_write(
+            lambda p: open(p, "w").write(json.dumps({**meta, "done": i + 1})),
+            meta_path,
+            ".json",
+        )
+        if progress is not None:
+            progress(i + 1, nchunks)
+
+    # consolidate into the single advertised npz, then drop chunk files
+    _atomic_write(
+        lambda p: np.savez(p, **{f"chunk{j}": b for j, b in enumerate(blocks)}),
+        checkpoint_path,
+        ".npz",
+    )
+    for i in range(nchunks):
+        try:
+            os.remove(_chunk_path(checkpoint_path, i))
+        except FileNotFoundError:
+            pass
+    return np.concatenate(blocks, axis=0)

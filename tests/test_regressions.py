@@ -99,11 +99,16 @@ def test_noise_dict_path_and_defaults(tmp_path):
 
 
 def test_flag_tail_negative_values(tmp_path):
-    """Negative numeric flag values are values, not new flag keys."""
+    """Negative numeric flag values are values, not new flag keys —
+    including the '-inf'/'-nan' float spellings."""
     p = tmp_path / "neg.tim"
-    p.write_text("FORMAT 1\n a 1440.0 53000.0 0.5 AXIS -padd -1.5e-6 -be GUPPI\n")
+    p.write_text(
+        "FORMAT 1\n a 1440.0 53000.0 0.5 AXIS -padd -1.5e-6 -be GUPPI\n"
+        " a 1440.0 53001.0 0.5 AXIS -padd -inf -nu -nan -be GUPPI\n"
+    )
     toas = read_tim(str(p))
     assert toas.flags[0] == {"padd": "-1.5e-6", "be": "GUPPI"}
+    assert toas.flags[1] == {"padd": "-inf", "nu": "-nan", "be": "GUPPI"}
 
 
 def test_user_spectrum_recipe_injects_gwb():

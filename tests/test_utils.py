@@ -69,3 +69,60 @@ def test_native_tim_parser_matches_python():
     assert float(np.abs((a.mjd - b.mjd).astype(float)).max()) == 0.0
     assert np.array_equal(a.errors_s, b.errors_s)
     assert a.flags == b.flags and a.observatories == b.observatories
+
+
+def test_sweep_resume_bit_identical(tmp_path):
+    """A sweep interrupted mid-way resumes from its checkpoint and yields
+    results bit-identical to an uninterrupted run; finished sweeps return
+    from disk; mismatched arguments are rejected."""
+    import jax
+    import jax.numpy as jnp
+    from pta_replicator_tpu.models.batched import Recipe
+    from pta_replicator_tpu.utils.sweep import sweep
+
+    b = synthetic_batch(npsr=3, ntoa=64, seed=2)
+    recipe = Recipe(efac=jnp.ones(3), rn_log10_amplitude=jnp.full(3, -14.0),
+                    rn_gamma=jnp.full(3, 4.0))
+    key = jax.random.PRNGKey(5)
+    ck1 = str(tmp_path / "a.npz")
+    full = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck1)
+    assert full.shape == (16, 3)
+
+    # interrupt after 2 of 4 chunks via the progress callback
+    ck2 = str(tmp_path / "b.npz")
+
+    class Stop(Exception):
+        pass
+
+    def bomb(done, total):
+        if done == 2:
+            raise Stop
+
+    with pytest.raises(Stop):
+        sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck2,
+              progress=bomb)
+    calls = []
+    resumed = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck2,
+                    progress=lambda d, t: calls.append(d))
+    assert calls == [3, 4]  # only the remaining chunks ran
+    np.testing.assert_array_equal(resumed, full)
+
+    # finished sweep: zero chunks run, same result
+    calls.clear()
+    again = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck2,
+                  progress=lambda d, t: calls.append(d))
+    assert calls == []
+    np.testing.assert_array_equal(again, full)
+
+    with pytest.raises(ValueError, match="different sweep"):
+        sweep(key, b, recipe, nreal=32, chunk=4, checkpoint_path=ck2)
+    # different physics (recipe contents) must be rejected too
+    import dataclasses
+
+    other = dataclasses.replace(recipe, rn_gamma=jnp.full(3, 2.0))
+    with pytest.raises(ValueError, match="different sweep"):
+        sweep(key, b, other, nreal=16, chunk=4, checkpoint_path=ck2)
+    # chunk files are consolidated away after completion
+    import glob
+
+    assert glob.glob(ck2 + ".chunk*") == []
