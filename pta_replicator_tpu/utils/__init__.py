@@ -4,10 +4,12 @@ from .cosmology import (
     gw_strain_source,
     m1m2_from_mtmr,
 )
+from .sweep import sweep
 
 __all__ = [
     "chirp_mass",
     "comoving_distance_cm",
     "gw_strain_source",
     "m1m2_from_mtmr",
+    "sweep",
 ]

@@ -6,7 +6,9 @@ ledger and cannot resume anything).
 A sweep is deterministic given (key, batch, recipe, nreal, chunk): chunk
 ``i`` always uses ``fold_in(key, i)``, so a crashed or preempted sweep
 resumes from the last completed chunk and produces bit-identical results
-to an uninterrupted run. Per-chunk results pass through a ``reduce_fn``
+to an uninterrupted run on the same device topology (resuming on a
+different mesh is allowed — preemption rarely hands back the same slice
+— and agrees up to float reduction order in partitioned contractions). Per-chunk results pass through a ``reduce_fn``
 (default: per-realization, per-pulsar RMS) so the on-disk state stays
 small even for million-realization sweeps; pass ``reduce_fn=None`` to
 keep full residual cubes.
@@ -53,8 +55,30 @@ def _fingerprint(*trees) -> str:
     return h.hexdigest()
 
 
+def _fn_id(fn) -> Optional[str]:
+    """Stable identity for the reduce function: a hash of its bytecode and
+    constants, so a redefined lambda with different behavior is detected
+    (a bare __qualname__ is '<lambda>' for every lambda)."""
+    if fn is None:
+        return None
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return getattr(fn, "__qualname__", repr(fn))
+    return hashlib.sha256(
+        code.co_code + repr(code.co_consts).encode()
+    ).hexdigest()[:16]
+
+
 def _chunk_path(checkpoint_path: str, i: int) -> str:
     return f"{checkpoint_path}.chunk{i:06d}.npy"
+
+
+def _cleanup_chunks(checkpoint_path: str, nchunks: int) -> None:
+    for i in range(nchunks):
+        try:
+            os.remove(_chunk_path(checkpoint_path, i))
+        except FileNotFoundError:
+            pass
 
 
 def _atomic_write(write_fn, final_path: str, suffix: str):
@@ -62,8 +86,12 @@ def _atomic_write(write_fn, final_path: str, suffix: str):
         suffix=suffix, dir=os.path.dirname(final_path) or "."
     )
     os.close(fd)
-    write_fn(tmp)
-    os.replace(tmp, final_path)
+    try:
+        write_fn(tmp)
+        os.replace(tmp, final_path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def sweep(
@@ -100,10 +128,11 @@ def sweep(
         "chunk": chunk,
         "fit": bool(fit),
         "physics": _fingerprint(batch, recipe),
-        "reduce": getattr(reduce_fn, "__qualname__", None)
-        if reduce_fn is not None
-        else None,
-        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "reduce": _fn_id(reduce_fn),
+        # NOTE: mesh is deliberately NOT part of the fingerprint — a
+        # preempted sweep may resume on a different topology (or none).
+        # Same-topology resume is bit-identical; cross-topology resume is
+        # equal up to float reduction order in partitioned contractions.
     }
     meta_path = checkpoint_path + ".meta.json"
     done = 0
@@ -119,6 +148,9 @@ def sweep(
         done = saved_done
 
     if done == nchunks and os.path.exists(checkpoint_path):
+        # best-effort: reap chunk files orphaned by a crash between the
+        # consolidation rename and the original cleanup loop
+        _cleanup_chunks(checkpoint_path, nchunks)
         with np.load(checkpoint_path) as z:
             return np.concatenate(
                 [z[f"chunk{i}"] for i in range(nchunks)], axis=0
@@ -143,11 +175,13 @@ def sweep(
             _chunk_path(checkpoint_path, i),
             ".npy",
         )
-        _atomic_write(
-            lambda p: open(p, "w").write(json.dumps({**meta, "done": i + 1})),
-            meta_path,
-            ".json",
-        )
+        payload = json.dumps({**meta, "done": i + 1})
+
+        def write_meta(p, payload=payload):
+            with open(p, "w") as fh:
+                fh.write(payload)
+
+        _atomic_write(write_meta, meta_path, ".json")
         if progress is not None:
             progress(i + 1, nchunks)
 
@@ -157,9 +191,5 @@ def sweep(
         checkpoint_path,
         ".npz",
     )
-    for i in range(nchunks):
-        try:
-            os.remove(_chunk_path(checkpoint_path, i))
-        except FileNotFoundError:
-            pass
+    _cleanup_chunks(checkpoint_path, nchunks)
     return np.concatenate(blocks, axis=0)
