@@ -1,0 +1,155 @@
+"""Command-line dataset runner: par/tim + JSON recipe → realizations.
+
+The reference has no CLI or config runner (SURVEY.md §1 L5 — its "API"
+is notebook imports). This runner covers the common batch use end to
+end:
+
+    python -m pta_replicator_tpu realize \
+        --pardir par/ --timdir tim/ --recipe recipe.json \
+        --nreal 1000 --out residuals.npz [--fit] [--sharded] \
+        [--checkpoint sweep.npz] [--seed 0]
+
+recipe.json maps 1:1 onto models.batched.Recipe, with scalars, lists, or
+nested lists for array leaves, plus one extra key:
+
+    "orf": "hd" (default)            Hellings-Downs correlations
+           "none"                    uncorrelated common process
+           {"lmax": L, "clm": [...]} anisotropic spherical-harmonic ORF
+
+`info` prints the loaded array's shape/epochs/backends as JSON.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _build_recipe(spec: dict, psrs):
+    import jax.numpy as jnp
+
+    from .models.batched import Recipe
+    from .ops.coords import pulsar_ra_dec
+    from .ops.orf import assemble_orf
+
+    spec = dict(spec)
+    orf_mode = spec.pop("orf", "hd")
+    static_names = {
+        "tnequad", "gwb_turnover", "rn_nmodes", "gwb_npts", "gwb_howml",
+        "cgw_tref_s", "cgw_chunk", "cgw_backend", "transient_psr",
+        "gwb_f0", "gwb_beta", "gwb_power",
+    }
+    kwargs = {}
+    for key, val in spec.items():
+        if key not in Recipe.__dataclass_fields__:
+            raise SystemExit(f"recipe key {key!r} is not a Recipe field")
+        kwargs[key] = val if key in static_names else jnp.asarray(val)
+
+    if "orf_cholesky" not in kwargs and orf_mode != "none":
+        locs = np.zeros((len(psrs), 2))
+        for i, p in enumerate(psrs):
+            ra, dec = pulsar_ra_dec(p.loc, p.name)
+            locs[i] = ra, np.pi / 2 - dec
+        if orf_mode == "hd":
+            orf = assemble_orf(locs, lmax=0)
+        else:
+            orf = assemble_orf(
+                locs, clm=orf_mode.get("clm"), lmax=int(orf_mode["lmax"])
+            )
+        kwargs["orf_cholesky"] = jnp.asarray(np.linalg.cholesky(orf))
+    return Recipe(**kwargs)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m pta_replicator_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    for name in ("realize", "info"):
+        p = sub.add_parser(name)
+        p.add_argument("--pardir", required=True)
+        p.add_argument("--timdir", required=True)
+        p.add_argument("--num-psrs", type=int, default=None)
+    p = sub.choices["realize"]
+    p.add_argument("--recipe", required=True, help="JSON recipe file")
+    p.add_argument("--nreal", type=int, default=100)
+    p.add_argument("--out", required=True)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--fit", action="store_true")
+    p.add_argument("--sharded", action="store_true",
+                   help="shard realizations over all visible devices")
+    p.add_argument("--checkpoint", default=None,
+                   help="resumable sweep checkpoint path (chunked)")
+    p.add_argument("--chunk", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    from . import load_from_directories, make_ideal
+
+    psrs = load_from_directories(args.pardir, args.timdir,
+                                 num_psrs=args.num_psrs)
+    for psr in psrs:
+        make_ideal(psr)
+
+    from .batch import freeze
+
+    batch = freeze(psrs)
+    if args.cmd == "info":
+        print(json.dumps({
+            "npsr": batch.npsr,
+            "ntoa_max": batch.ntoa_max,
+            "names": list(batch.names),
+            "backends": list(batch.backend_names),
+            "max_epochs": batch.max_epochs,
+            "tref_mjd": float(batch.tref_mjd),
+        }))
+        return
+
+    import jax
+
+    with open(args.recipe) as fh:
+        recipe = _build_recipe(json.load(fh), psrs)
+    key = jax.random.PRNGKey(args.seed)
+
+    if args.checkpoint:
+        from .utils.sweep import sweep
+
+        chunk = min(args.chunk, args.nreal)
+        if args.nreal % chunk:
+            raise SystemExit(
+                f"--nreal {args.nreal} must be a multiple of --chunk {chunk}"
+            )
+        mesh = None
+        if args.sharded:
+            from .parallel import make_mesh
+
+            mesh = make_mesh()
+        out = sweep(key, batch, recipe, nreal=args.nreal,
+                    checkpoint_path=args.checkpoint, chunk=chunk,
+                    reduce_fn=None, fit=args.fit, mesh=mesh,
+                    progress=lambda d, t: print(f"chunk {d}/{t}",
+                                                file=sys.stderr))
+    elif args.sharded:
+        from .parallel import make_mesh, sharded_realize
+
+        out = np.asarray(sharded_realize(
+            key, batch, recipe, nreal=args.nreal, mesh=make_mesh(),
+            fit=args.fit,
+        ))
+    else:
+        from .models.batched import realize
+
+        out = np.asarray(realize(key, batch, recipe, nreal=args.nreal,
+                                 fit=args.fit))
+
+    np.savez(args.out, residuals=out, mask=np.asarray(batch.mask),
+             names=np.array(batch.names))
+    print(json.dumps({
+        "out": args.out,
+        "shape": list(out.shape),
+        "rms_s": float(np.sqrt((out**2).mean())),
+    }))
+
+
+if __name__ == "__main__":
+    main()

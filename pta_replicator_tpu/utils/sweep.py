@@ -55,18 +55,37 @@ def _fingerprint(*trees) -> str:
     return h.hexdigest()
 
 
+def _hash_code(h, code) -> None:
+    """Hash bytecode recursively: nested code objects are hashed by their
+    own bytecode, never by repr (which embeds per-process addresses)."""
+    import types
+
+    h.update(code.co_code)
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            _hash_code(h, const)
+        else:
+            h.update(repr(const).encode())
+
+
 def _fn_id(fn) -> Optional[str]:
-    """Stable identity for the reduce function: a hash of its bytecode and
-    constants, so a redefined lambda with different behavior is detected
-    (a bare __qualname__ is '<lambda>' for every lambda)."""
+    """Stable identity for the reduce function across process restarts: a
+    hash of its (recursive) bytecode, constants, and closure-cell values.
+    Detects redefined lambdas and changed captured constants; values only
+    reachable through module globals are NOT hashed (documented limit)."""
     if fn is None:
         return None
     code = getattr(fn, "__code__", None)
     if code is None:
         return getattr(fn, "__qualname__", repr(fn))
-    return hashlib.sha256(
-        code.co_code + repr(code.co_consts).encode()
-    ).hexdigest()[:16]
+    h = hashlib.sha256()
+    _hash_code(h, code)
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            h.update(repr(cell.cell_contents).encode())
+        except ValueError:  # empty cell
+            pass
+    return h.hexdigest()[:16]
 
 
 def _chunk_path(checkpoint_path: str, i: int) -> str:
