@@ -196,11 +196,16 @@ def freeze(
         phat[i] = unit_vector(theta, phi)
 
         flags = p.toas.get_flag(flagid)
-        for j in range(n):
-            val = str(flags[j])
+        # vectorized vocab mapping: unique values once, O(V) list work
+        flags_arr = np.asarray([str(v) for v in flags])
+        uniq, inv = np.unique(flags_arr, return_inverse=True)
+        local_to_global = np.empty(len(uniq), dtype=np.int32)
+        for u_i, val in enumerate(uniq):
+            val = str(val)  # plain str, not np.str_
             if val not in backend_names:
                 backend_names.append(val)
-            backend_idx[i, j] = backend_names.index(val)
+            local_to_global[u_i] = backend_names.index(val)
+        backend_idx[i, :n] = local_to_global[inv]
 
         bins = quantize(mjds[i], flags=flags, dt=coarsegrain)
         epoch_indices.append(bins.epoch_index)
@@ -213,16 +218,10 @@ def freeze(
         idx, cnt = epoch_indices[i], epoch_counts[i]
         epoch_idx[i, : len(idx)] = idx
         epoch_mask[i, :cnt] = 1.0
-        # backend of each epoch = backend of its first TOA
-        first_toa_of_epoch = np.zeros(cnt, dtype=np.int64)
-        seen = np.zeros(cnt, dtype=bool)
+        # backend of each epoch = backend of its (time-)first TOA
         order = np.argsort(mjds[i], kind="stable")
-        for j in order:
-            e = idx[j]
-            if not seen[e]:
-                seen[e] = True
-                first_toa_of_epoch[e] = j
-        epoch_backend[i, :cnt] = backend_idx[i, first_toa_of_epoch]
+        uniq_e, first_pos = np.unique(idx[order], return_index=True)
+        epoch_backend[i, uniq_e] = backend_idx[i, order[first_pos]]
 
     start = float(min(m.min() for m in mjds) - 1.0) * DAY_IN_SEC
     stop = float(max(m.max() for m in mjds) + 1.0) * DAY_IN_SEC

@@ -44,24 +44,26 @@ def quantize(times: np.ndarray, flags=None, dt: float = 1.0) -> EpochBins:
     TOA of the current epoch — matching the reference's bucketing rule so
     epoch structures agree exactly.
     """
-    times = np.asarray(times)
+    times = np.asarray(times, dtype=np.float64)
+    n = len(times)
     order = np.argsort(times, kind="stable")
-    epoch_of = np.empty(len(times), dtype=np.int64)
+    ts = times[order]
 
-    starts = []  # first-TOA time of each epoch
-    members = []  # list of index lists
-    for idx in order:
-        if starts and times[idx] - starts[-1] < dt:
-            members[-1].append(idx)
-        else:
-            starts.append(times[idx])
-            members.append([idx])
-    for e, idxs in enumerate(members):
-        epoch_of[idxs] = e
+    # boundary walk: one searchsorted per epoch (O(E log N)) instead of a
+    # Python append per TOA
+    bounds = [0]
+    i = 0
+    while i < n:
+        i = int(np.searchsorted(ts, ts[i] + dt, side="left"))
+        bounds.append(i)
+    bounds = np.asarray(bounds)
+    sizes = np.diff(bounds)
+    nep = len(sizes)
 
-    ave = np.array([times[idxs].mean() for idxs in members], dtype=np.float64)
+    epoch_of = np.empty(n, dtype=np.int64)
+    epoch_of[order] = np.repeat(np.arange(nep), sizes)
+    ave = np.add.reduceat(ts, bounds[:-1]) / sizes if n else np.zeros(0)
     aveflags = None
     if flags is not None:
-        flags = np.asarray(flags)
-        aveflags = np.array([flags[idxs[0]] for idxs in members])
+        aveflags = np.asarray(flags)[order[bounds[:-1]]]
     return EpochBins(epoch_index=epoch_of, ave_times=ave, ave_flags=aveflags)
