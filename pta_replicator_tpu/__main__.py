@@ -36,6 +36,18 @@ def _build_recipe(spec: dict, psrs):
 
     spec = dict(spec)
     orf_mode = spec.pop("orf", "hd")
+    lmax_ok = False
+    if isinstance(orf_mode, dict) and "lmax" in orf_mode:
+        try:
+            int(orf_mode["lmax"])
+            lmax_ok = True
+        except (TypeError, ValueError):
+            pass
+    if not (orf_mode in ("hd", "none") or lmax_ok):
+        raise SystemExit(
+            'recipe key "orf" must be "hd", "none", or an object with an '
+            f'integer "lmax" key (and optional "clm"); got {orf_mode!r}'
+        )
     static_names = {
         "tnequad", "gwb_turnover", "rn_nmodes", "gwb_npts", "gwb_howml",
         "cgw_tref_s", "cgw_chunk", "cgw_backend", "transient_psr",

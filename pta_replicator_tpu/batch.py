@@ -196,12 +196,17 @@ def freeze(
         phat[i] = unit_vector(theta, phi)
 
         flags = p.toas.get_flag(flagid)
-        # vectorized vocab mapping: unique values once, O(V) list work
+        # vectorized vocab mapping: unique values once, O(V) list work.
+        # The global vocabulary grows in order of first appearance (TOA
+        # order within each pulsar), so re-freezing a dataset reproduces
+        # the backend_names ordering of any tables built against it.
         flags_arr = np.asarray([str(v) for v in flags])
-        uniq, inv = np.unique(flags_arr, return_inverse=True)
+        uniq, first, inv = np.unique(
+            flags_arr, return_index=True, return_inverse=True
+        )
         local_to_global = np.empty(len(uniq), dtype=np.int32)
-        for u_i, val in enumerate(uniq):
-            val = str(val)  # plain str, not np.str_
+        for u_i in np.argsort(first):
+            val = str(uniq[u_i])  # plain str, not np.str_
             if val not in backend_names:
                 backend_names.append(val)
             local_to_global[u_i] = backend_names.index(val)

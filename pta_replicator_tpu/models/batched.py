@@ -226,6 +226,68 @@ def gwb_delays(
     return uniform_grid_interp(batch.toas_s, ut[0], ut[-1], grid_series) * batch.mask
 
 
+#: cached result of the one-shot Pallas viability probe, keyed by the
+#: (npsr, dtype, psr_term, evolve, phase_approx) kernel variant
+_PALLAS_PROBE: dict = {}
+
+
+def _pallas_usable(
+    npsr: int, ntoa: int, nsrc: int, dtype,
+    psr_term: bool, evolve: bool, phase_approx: bool,
+) -> bool:
+    """Compile-and-run the Pallas CW kernel once at exactly the tile
+    sizes, pulsar count, and dtype the production call will use on the
+    current default backend. ``backend='auto'`` consults this so a Mosaic
+    compile or runtime failure degrades the flagship op to the portable
+    scan path instead of taking it down (the kernel had zero
+    real-hardware evidence in round 1 — ADVICE.md). A failed probe is
+    cached and warns once; callers who believe the failure was transient
+    can clear ``_PALLAS_PROBE`` or pass ``backend='pallas'`` explicitly."""
+    # mirror cw_catalog_response's tile derivation so the probe compiles
+    # the same kernel instantiation production will
+    src_tile = min(128, max(8, nsrc))
+    toa_tile = min(1024, max(128, ntoa))
+    key = (
+        npsr, toa_tile, src_tile, jnp.dtype(dtype).name,
+        psr_term, evolve, phase_approx,
+    )
+    if key not in _PALLAS_PROBE:
+        try:
+            from ..ops.pallas_cw import (
+                cw_catalog_coefficients,
+                cw_catalog_response,
+            )
+
+            one = jnp.full((src_tile,), 0.5, dtype)
+            phat = jnp.asarray(
+                np.tile(np.eye(3), (npsr // 3 + 1, 1))[:npsr], dtype
+            )
+            src_c, psr_c = cw_catalog_coefficients(
+                phat, one, one, 1e8 * one, 100.0 * one,
+                1e-8 * one, one, one, one, dtype=dtype,
+            )
+            toas = jnp.broadcast_to(
+                jnp.linspace(0.0, 1e8, toa_tile, dtype=dtype),
+                (npsr, toa_tile),
+            )
+            out = cw_catalog_response(
+                toas, src_c, psr_c, psr_term=psr_term, evolve=evolve,
+                phase_approx=phase_approx, src_tile=src_tile,
+                toa_tile=toa_tile,
+            )
+            # host readback forces real execution, not just dispatch
+            _PALLAS_PROBE[key] = bool(np.isfinite(np.asarray(out)).all())
+        except Exception as exc:  # Mosaic lowering/compile/runtime failure
+            import warnings
+
+            warnings.warn(
+                "Pallas CW kernel probe failed; cgw backend 'auto' falls "
+                f"back to 'scan' for this process: {exc!r}"
+            )
+            _PALLAS_PROBE[key] = False
+    return _PALLAS_PROBE[key]
+
+
 def cgw_catalog_delays(
     batch: PulsarBatch,
     gwtheta,
@@ -265,7 +327,15 @@ def cgw_catalog_delays(
         batch.tref_mjd * 86400.0 - tref_s, dtype
     )
     if backend == "auto":
-        backend = "pallas" if jax.default_backend() == "tpu" else "scan"
+        backend = (
+            "pallas"
+            if jax.default_backend() == "tpu"
+            and _pallas_usable(
+                batch.npsr, batch.ntoa_max, jnp.asarray(gwtheta).shape[0],
+                dtype, psr_term, evolve, phase_approx,
+            )
+            else "scan"
+        )
     if backend not in ("pallas", "pallas_interpret", "scan"):
         raise ValueError(f"unknown CW-catalog backend {backend!r}")
     if backend in ("pallas", "pallas_interpret"):

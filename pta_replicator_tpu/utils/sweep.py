@@ -82,9 +82,23 @@ def _fn_id(fn) -> Optional[str]:
     _hash_code(h, code)
     for cell in getattr(fn, "__closure__", None) or ():
         try:
-            h.update(repr(cell.cell_contents).encode())
+            val = cell.cell_contents
         except ValueError:  # empty cell
-            pass
+            continue
+        arr = None
+        if hasattr(val, "shape") and hasattr(val, "dtype"):
+            # ndarray / jax.Array: repr() truncates large arrays ('...'),
+            # so hash dtype/shape + the full buffer instead (as
+            # _fingerprint does for tree leaves)
+            try:
+                arr = np.asarray(val)
+            except Exception:  # non-addressable/deleted device array
+                arr = None
+        if arr is not None and arr.dtype != object:
+            h.update(str((arr.dtype.str, arr.shape)).encode())
+            h.update(arr.tobytes())
+        else:
+            h.update(repr(val).encode())
     return h.hexdigest()[:16]
 
 
