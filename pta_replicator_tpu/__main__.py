@@ -36,13 +36,11 @@ def _build_recipe(spec: dict, psrs):
 
     spec = dict(spec)
     orf_mode = spec.pop("orf", "hd")
-    lmax_ok = False
-    if isinstance(orf_mode, dict) and "lmax" in orf_mode:
-        try:
-            int(orf_mode["lmax"])
-            lmax_ok = True
-        except (TypeError, ValueError):
-            pass
+    lmax_ok = (
+        isinstance(orf_mode, dict)
+        and isinstance(orf_mode.get("lmax"), int)
+        and not isinstance(orf_mode.get("lmax"), bool)
+    )
     if not (orf_mode in ("hd", "none") or lmax_ok):
         raise SystemExit(
             'recipe key "orf" must be "hd", "none", or an object with an '
@@ -50,7 +48,8 @@ def _build_recipe(spec: dict, psrs):
         )
     static_names = {
         "tnequad", "gwb_turnover", "rn_nmodes", "gwb_npts", "gwb_howml",
-        "cgw_tref_s", "cgw_chunk", "cgw_backend", "transient_psr",
+        "cgw_tref_s", "cgw_chunk", "cgw_backend", "cgw_psr_term",
+        "cgw_evolve", "cgw_phase_approx", "transient_psr",
         "gwb_f0", "gwb_beta", "gwb_power",
     }
     kwargs = {}

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from ..batch import PulsarBatch
 from ..constants import YEAR_IN_SEC
-from .cgw import cw_delay, principal_axes
+from .cgw import principal_axes
 from .gwb import (
     characteristic_strain,
     dft_synthesis_matrices,
@@ -227,13 +227,12 @@ def gwb_delays(
 
 
 #: cached result of the one-shot Pallas viability probe, keyed by the
-#: (npsr, dtype, psr_term, evolve, phase_approx) kernel variant
+#: (npsr, toa_tile, src_tile, dtype, psr_term, evolve) kernel variant
 _PALLAS_PROBE: dict = {}
 
 
 def _pallas_usable(
-    npsr: int, ntoa: int, nsrc: int, dtype,
-    psr_term: bool, evolve: bool, phase_approx: bool,
+    npsr: int, ntoa: int, nsrc: int, dtype, psr_term: bool, evolve: bool
 ) -> bool:
     """Compile-and-run the Pallas CW kernel once at exactly the tile
     sizes, pulsar count, and dtype the production call will use on the
@@ -248,32 +247,31 @@ def _pallas_usable(
     src_tile = min(128, max(8, nsrc))
     toa_tile = min(1024, max(128, ntoa))
     key = (
-        npsr, toa_tile, src_tile, jnp.dtype(dtype).name,
-        psr_term, evolve, phase_approx,
+        npsr, toa_tile, src_tile, jnp.dtype(dtype).name, psr_term, evolve,
     )
     if key not in _PALLAS_PROBE:
         try:
             from ..ops.pallas_cw import (
-                cw_catalog_coefficients,
+                cw_catalog_planes,
                 cw_catalog_response,
             )
 
-            one = jnp.full((src_tile,), 0.5, dtype)
-            phat = jnp.asarray(
-                np.tile(np.eye(3), (npsr // 3 + 1, 1))[:npsr], dtype
-            )
-            src_c, psr_c = cw_catalog_coefficients(
+            # 2x2-tile workload so the probe exercises the multi-tile
+            # grid (incl. the out_ref accumulation across source tiles)
+            # production compiles, not just a (1,1)-grid program
+            one = np.full((2 * src_tile,), 0.5)
+            phat = np.tile(np.eye(3), (npsr // 3 + 1, 1))[:npsr]
+            src_c, psr_c = cw_catalog_planes(
                 phat, one, one, 1e8 * one, 100.0 * one,
-                1e-8 * one, one, one, one, dtype=dtype,
+                1e-8 * one, one, one, one, evolve=evolve, dtype=dtype,
             )
             toas = jnp.broadcast_to(
-                jnp.linspace(0.0, 1e8, toa_tile, dtype=dtype),
-                (npsr, toa_tile),
+                jnp.linspace(0.0, 1e8, 2 * toa_tile, dtype=dtype),
+                (npsr, 2 * toa_tile),
             )
             out = cw_catalog_response(
                 toas, src_c, psr_c, psr_term=psr_term, evolve=evolve,
-                phase_approx=phase_approx, src_tile=src_tile,
-                toa_tile=toa_tile,
+                src_tile=src_tile, toa_tile=toa_tile,
             )
             # host readback forces real execution, not just dispatch
             _PALLAS_PROBE[key] = bool(np.isfinite(np.asarray(out)).all())
@@ -288,6 +286,71 @@ def _pallas_usable(
     return _PALLAS_PROBE[key]
 
 
+def _cw_scan_response(
+    toas_rel, src_c, psr_c, psr_term: bool, evolve: bool, chunk: int
+):
+    """Portable plane-consuming fallback for :func:`cw_catalog_response`:
+    ``lax.scan`` over ``chunk``-sized source tiles, vmapped over pulsars,
+    so only a (chunk, Nt) workspace is live per pulsar while the scan
+    accumulates the (Np, Nt) sum."""
+    from ..ops.pallas_cw import (
+        NC_PSR,
+        NC_SRC,
+        _PSR_PLANES,
+        _SRC_PLANES,
+        _polarized,
+        _term_response,
+    )
+
+    dtype = toas_rel.dtype
+    npsr, _ = toas_rel.shape
+    nsrc = src_c.shape[1]
+    npad = (-nsrc) % chunk
+    src_p = jnp.pad(src_c, ((0, 0), (0, npad)))
+    psr_p = jnp.pad(psr_c, ((0, 0), (0, 0), (0, npad)))
+    nch = (nsrc + npad) // chunk
+    src_tiles = src_p.reshape(NC_SRC, nch, chunk).transpose(1, 0, 2)
+    psr_tiles = psr_p.reshape(NC_PSR, npsr, nch, chunk).transpose(2, 0, 1, 3)
+
+    def one_psr(u_row, psr_tile, src_tile):
+        # (chunk, 1) coefficient columns against the (1, Nt) time row;
+        # named plane lookups keep this in lockstep with the kernel
+        sp = lambda n: src_tile[_SRC_PLANES.index(n)][:, None]
+        pp = lambda n: psr_tile[_PSR_PLANES.index(n)][:, None]
+        u = u_row[None, :]
+        inc1, inc2 = sp("incfac1"), sp("incfac2")
+        s2p, c2p = sp("sin2psi"), sp("cos2psi")
+        phase, alpha = _term_response(
+            u, sp("phi0_e"), sp("rate_e"), sp("pn_e"), sp("amp_e"), evolve
+        )
+        rplus, rcross = _polarized(phase, alpha, inc1, inc2, s2p, c2p)
+        if psr_term:
+            phase_p, alpha_p = _term_response(
+                u, pp("phi0_p"), pp("rate_p"), pp("pn_p"), pp("amp_p"),
+                evolve,
+            )
+            rplus_p, rcross_p = _polarized(
+                phase_p, alpha_p, inc1, inc2, s2p, c2p
+            )
+            res = pp("fplus") * (rplus_p - rplus) + pp("fcross") * (
+                rcross_p - rcross
+            )
+        else:
+            res = -pp("fplus") * rplus - pp("fcross") * rcross
+        res = jnp.where(jnp.isnan(res), 0.0, res) * sp("valid")
+        return jnp.sum(res, axis=0)
+
+    per_psr = jax.vmap(one_psr, in_axes=(0, 1, None))
+
+    def step(carry, tiles):
+        src_tile, psr_tile = tiles
+        return carry + per_psr(toas_rel, psr_tile, src_tile), None
+
+    init = jnp.zeros(toas_rel.shape, dtype)
+    total, _ = jax.lax.scan(step, init, (src_tiles, psr_tiles))
+    return total
+
+
 def cgw_catalog_delays(
     batch: PulsarBatch,
     gwtheta,
@@ -299,6 +362,7 @@ def cgw_catalog_delays(
     psi,
     inc,
     pdist=1.0,
+    pphase=None,
     psr_term: bool = True,
     evolve: bool = True,
     phase_approx: bool = False,
@@ -310,90 +374,77 @@ def cgw_catalog_delays(
 
     Replaces the reference's numba prange + 1e7-source python chunking
     (deterministic.py:258-294, 321-440) with explicit memory tiling of the
-    (Nsrc x Nt) product. Two interchangeable backends:
+    (Nsrc x Nt) product. ``pdist`` (kpc) may be a scalar, (Ns,), or
+    (Np, Ns); ``pphase`` ((Ns,) or (Np, Ns)) overrides it with explicit
+    pulsar-term phases (reference deterministic.py:99-108). Two
+    interchangeable backends consume the same epoch-folded coefficient
+    planes (ops.pallas_cw.cw_catalog_planes — precomputed in float64 on
+    the host whenever the parameters are concrete, which is what makes
+    the float32 device path accurate; see the pallas_cw module docstring):
 
-    * ``"pallas"`` — the TPU kernel in ops.pallas_cw: a (Np, Nt/T, Ns/S)
+    * ``"pallas"`` — the TPU kernel in ops.pallas_cw: a (Nt/T, Ns/S)
       grid holding one (S, T) workspace tile in VMEM per program;
     * ``"scan"``   — a portable ``lax.scan`` over ``chunk``-sized source
       tiles (the (chunk x Nt) workspace stays VMEM-scale while the scan
       accumulates the (Np, Nt) sum).
 
-    ``"auto"`` picks pallas on TPU backends, scan elsewhere.
-    Deterministic (no key): source parameters are data.
+    ``"auto"`` picks pallas on TPU backends (after a one-shot compile
+    probe), scan elsewhere. Deterministic (no key): source parameters are
+    data.
     """
+    from ..ops.pallas_cw import cw_catalog_planes, cw_catalog_response
+
     dtype = batch.toas_s.dtype
-    # absolute-seconds times as the reference kernels use them
-    toas_abs = batch.toas_s + jnp.asarray(
-        batch.tref_mjd * 86400.0 - tref_s, dtype
+    # fold epoch: batch start, in absolute source-frame seconds. start_s
+    # is static metadata, so it stays concrete even when the arrays are
+    # traced; kernel times are fold-relative (|u| <~ observation span).
+    t_fold = batch.tref_mjd * 86400.0 - tref_s + batch.start_s
+    u = batch.toas_s - jnp.asarray(batch.start_s, dtype)
+
+    params = (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
+    tracer = jax.core.Tracer
+    host_ok = not any(
+        isinstance(x, tracer)
+        for x in (batch.phat, pdist, pphase, *params)
+        if x is not None
     )
+    if host_ok:
+        # float64 host precompute: the supported accurate path for f32
+        src_c, psr_c = cw_catalog_planes(
+            np.asarray(batch.phat, np.float64),
+            *[np.atleast_1d(np.asarray(x, np.float64)) for x in params],
+            pdist=np.asarray(pdist, np.float64),
+            pphase=None if pphase is None else np.asarray(pphase, np.float64),
+            t_fold=t_fold, evolve=evolve, phase_approx=phase_approx,
+            xp=np, dtype=dtype,
+        )
+    else:  # traced parameters: same formulas at ambient precision
+        src_c, psr_c = cw_catalog_planes(
+            batch.phat, *params, pdist=pdist, pphase=pphase,
+            t_fold=t_fold, evolve=evolve, phase_approx=phase_approx,
+            xp=jnp, dtype=dtype,
+        )
+
+    nsrc = src_c.shape[1]
     if backend == "auto":
         backend = (
             "pallas"
             if jax.default_backend() == "tpu"
             and _pallas_usable(
-                batch.npsr, batch.ntoa_max, jnp.asarray(gwtheta).shape[0],
-                dtype, psr_term, evolve, phase_approx,
+                batch.npsr, batch.ntoa_max, nsrc, dtype, psr_term, evolve
             )
             else "scan"
         )
     if backend not in ("pallas", "pallas_interpret", "scan"):
         raise ValueError(f"unknown CW-catalog backend {backend!r}")
     if backend in ("pallas", "pallas_interpret"):
-        from ..ops.pallas_cw import cw_catalog_coefficients, cw_catalog_response
-
-        src_c, psr_c = cw_catalog_coefficients(
-            batch.phat, gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc,
-            pdist=pdist, dtype=dtype,
+        out = cw_catalog_response(
+            u, src_c, psr_c, psr_term=psr_term, evolve=evolve,
+            interpret=backend == "pallas_interpret",
         )
-        return (
-            cw_catalog_response(
-                toas_abs,
-                src_c,
-                psr_c,
-                psr_term=psr_term,
-                evolve=evolve,
-                phase_approx=phase_approx,
-                interpret=backend == "pallas_interpret",
-            )
-            * batch.mask
-        )
-    params = [
-        jnp.asarray(x, dtype)
-        for x in (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
-    ]
-    nsrc = params[0].shape[0]
-    npad = (-nsrc) % chunk
-    params = [jnp.concatenate([p, jnp.zeros(npad, dtype)]) for p in params]
-    valid = jnp.concatenate([jnp.ones(nsrc, dtype), jnp.zeros(npad, dtype)])
-    nchunks = (nsrc + npad) // chunk
-    stacked = jnp.stack(params + [valid])  # (9, nsrc+pad)
-    tiles = stacked.reshape(9, nchunks, chunk).transpose(1, 0, 2)
-
-    per_psr = jax.vmap(
-        lambda toas, phat, tile: jnp.sum(
-            cw_delay(
-                toas,
-                phat,
-                *[tile[i] for i in range(8)],
-                pdist=pdist,
-                psr_term=psr_term,
-                evolve=evolve,
-                phase_approx=phase_approx,
-                nan_to_zero=True,
-                xp=jnp,
-            )
-            * tile[8][:, None],
-            axis=0,
-        ),
-        in_axes=(0, 0, None),
-    )
-
-    def step(carry, tile):
-        return carry + per_psr(toas_abs, batch.phat, tile), None
-
-    init = jnp.zeros(batch.toas_s.shape, dtype)
-    total, _ = jax.lax.scan(step, init, tiles)
-    return total * batch.mask
+    else:
+        out = _cw_scan_response(u, src_c, psr_c, psr_term, evolve, chunk)
+    return out * batch.mask
 
 
 def _batch_antenna(gwtheta, gwphi, phat):
@@ -498,6 +549,11 @@ class Recipe:
     #: (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc); deterministic,
     #: shared by every realization (the population-synthesis outliers)
     cgw_params: Optional[jax.Array] = None
+    #: CW-catalog pulsar distances [kpc]: scalar, (Ns,), or (Np, Ns)
+    cgw_pdist: Optional[jax.Array] = None
+    #: explicit CW-catalog pulsar-term phases ((Ns,) or (Np, Ns));
+    #: overrides cgw_pdist (reference deterministic.py:99-108)
+    cgw_pphase: Optional[jax.Array] = None
     #: (5,) burst-with-memory params (strain, gwtheta, gwphi, bwm_pol,
     #: t0_mjd)
     gwm_params: Optional[jax.Array] = None
@@ -519,6 +575,9 @@ class Recipe:
     gwb_howml: float = field(metadata=dict(static=True), default=10.0)
     cgw_tref_s: float = field(metadata=dict(static=True), default=0.0)
     cgw_chunk: int = field(metadata=dict(static=True), default=512)
+    cgw_psr_term: bool = field(metadata=dict(static=True), default=True)
+    cgw_evolve: bool = field(metadata=dict(static=True), default=True)
+    cgw_phase_approx: bool = field(metadata=dict(static=True), default=False)
     #: CW-catalog backend: "auto" (pallas on TPU, scan elsewhere),
     #: "pallas", "pallas_interpret", or "scan"
     cgw_backend: str = field(metadata=dict(static=True), default="auto")
@@ -605,6 +664,11 @@ def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
         total = total + cgw_catalog_delays(
             batch,
             *[recipe.cgw_params[i] for i in range(8)],
+            pdist=recipe.cgw_pdist if recipe.cgw_pdist is not None else 1.0,
+            pphase=recipe.cgw_pphase,
+            psr_term=recipe.cgw_psr_term,
+            evolve=recipe.cgw_evolve,
+            phase_approx=recipe.cgw_phase_approx,
             tref_s=recipe.cgw_tref_s,
             chunk=recipe.cgw_chunk,
             backend=recipe.cgw_backend,

@@ -6,22 +6,45 @@ deterministic.py:321-440, chunked at 1e7 sources at :258-264). Here the
 same product is tiled explicitly for the TPU memory hierarchy:
 
 * all O(Nsrc) and O(Np*Nsrc) coefficient math (antenna patterns, chirp
-  constants, polarization factors) is precomputed once by XLA — it is
-  tiny compared with the (Nsrc x Ntoa) product;
-* a Pallas kernel runs a (Np, Ntoa/T, Nsrc/S) grid; each program holds a
+  constants, polarization factors) is precomputed once — it is tiny
+  compared with the (Nsrc x Ntoa) product;
+* a Pallas kernel runs a (Ntoa/T, Nsrc/S) grid; each program holds a
   (S,) coefficient tile and a (T,) TOA tile in VMEM, materializes only
   the (S, T) workspace of its tile (the reference materializes the full
   (Nsrc, Ntoa) workspace per chunk), reduces over sources on the VPU,
   and accumulates into its (1, T) output block across the fastest-moving
   source-tile axis.
 
-The kernel covers all three evolution modes of the reference (full
-8/3-power chirp, phase approximation, monochromatic — deterministic.py:
-111-141) as static variants, with the merged-binary NaN->0 guard
-(deterministic.py:433-438) applied in-kernel via ``jnp.where``.
+Float32 accuracy by construction (the round-1 weakness: ~2% f32 error in
+evolve mode from ``(1 - chirp*t)^(-3/8)`` at absolute times t ~ 4.7e9 s):
+
+* every per-source/per-(pulsar, source) constant is *epoch-folded* — the
+  reference's absolute source-frame time axis is re-referenced to a fold
+  epoch ``t_fold`` (the batch start), exactly:
+  ``1 - chirp*t = y_f * (1 - chirp' * u)`` with ``u = t - t_fold``,
+  ``y_f = 1 - chirp*t_fold``, ``chirp' = chirp/y_f``, which maps the
+  evolve-mode phase/amplitude onto the *same closed form* with effective
+  constants (w0', chirp', phi0') evaluated at the fold epoch. The fold
+  runs in float64 on the host (:func:`cw_catalog_planes` with ``xp=np``),
+  so the device only ever sees |u| <~ 2e8 s;
+* the kernel evaluates the chirp factors through ``log1p``/``expm1``:
+  ``1 - y^{5/8} = -expm1(0.625*log1p(-chirp'*u))``, which is fully
+  accurate for small arguments where the naive form cancels
+  catastrophically in f32.
+
+The three evolution modes of the reference (full 8/3-power chirp, phase
+approximation, monochromatic — deterministic.py:111-141) collapse to two
+kernel variants: ``evolve`` (log1p chirp factors) and linear
+(``phi0 + rate*u``, covering both monochromatic and phase-approx, whose
+difference lives entirely in the plane precompute). The merged-binary
+NaN->0 guard (deterministic.py:433-438) is applied in-kernel via
+``jnp.where``; sources already merged at the fold epoch are zeroed by
+``valid=0`` at precompute (matching the reference, whose earth-term NaN
+poisons the source's whole response row).
 
 ``interpret=True`` runs the same kernel on CPU for tests; the scan-tiled
-jnp path in models.batched remains the portable fallback.
+jnp path in models.batched consumes the same planes as the portable
+fallback.
 """
 from __future__ import annotations
 
@@ -29,6 +52,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # TPU memory spaces; absent on CPU-only installs of older jaxlibs
@@ -41,20 +65,185 @@ except Exception:  # pragma: no cover
 
 from ..constants import KPC2S, MPC2S, SOLAR2S
 
-#: coefficient-plane order for the (NC_SRC, Ns) per-source operand
+#: per-source plane order of the (NC_SRC, Ns) earth-term operand
 _SRC_PLANES = (
-    "w0", "chirp_rate", "phase_norm", "amp_norm", "phi0_orb", "w053",
+    "phi0_e", "rate_e", "pn_e", "amp_e",
     "incfac1", "incfac2", "sin2psi", "cos2psi", "valid",
 )
 NC_SRC = len(_SRC_PLANES)
-#: coefficient-plane order for the (NC_PSR, Np, Ns) per-(pulsar, source)
-#: operand
-_PSR_PLANES = ("fplus", "fcross", "pd_term", "omega_p0")
+#: per-(pulsar, source) plane order of the (NC_PSR, Np, Ns) operand
+_PSR_PLANES = ("fplus", "fcross", "phi0_p", "rate_p", "pn_p", "amp_p")
 NC_PSR = len(_PSR_PLANES)
 
 
+def cw_catalog_planes(
+    phat,
+    gwtheta,
+    gwphi,
+    mc,
+    dist,
+    fgw,
+    phase0,
+    psi,
+    inc,
+    pdist=1.0,
+    pphase=None,
+    t_fold: float = 0.0,
+    evolve: bool = True,
+    phase_approx: bool = False,
+    xp=np,
+    dtype=None,
+):
+    """Epoch-folded coefficient planes for the CW-catalog kernels.
+
+    Parameters follow the reference API (deterministic.py:188-232): mc in
+    solar masses, dist in Mpc, fgw in Hz, pdist in kpc (scalar, (Ns,), or
+    (Np, Ns)), optional pphase (pulsar-term phase, (Ns,) or (Np, Ns) —
+    reference deterministic.py:99-108), angles in radians. ``t_fold`` is
+    the fold epoch in absolute source-frame seconds; kernel times are
+    ``u = t_abs - t_fold``.
+
+    With ``xp=np`` everything is computed in float64 on the host and cast
+    to ``dtype`` at the end — the supported way to run the kernels in
+    float32. With ``xp=jnp`` the same formulas trace (for tracer
+    parameters), at the ambient precision.
+
+    Returns ``(src (NC_SRC, Ns), psr (NC_PSR, Np, Ns))``.
+    """
+    f64 = np.float64 if xp is np else None
+    a = lambda v: xp.asarray(v, dtype=f64) if f64 else xp.asarray(v)
+    gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc = map(
+        a, (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
+    )
+    phat = a(phat)  # (Np, 3)
+
+    from ..models.cgw import principal_axes
+
+    m, n, omhat = principal_axes(gwtheta, gwphi, xp=xp)  # (Ns, 3) each
+    mp = phat @ m.T  # (Np, Ns)
+    np_ = phat @ n.T
+    op = phat @ omhat.T
+    fplus = 0.5 * (mp**2 - np_**2) / (1.0 + op)
+    fcross = mp * np_ / (1.0 + op)
+    cosmu = -op
+
+    mc_s = mc * SOLAR2S
+    w0 = xp.pi * fgw
+    phi0_orb = phase0 / 2.0
+    pn = 1.0 / 32.0 / mc_s ** (5.0 / 3.0)
+    amp = mc_s ** (5.0 / 3.0) / (dist * MPC2S)
+    chirp = 256.0 / 5.0 * mc_s ** (5.0 / 3.0) * w0 ** (8.0 / 3.0)
+    w053 = w0 ** (-5.0 / 3.0)
+
+    if pphase is not None:
+        pd_s = a(pphase) / (2.0 * xp.pi * fgw * (1.0 - cosmu))
+    else:
+        pd_s = a(pdist) * KPC2S
+        if pd_s.ndim < 2:
+            pd_s = xp.broadcast_to(pd_s, cosmu.shape)
+    pd_term = pd_s * (1.0 - cosmu)  # (Np, Ns) light-travel offset [s]
+
+    npsr = phat.shape[0]
+    ones = xp.ones_like(w0)
+
+    if evolve:
+        # earth term folded to t_fold; y_f <= 0 => merged before any
+        # observation => source zeroed via valid (the reference's earth
+        # NaN poisons the whole row, deterministic.py:433-438)
+        y_f = 1.0 - chirp * t_fold
+        valid = xp.where(y_f > 0.0, ones, xp.zeros_like(ones))
+        y_safe = xp.where(y_f > 0.0, y_f, ones)
+        w0e = w0 * y_safe ** (-3.0 / 8.0)
+        rate_e = chirp / y_safe
+        pn_e = pn * w0e ** (-5.0 / 3.0)
+        amp_e = amp * w0e ** (-1.0 / 3.0)
+        phi0_e = xp.mod(phi0_orb + pn * (w053 - w0e ** (-5.0 / 3.0)), xp.pi)
+
+        # pulsar term: tp = t - pd_term, so y at the fold epoch is larger
+        # (earlier emission) and positive whenever y_f is
+        y_fp = y_safe + chirp * pd_term  # (Np, Ns)
+        w0p = w0 * y_fp ** (-3.0 / 8.0)
+        rate_p = chirp / y_fp
+        pn_p = pn * w0p ** (-5.0 / 3.0)
+        amp_p = amp * w0p ** (-1.0 / 3.0)
+        phi0_p = xp.mod(phi0_orb + pn * (w053 - w0p ** (-5.0 / 3.0)), xp.pi)
+    elif phase_approx:
+        valid = ones
+        rate_e = w0 * ones
+        pn_e = xp.zeros_like(ones)
+        amp_e = amp * w0 ** (-1.0 / 3.0)
+        phi0_e = xp.mod(phi0_orb + w0 * t_fold, xp.pi)
+
+        # constant pulsar-term frequency from the light-travel offset
+        # (reference deterministic.py:122-130)
+        omega_p = w0 * (1.0 + chirp * pd_term) ** (-3.0 / 8.0)
+        rate_p = omega_p
+        pn_p = xp.zeros_like(omega_p)
+        amp_p = amp * omega_p ** (-1.0 / 3.0)
+        phi0_p = xp.mod(
+            phi0_orb
+            + pn * (w053 - omega_p ** (-5.0 / 3.0))
+            + omega_p * t_fold,
+            xp.pi,
+        )
+    else:  # monochromatic
+        valid = ones
+        rate_e = w0 * ones
+        pn_e = xp.zeros_like(ones)
+        amp_e = amp * w0 ** (-1.0 / 3.0)
+        phi0_e = xp.mod(phi0_orb + w0 * t_fold, xp.pi)
+
+        rate_p = xp.broadcast_to(w0, pd_term.shape)
+        pn_p = xp.zeros_like(pd_term)
+        amp_p = xp.broadcast_to(amp_e, pd_term.shape)
+        phi0_p = xp.mod(phi0_orb + w0 * (t_fold - pd_term), xp.pi)
+
+    src = xp.stack(
+        [
+            phi0_e,
+            rate_e,
+            pn_e,
+            amp_e,
+            0.5 * (3.0 + xp.cos(2.0 * inc)),
+            2.0 * xp.cos(inc),
+            xp.sin(2.0 * psi),
+            xp.cos(2.0 * psi),
+            valid,
+        ]
+    )
+    bc = lambda v: xp.broadcast_to(v, (npsr,) + v.shape[-1:]) if v.ndim < 2 else v
+    psr = xp.stack(
+        [fplus, fcross, bc(phi0_p), bc(rate_p), bc(pn_p), bc(amp_p)]
+    )
+    if dtype is not None:
+        src = jnp.asarray(src, dtype)
+        psr = jnp.asarray(psr, dtype)
+    return src, psr
+
+
+def _term_response(u, phi0, rate, pn, amp, evolve):
+    """Phase/amplitude of one term (earth or pulsar) at fold-relative
+    times ``u``; all operands broadcast (S, T)."""
+    if evolve:
+        l = jnp.log1p(-rate * u)  # NaN past merger -> NaN->0 guard
+        phase = phi0 + pn * (-jnp.expm1(0.625 * l))
+        alpha = amp * jnp.exp(0.125 * l)
+    else:
+        phase = phi0 + rate * u
+        alpha = amp
+    return phase, alpha
+
+
+def _polarized(phase, alpha, inc1, inc2, s2p, c2p):
+    At = jnp.sin(2.0 * phase) * inc1
+    Bt = jnp.cos(2.0 * phase) * inc2
+    rplus = alpha * (At * c2p + Bt * s2p)
+    rcross = alpha * (Bt * c2p - At * s2p)
+    return rplus, rcross
+
+
 def _cw_kernel(toas_ref, src_ref, psrc_ref, out_ref, *, npsr, psr_term,
-               evolve, phase_approx):
+               evolve):
     """One (toa-tile t, source-tile s) program: for each pulsar row,
     materialize its (S, T) response tile, reduce over sources, and
     accumulate (1, T) into the output row across the fastest-moving
@@ -69,67 +258,29 @@ def _cw_kernel(toas_ref, src_ref, psrc_ref, out_ref, *, npsr, psr_term,
     def sp(name):  # per-source coefficient column vector (S, 1)
         return src_ref[_SRC_PLANES.index(name), :][:, None]
 
-    w0 = sp("w0")
-    phi0 = sp("phi0_orb")
-    s2p, c2p = sp("sin2psi"), sp("cos2psi")
+    phi0_e, rate_e = sp("phi0_e"), sp("rate_e")
+    pn_e, amp_e = sp("pn_e"), sp("amp_e")
     inc1, inc2 = sp("incfac1"), sp("incfac2")
-    amp = sp("amp_norm")
+    s2p, c2p = sp("sin2psi"), sp("cos2psi")
     valid = sp("valid")
-    chirp = sp("chirp_rate")
-    # per-source constants hoisted out of the (S, T) workspace math:
-    # phase = phi0 + pn (w0^{-5/3} - omega^{-5/3}) with
-    # omega^{-5/3} = w0^{-5/3} y^{5/8}, y = 1 - chirp t, so
-    # phase = phi0 + pn w0^{-5/3} (1 - y^{5/8}); likewise
-    # alpha = amp omega^{-1/3} = amp w0^{-1/3} y^{1/8}. One log+exp then
-    # gives y^{1/8}; y^{5/8} is its fifth power — replacing three
-    # fractional pows (6 transcendentals) per time series with 2.
-    pn_w53 = sp("phase_norm") * sp("w053")
-    amp_w13 = amp * w0 ** (-1.0 / 3.0)
-
-    def chirp_factors(tt):
-        # Past-merger times give y < 0: log -> NaN, propagating to the
-        # response, caught by the NaN->0 guard (as in the reference
-        # kernels, deterministic.py:433-438).
-        z = jnp.exp(0.125 * jnp.log(1.0 - chirp * tt))  # y^{1/8}
-        z2 = z * z
-        phase = phi0 + pn_w53 * (1.0 - z2 * z2 * z)
-        return phase, amp_w13 * z
 
     def row(i):
-        t = toas_ref[pl.ds(i, 1), :]  # (1, T)
+        u = toas_ref[pl.ds(i, 1), :]  # (1, T)
 
         def pp(name):  # per-(pulsar i, source) column vector (S, 1)
             return psrc_ref[_PSR_PLANES.index(name), i, :][:, None]
 
-        tp = t - pp("pd_term")
-        if evolve:
-            phase, alpha = chirp_factors(t)
-            phase_p, alpha_p = chirp_factors(tp)
-        elif phase_approx:
-            wp = pp("omega_p0")
-            phase = phi0 + w0 * t
-            phase_p = (
-                phi0
-                + sp("phase_norm") * (sp("w053") - wp ** (-5.0 / 3.0))
-                + wp * t
-            )
-            alpha = amp_w13
-            alpha_p = amp * wp ** (-1.0 / 3.0)
-        else:
-            phase = phi0 + w0 * t
-            phase_p = phi0 + w0 * tp
-            alpha = alpha_p = amp_w13
-
-        At = jnp.sin(2.0 * phase) * inc1
-        Bt = jnp.cos(2.0 * phase) * inc2
-        rplus = alpha * (At * c2p + Bt * s2p)
-        rcross = alpha * (Bt * c2p - At * s2p)
+        phase, alpha = _term_response(u, phi0_e, rate_e, pn_e, amp_e, evolve)
+        rplus, rcross = _polarized(phase, alpha, inc1, inc2, s2p, c2p)
 
         if psr_term:
-            At_p = jnp.sin(2.0 * phase_p) * inc1
-            Bt_p = jnp.cos(2.0 * phase_p) * inc2
-            rplus_p = alpha_p * (At_p * c2p + Bt_p * s2p)
-            rcross_p = alpha_p * (Bt_p * c2p - At_p * s2p)
+            phase_p, alpha_p = _term_response(
+                u, pp("phi0_p"), pp("rate_p"), pp("pn_p"), pp("amp_p"),
+                evolve,
+            )
+            rplus_p, rcross_p = _polarized(
+                phase_p, alpha_p, inc1, inc2, s2p, c2p
+            )
             res = pp("fplus") * (rplus_p - rplus) + pp("fcross") * (
                 rcross_p - rcross
             )
@@ -150,84 +301,29 @@ def _cw_kernel(toas_ref, src_ref, psrc_ref, out_ref, *, npsr, psr_term,
     jax.lax.fori_loop(0, npsr, body, 0)
 
 
-def cw_catalog_coefficients(phat, gwtheta, gwphi, mc, dist, fgw, phase0,
-                            psi, inc, pdist=1.0, dtype=None):
-    """XLA-side precompute of every O(Ns)/O(Np*Ns) coefficient the kernel
-    needs. Returns (src_coeffs (NC_SRC, Ns), psr_coeffs (NC_PSR, Np, Ns)).
-
-    Same math as models.cgw.cw_delay's prologue (reference
-    deterministic.py:66-108); kept in the caller's dtype.
-    """
-    if dtype is None:
-        dtype = jnp.asarray(phat).dtype
-    f = lambda x: jnp.asarray(x, dtype)
-    gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc = map(
-        f, (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
-    )
-    phat = f(phat)  # (Np, 3)
-
-    from ..models.cgw import principal_axes
-
-    m, n, omhat = principal_axes(gwtheta, gwphi, xp=jnp)  # (Ns, 3) each
-    mp = phat @ m.T  # (Np, Ns)
-    np_ = phat @ n.T
-    op = phat @ omhat.T
-    fplus = 0.5 * (mp**2 - np_**2) / (1.0 + op)
-    fcross = mp * np_ / (1.0 + op)
-    cosmu = -op
-
-    mc_s = mc * SOLAR2S
-    w0 = jnp.pi * fgw
-    chirp_rate = 256.0 / 5.0 * mc_s ** (5.0 / 3.0) * w0 ** (8.0 / 3.0)
-    pd_s = f(pdist) * KPC2S
-    pd_term = jnp.broadcast_to(pd_s, cosmu.shape) * (1.0 - cosmu)
-    # pulsar-term frequency of the phase-approx mode (constant per
-    # pulsar-source pair, reference deterministic.py:124-126)
-    omega_p0 = w0 * (1.0 + chirp_rate * pd_term) ** (-3.0 / 8.0)
-
-    src = jnp.stack(
-        [
-            w0,
-            chirp_rate,
-            1.0 / 32.0 / mc_s ** (5.0 / 3.0),
-            mc_s ** (5.0 / 3.0) / (dist * MPC2S),
-            phase0 / 2.0,
-            w0 ** (-5.0 / 3.0),
-            0.5 * (3.0 + jnp.cos(2.0 * inc)),
-            2.0 * jnp.cos(inc),
-            jnp.sin(2.0 * psi),
-            jnp.cos(2.0 * psi),
-            jnp.ones_like(w0),
-        ]
-    )
-    psr = jnp.stack([fplus, fcross, pd_term, omega_p0])
-    return src, psr
-
-
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "psr_term", "evolve", "phase_approx", "src_tile", "toa_tile",
-        "interpret",
+        "psr_term", "evolve", "src_tile", "toa_tile", "interpret",
     ),
 )
 def cw_catalog_response(
-    toas_abs,
+    toas_rel,
     src_coeffs,
     psr_coeffs,
     psr_term: bool = True,
     evolve: bool = True,
-    phase_approx: bool = False,
     src_tile: int = 128,
     toa_tile: int = 1024,
     interpret: bool = False,
 ):
     """Summed CW response (Np, Nt) of the whole catalog via the Pallas
-    kernel. ``toas_abs``: (Np, Nt) seconds on the source-frame reference;
-    coefficient operands from :func:`cw_catalog_coefficients`."""
-    npsr, ntoa = toas_abs.shape
+    kernel. ``toas_rel``: (Np, Nt) seconds relative to the fold epoch the
+    planes were built with; coefficient operands from
+    :func:`cw_catalog_planes`."""
+    npsr, ntoa = toas_rel.shape
     nsrc = src_coeffs.shape[1]
-    dtype = toas_abs.dtype
+    dtype = toas_rel.dtype
 
     src_tile = min(src_tile, max(8, nsrc))
     toa_tile = min(toa_tile, max(128, ntoa))
@@ -237,12 +333,11 @@ def cw_catalog_response(
     # finite garbage sliced off below
     src_coeffs = jnp.pad(src_coeffs, ((0, 0), (0, ns_pad)))
     psr_coeffs = jnp.pad(psr_coeffs, ((0, 0), (0, 0), (0, ns_pad)))
-    toas_abs = jnp.pad(toas_abs, ((0, 0), (0, nt_pad)))
+    toas_rel = jnp.pad(toas_rel, ((0, 0), (0, nt_pad)))
     nsp, ntp = nsrc + ns_pad, ntoa + nt_pad
 
     kernel = functools.partial(
         _cw_kernel, npsr=npsr, psr_term=psr_term, evolve=evolve,
-        phase_approx=phase_approx,
     )
     grid = (ntp // toa_tile, nsp // src_tile)
     mem = {} if _VMEM is None else dict(memory_space=_VMEM)
@@ -259,5 +354,5 @@ def cw_catalog_response(
         ],
         out_specs=pl.BlockSpec((npsr, toa_tile), lambda t, s: (0, t), **mem),
         interpret=interpret,
-    )(toas_abs, src_coeffs, psr_coeffs)
+    )(toas_rel, src_coeffs, psr_coeffs)
     return out[:, :ntoa]

@@ -206,6 +206,57 @@ def test_cgw_catalog_matches_oracle(batch):
 
 
 @pytest.mark.parametrize(
+    "pskw",
+    [
+        dict(pdist="per_source"),
+        dict(pphase="per_source"),
+        dict(pphase="per_source", mode=dict(evolve=False, phase_approx=True)),
+        dict(pphase="per_source", mode=dict(evolve=False, phase_approx=False)),
+    ],
+    ids=["pdist-vec", "pphase-evolve", "pphase-approx", "pphase-mono"],
+)
+def test_cgw_catalog_pphase_pdist_matches_oracle(batch, pskw):
+    """Per-source pulsar distances and explicit pulsar-term phases agree
+    with the oracle path (reference deterministic.py:99-108) in every
+    evolution mode."""
+    b, psrs = batch
+    n = 40
+    rng = np.random.default_rng(15)
+    cat = dict(
+        gwtheta=np.arccos(rng.uniform(-1, 1, n)),
+        gwphi=rng.uniform(0, 2 * np.pi, n),
+        mc=10 ** rng.uniform(8, 9.4, n),
+        dist=rng.uniform(10, 500, n),
+        fgw=10 ** rng.uniform(-8.8, -7.6, n),
+        phase0=rng.uniform(0, 2 * np.pi, n),
+        psi=rng.uniform(0, np.pi, n),
+        inc=np.arccos(rng.uniform(-1, 1, n)),
+    )
+    pskw = dict(pskw)
+    mode = pskw.pop("mode", {})
+    kw = {k: rng.uniform(0.4, 3.0, n) if k == "pdist"
+          else rng.uniform(0, 2 * np.pi, n) for k in pskw}
+    tref = 53000 * 86400
+    dev = B.cgw_catalog_delays(b, *cat.values(), tref_s=tref, **kw, **mode)
+    sig = f"cw_pp_{'-'.join(sorted(kw))}_{sorted(mode.items())}"
+    for i, p in enumerate(psrs):
+        add_catalog_of_cws(
+            p,
+            gwtheta_list=cat["gwtheta"], gwphi_list=cat["gwphi"],
+            mc_list=cat["mc"], dist_list=cat["dist"], fgw_list=cat["fgw"],
+            phase0_list=cat["phase0"], psi_list=cat["psi"],
+            inc_list=cat["inc"], tref=tref, signal_name=sig,
+            evolve=mode.get("evolve", True),
+            phase_approx=mode.get("phase_approx", False),
+            **kw,
+        )
+        oracle = p.added_signals_time[f"{p.name}_{sig}"]
+        np.testing.assert_allclose(
+            np.asarray(dev[i]), oracle, rtol=1e-8, atol=1e-15
+        )
+
+
+@pytest.mark.parametrize(
     "mode",
     [
         dict(evolve=True, phase_approx=False),

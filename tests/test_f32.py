@@ -1,0 +1,226 @@
+"""Float32 is the production dtype on TPU (f64 is emulated and slow), so
+every device op needs f32 accuracy evidence against its f64 form — the
+round-1 gap flagged in VERDICT.md ("production dtype is never tested";
+the evolve-mode CW catalog had a ~2% systematic f32 error from
+absolute-time chirp cancellation, fixed by the epoch-folded planes in
+ops.pallas_cw).
+
+Deterministic ops are compared f32-vs-f64 directly; stochastic ops are
+validated statistically at f32 (their f32/f64 draws are different bit
+streams by construction).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models import batched as B
+
+
+def _rel_rms(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    return float(np.sqrt(np.mean((a - b) ** 2) / np.mean(b**2)))
+
+
+@pytest.fixture(scope="module")
+def batches():
+    b64 = synthetic_batch(npsr=8, ntoa=1024, nbackend=3, seed=3,
+                          dtype=jnp.float64)
+    return b64, b64.astype(jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    n = 60
+    rng = np.random.default_rng(11)
+    return dict(
+        gwtheta=np.arccos(rng.uniform(-1, 1, n)),
+        gwphi=rng.uniform(0, 2 * np.pi, n),
+        mc=10 ** rng.uniform(8, 9.5, n),
+        dist=rng.uniform(20, 500, n),
+        fgw=10 ** rng.uniform(-8.8, -7.5, n),
+        phase0=rng.uniform(0, 2 * np.pi, n),
+        psi=rng.uniform(0, np.pi, n),
+        inc=np.arccos(rng.uniform(-1, 1, n)),
+    )
+
+
+@pytest.mark.parametrize(
+    "mode",
+    [
+        dict(evolve=True, phase_approx=False),
+        dict(evolve=False, phase_approx=True),
+        dict(evolve=False, phase_approx=False),
+    ],
+    ids=["evolve", "phase_approx", "mono"],
+)
+@pytest.mark.parametrize("backend", ["scan", "pallas_interpret"])
+def test_cw_catalog_f32_accuracy(batches, catalog, mode, backend):
+    """The VERDICT.md round-2 'done' criterion: f32 CW catalog matches
+    f64 to <1e-3 relative rms in every evolution mode (the folded planes
+    give ~1e-5; round 1 was ~2% in evolve mode)."""
+    b64, b32 = batches
+    tref = 53000 * 86400.0
+    kw = dict(tref_s=tref, pdist=1.2, backend=backend, **mode)
+    d64 = B.cgw_catalog_delays(b64, *catalog.values(), **kw)
+    d32 = B.cgw_catalog_delays(b32, *catalog.values(), **kw)
+    assert d32.dtype == jnp.float32
+    assert _rel_rms(d32, d64) < 1e-3
+
+
+def test_cw_catalog_f32_pphase_pdist_vectors(batches, catalog):
+    """Per-source pdist and explicit pphase stay f32-accurate too."""
+    b64, b32 = batches
+    n = len(catalog["mc"])
+    rng = np.random.default_rng(12)
+    pdist = rng.uniform(0.3, 3.0, n)
+    pphase = rng.uniform(0, 2 * np.pi, n)
+    for kw in (dict(pdist=pdist), dict(pphase=pphase)):
+        d64 = B.cgw_catalog_delays(b64, *catalog.values(), **kw)
+        d32 = B.cgw_catalog_delays(b32, *catalog.values(), **kw)
+        assert _rel_rms(d32, d64) < 1e-3
+
+
+def test_gw_memory_f32(batches):
+    b64, b32 = batches
+    args = dict(strain=5e-15, gwtheta=1.1, gwphi=2.3, bwm_pol=0.7,
+                t0_mjd=55500.0)
+    d64 = B.gw_memory_delays(b64, **args)
+    d32 = B.gw_memory_delays(b32, **args)
+    assert _rel_rms(d32, d64) < 1e-5
+
+
+def test_burst_f32(batches):
+    b64, b32 = batches
+    g = np.linspace(0, 1, 256)
+    hp, hc = 1e-13 * np.sin(9 * g) * g, 1e-13 * np.cos(7 * g) * g
+    span = float(b64.tspan_s[0])
+    args = dict(gwtheta=0.9, gwphi=1.0, hplus_grid=hp, hcross_grid=hc,
+                grid_start_s=-span / 4, grid_stop_s=span / 4, psi=0.4)
+    d64 = B.burst_delays(b64, **args)
+    d32 = B.burst_delays(b32, **args)
+    assert _rel_rms(d32, d64) < 1e-4
+
+
+def test_transient_f32(batches):
+    b64, b32 = batches
+    wf = 1e-7 * np.hanning(128)
+    span = float(b64.tspan_s[0])
+    args = dict(psr_index=2, waveform_grid=wf, grid_start_s=-span / 8,
+                grid_stop_s=span / 8)
+    d64 = B.transient_delays(b64, **args)
+    d32 = B.transient_delays(b32, **args)
+    assert _rel_rms(d32, d64 + 1e-300) < 1e-4
+
+
+def test_quadratic_fit_f32(batches):
+    """The refit projection (normalized time basis) stays well
+    conditioned in f32."""
+    b64, b32 = batches
+    key = jax.random.PRNGKey(7)
+    d64 = B.red_noise_delays(key, b64, -13.5, 4.0)
+    d32 = d64.astype(jnp.float32)
+    f64 = B.quadratic_fit_subtract(d64, b64)
+    f32 = B.quadratic_fit_subtract(d32, b32)
+    assert _rel_rms(f32, f64) < 1e-3
+
+
+def test_white_noise_f32_statistics(batches):
+    """Stochastic op at f32: per-TOA variance matches the analytic
+    EFAC/EQUAD expectation."""
+    _, b32 = batches
+    keys = jax.random.split(jax.random.PRNGKey(1), 2000)
+    d = jax.vmap(
+        lambda k: B.white_noise_delays(k, b32, efac=1.4, log10_equad=-6.2)
+    )(keys)
+    assert d.dtype == jnp.float32
+    var = np.var(np.asarray(d), axis=0)
+    expect = 1.4**2 * np.asarray(b32.errors_s) ** 2 + 1.4**2 * (10**-6.2) ** 2
+    np.testing.assert_allclose(var, expect, rtol=0.2)
+
+
+def test_red_noise_f32_statistics(batches):
+    """Red-noise delay variance at f32 matches the f64 op's variance to a
+    few percent over realizations (same physics, different draws)."""
+    b64, b32 = batches
+    keys = jax.random.split(jax.random.PRNGKey(2), 600)
+    v32 = np.var(
+        np.asarray(
+            jax.vmap(lambda k: B.red_noise_delays(k, b32, -13.8, 3.8))(keys)
+        )
+    )
+    v64 = np.var(
+        np.asarray(
+            jax.vmap(lambda k: B.red_noise_delays(k, b64, -13.8, 3.8))(keys)
+        )
+    )
+    assert abs(v32 / v64 - 1.0) < 0.15
+
+
+def test_gwb_f32_statistics(batches):
+    """GWB realization rms at f32 agrees with f64 statistically, and the
+    cross-pulsar mix stays finite/masked."""
+    b64, b32 = batches
+    orf = np.sqrt(2.0) * np.eye(8)
+    keys = jax.random.split(jax.random.PRNGKey(3), 64)
+
+    def rms(b, dtype):
+        d = jax.vmap(
+            lambda k: B.gwb_delays(
+                k, b, -14.0, 4.33, jnp.asarray(orf, dtype), npts=300,
+                howml=4,
+            )
+        )(keys)
+        assert bool(jnp.all(jnp.isfinite(d)))
+        return float(jnp.sqrt(jnp.mean(d**2)))
+
+    r32 = rms(b32, jnp.float32)
+    r64 = rms(b64, jnp.float64)
+    assert abs(r32 / r64 - 1.0) < 0.1
+
+
+def test_full_recipe_f32_realize(batches):
+    """End-to-end realize() in f32: finite, right dtype, rms within a few
+    percent of the f64 run (statistical)."""
+    b64, b32 = batches
+    rng = np.random.default_rng(4)
+    ncw = 20
+    cat = np.stack(
+        [
+            np.arccos(rng.uniform(-1, 1, ncw)),
+            rng.uniform(0, 2 * np.pi, ncw),
+            10 ** rng.uniform(8, 9.3, ncw),
+            rng.uniform(50, 800, ncw),
+            10 ** rng.uniform(-8.8, -7.8, ncw),
+            rng.uniform(0, 2 * np.pi, ncw),
+            rng.uniform(0, np.pi, ncw),
+            np.arccos(rng.uniform(-1, 1, ncw)),
+        ]
+    )
+    orf = np.sqrt(2.0) * np.eye(8)
+
+    def run(b, dtype):
+        recipe = B.Recipe(
+            efac=jnp.asarray(1.1, dtype),
+            log10_equad=jnp.asarray(-6.5, dtype),
+            log10_ecorr=jnp.asarray(-6.8, dtype),
+            rn_log10_amplitude=jnp.asarray(-14.0, dtype),
+            rn_gamma=jnp.asarray(4.0, dtype),
+            gwb_log10_amplitude=jnp.asarray(-14.2, dtype),
+            gwb_gamma=jnp.asarray(4.33, dtype),
+            orf_cholesky=jnp.asarray(orf, dtype),
+            cgw_params=jnp.asarray(cat, dtype),
+            gwb_npts=300,
+            gwb_howml=4.0,
+        )
+        return B.realize(jax.random.PRNGKey(9), b, recipe, nreal=32,
+                         fit=True)
+
+    r32 = run(b32, jnp.float32)
+    r64 = run(b64, jnp.float64)
+    assert r32.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(r32)))
+    rms32 = float(jnp.sqrt(jnp.mean(r32**2)))
+    rms64 = float(jnp.sqrt(jnp.mean(r64**2)))
+    assert abs(rms32 / rms64 - 1.0) < 0.1
