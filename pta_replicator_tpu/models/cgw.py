@@ -257,6 +257,12 @@ def add_catalog_of_cws(
     ]
     nsrc = params[2].size
     ntoa = toas_s.size
+    # per-source pdist/pphase vectors must be chunk-sliced with the params
+    pdist_v = np.atleast_1d(np.asarray(pdist, dtype=np.float64))
+    pphase_v = (
+        None if pphase is None
+        else np.atleast_1d(np.asarray(pphase, dtype=np.float64))
+    )
     # bound the (sources x toas) workspace at ~2e7 elements
     step = max(1, min(chunk_size, int(2e7) // max(ntoa, 1)))
     total = np.zeros(ntoa)
@@ -266,8 +272,11 @@ def add_catalog_of_cws(
             toas_s,
             phat,
             *[p[sl] for p in params],
-            pdist=pdist,
-            pphase=pphase,
+            pdist=pdist_v[sl] if pdist_v.size > 1 else pdist_v,
+            pphase=(
+                None if pphase_v is None
+                else (pphase_v[sl] if pphase_v.size > 1 else pphase_v)
+            ),
             psr_term=psrTerm,
             evolve=evolve,
             phase_approx=phase_approx,

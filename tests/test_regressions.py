@@ -192,3 +192,39 @@ def test_split_population_drops_zero_weight_outliers():
     fobs = np.array([1e-9, 1e-8])
     split = split_population(vals, weights, fobs, 1e8, outlier_per_bin=4)
     assert split.outlier_fo.size == 1  # zero-weight entries filtered
+
+
+def test_cw_catalog_vector_pdist_pphase_chunked():
+    """Per-source pdist/pphase vectors must be sliced with the source
+    chunks (review finding: unsliced vectors broadcast-crashed — or worse,
+    misaligned — for catalogs larger than one chunk)."""
+    from pta_replicator_tpu.models.cgw import add_catalog_of_cws
+
+    psr = load_pulsar(PAR, TIM)
+    make_ideal(psr)
+    n = 50
+    rng = np.random.default_rng(1)
+    cat = dict(
+        gwtheta_list=np.arccos(rng.uniform(-1, 1, n)),
+        gwphi_list=rng.uniform(0, 2 * np.pi, n),
+        mc_list=10 ** rng.uniform(8, 9.4, n),
+        dist_list=rng.uniform(10, 500, n),
+        fgw_list=10 ** rng.uniform(-8.8, -7.6, n),
+        phase0_list=rng.uniform(0, 2 * np.pi, n),
+        psi_list=rng.uniform(0, np.pi, n),
+        inc_list=np.arccos(rng.uniform(-1, 1, n)),
+    )
+    for kw in (
+        dict(pdist=rng.uniform(0.4, 3.0, n)),
+        dict(pphase=rng.uniform(0, 2 * np.pi, n)),
+    ):
+        name = next(iter(kw))
+        add_catalog_of_cws(psr, **cat, **kw, chunk_size=7,
+                           signal_name=f"{name}_chunked")
+        add_catalog_of_cws(psr, **cat, **kw, chunk_size=10**6,
+                           signal_name=f"{name}_whole")
+        np.testing.assert_allclose(
+            psr.added_signals_time[f"{psr.name}_{name}_chunked"],
+            psr.added_signals_time[f"{psr.name}_{name}_whole"],
+            rtol=1e-9,
+        )
