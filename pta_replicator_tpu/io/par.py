@@ -87,6 +87,10 @@ class ParModel:
             self.pepoch_mjd = value
         elif key == "DM":
             self.dm = value
+        elif key == "RAJ":  # decimal hours (colon-free floats re-parse fine)
+            self.raj_hours = value
+        elif key == "DECJ":  # decimal degrees
+            self.decj_deg = value
         updated = False
         for i, line in enumerate(self.lines):
             tokens = line.split()

@@ -31,6 +31,13 @@ from .gwb import (
 )
 
 
+#: Version of the op suite's PRNG stream contract. Bump whenever any
+#: op's key-consumption order or draw layout changes (e.g. the red-noise
+#: coefficient interleave), so resumable sweeps checkpointed under a
+#: different stream refuse to resume instead of silently mixing streams.
+STREAM_VERSION = 2
+
+
 def _per_toa(params, index, mask):
     """Gather per-backend parameters onto TOAs: (Np, NB) -> (Np, Nt)."""
     params = jnp.asarray(params)
@@ -90,6 +97,66 @@ def jitter_delays(key, batch: PulsarBatch, log10_ecorr):
     return jnp.take_along_axis(val, batch.epoch_index, axis=1) * batch.mask
 
 
+def red_noise_basis_prior(
+    batch: PulsarBatch,
+    log10_amplitude,
+    gamma,
+    nmodes: int = 30,
+    modes=None,
+    logf: bool = False,
+    fmin=None,
+    fmax=None,
+    phase_shift=None,
+    libstempo_convention: bool = False,
+    tspan_s=None,
+):
+    """Per-pulsar Fourier basis and power-law prior for the device path,
+    with the full option surface of the reference's design matrix
+    (reference red_noise.py:36-103): default k/T grids, log/linear
+    fmin-fmax spacing, explicit modes, per-mode phase shifts, and the
+    libstempo convention ([cos, sin] column order, times referenced to
+    each pulsar's first TOA).
+
+    Returns ``(F (Np, Nt, 2K), prior (Np, 2K))`` with sin/cos columns
+    interleaved per frequency exactly like the oracle basis
+    (ops.fourier.fourier_basis), so a shared coefficient stream produces
+    identical delays on both paths.
+    """
+    from ..ops.fourier import (
+        fourier_basis,
+        fourier_frequencies,
+        powerlaw_prior,
+    )
+
+    dtype = batch.toas_s.dtype
+    log10_amplitude = jnp.broadcast_to(
+        jnp.asarray(log10_amplitude, dtype), (batch.npsr,)
+    )
+    gamma = jnp.broadcast_to(jnp.asarray(gamma, dtype), (batch.npsr,))
+    T = batch.tspan_s if tspan_s is None else jnp.broadcast_to(
+        jnp.asarray(tspan_s, dtype), (batch.npsr,)
+    )
+    freqs = fourier_frequencies(
+        T, nmodes=nmodes, logf=logf, fmin=fmin, fmax=fmax, modes=modes,
+        xp=jnp,
+    )
+    freqs = jnp.broadcast_to(
+        jnp.asarray(freqs, dtype), (batch.npsr, freqs.shape[-1])
+    )
+    shift = (
+        None if phase_shift is None
+        else jnp.broadcast_to(jnp.asarray(phase_shift, dtype), freqs.shape)
+    )
+    F = fourier_basis(
+        batch.toas_s, freqs, phase_shift=shift,
+        libstempo_convention=libstempo_convention, xp=jnp,
+    )
+    prior2 = powerlaw_prior(
+        jnp.repeat(freqs, 2, axis=-1), log10_amplitude, gamma, T, xp=jnp
+    )
+    return F, prior2
+
+
 def red_noise_delays(
     key,
     batch: PulsarBatch,
@@ -97,42 +164,43 @@ def red_noise_delays(
     gamma,
     nmodes: int = 30,
     modes=None,
+    logf: bool = False,
+    fmin=None,
+    fmax=None,
+    pshift: bool = False,
+    phase_shift=None,
+    libstempo_convention: bool = False,
+    tspan_s=None,
+    eps=None,
 ):
     """Per-pulsar power-law red noise on the rank-reduced Fourier basis.
 
     The (Np, Nt, 2K) basis is built in-kernel from the frozen times (cheap,
-    XLA fuses the trig into the MXU contraction); frequencies are k/Tspan
-    per pulsar. Times are referenced to the batch epoch (a per-mode phase
-    convention — statistically identical to the oracle's absolute-time
-    convention, reference red_noise.py:92-101).
+    XLA fuses the trig into the MXU contraction). Accepts everything the
+    oracle ``add_red_noise`` / reference design matrix does: explicit
+    ``modes``, log/linear ``fmin``-``fmax`` grids, random per-mode phase
+    shifts (``pshift``, drawn from ``key``; or explicit via
+    ``phase_shift``), ``libstempo_convention``, and a ``tspan_s``
+    override. ``eps`` injects an explicit (Np, 2K) coefficient stream
+    (oracle-equivalence tests; normally drawn from ``key``).
     """
     dtype = batch.toas_s.dtype
-    log10_amplitude = jnp.broadcast_to(jnp.asarray(log10_amplitude, dtype), (batch.npsr,))
-    gamma = jnp.broadcast_to(jnp.asarray(gamma, dtype), (batch.npsr,))
-    if modes is not None:
-        # explicit mode frequencies [Hz], shared across the array
-        # (oracle analog red_noise.add_red_noise(modes=...),
-        # reference red_noise.py:71-74)
-        freqs = jnp.broadcast_to(
-            jnp.asarray(modes, dtype)[None, :], (batch.npsr, len(modes))
+    if pshift and phase_shift is None:
+        k_eps, k_shift = jax.random.split(key)
+        nm = nmodes if modes is None else len(modes)
+        phase_shift = jax.random.uniform(
+            k_shift, (batch.npsr, nm), dtype, 0.0, 2.0 * jnp.pi
         )
     else:
-        k = jnp.arange(1, nmodes + 1, dtype=dtype)
-        freqs = k[None, :] / batch.tspan_s[:, None]  # (Np, K)
-    arg = 2.0 * jnp.pi * freqs[:, None, :] * batch.toas_s[:, :, None]
-    F = jnp.concatenate([jnp.sin(arg), jnp.cos(arg)], axis=-1)  # (Np, Nt, 2K)
-
-    fyr = 1.0 / YEAR_IN_SEC
-    amp = 10.0 ** log10_amplitude
-    prior = (
-        amp[:, None] ** 2
-        * (freqs / fyr) ** (-gamma[:, None])
-        / (12.0 * jnp.pi**2 * batch.tspan_s[:, None])
-        * YEAR_IN_SEC**3
+        k_eps = key
+    F, prior2 = red_noise_basis_prior(
+        batch, log10_amplitude, gamma, nmodes=nmodes, modes=modes,
+        logf=logf, fmin=fmin, fmax=fmax, phase_shift=phase_shift,
+        libstempo_convention=libstempo_convention, tspan_s=tspan_s,
     )
-    prior2 = jnp.concatenate([prior, prior], axis=-1)  # sin and cos blocks
-    eps = jax.random.normal(key, prior2.shape, dtype)
-    coeff = jnp.sqrt(prior2) * eps
+    if eps is None:
+        eps = jax.random.normal(k_eps, prior2.shape, dtype)
+    coeff = jnp.sqrt(prior2) * jnp.asarray(eps, dtype)
     return jnp.einsum("pnk,pk->pn", F, coeff) * batch.mask
 
 
@@ -534,6 +602,13 @@ class Recipe:
     rn_gamma: Optional[jax.Array] = None
     #: explicit red-noise mode frequencies [Hz] (overrides rn_nmodes)
     rn_modes: Optional[jax.Array] = None
+    #: red-noise frequency-grid bounds [Hz] (scalar or (Np,)); with
+    #: rn_logf they select the general log/linear grids of the reference
+    #: design matrix (red_noise.py:74-81)
+    rn_fmin: Optional[jax.Array] = None
+    rn_fmax: Optional[jax.Array] = None
+    #: common red-noise Tspan override [s] (scalar or (Np,))
+    rn_tspan_s: Optional[jax.Array] = None
     gwb_log10_amplitude: Optional[jax.Array] = None
     gwb_gamma: Optional[jax.Array] = None
     orf_cholesky: Optional[jax.Array] = None
@@ -571,6 +646,9 @@ class Recipe:
     tnequad: bool = field(metadata=dict(static=True), default=False)
     gwb_turnover: bool = field(metadata=dict(static=True), default=False)
     rn_nmodes: int = field(metadata=dict(static=True), default=30)
+    rn_logf: bool = field(metadata=dict(static=True), default=False)
+    rn_pshift: bool = field(metadata=dict(static=True), default=False)
+    rn_libstempo: bool = field(metadata=dict(static=True), default=False)
     gwb_npts: int = field(metadata=dict(static=True), default=600)
     gwb_howml: float = field(metadata=dict(static=True), default=10.0)
     cgw_tref_s: float = field(metadata=dict(static=True), default=0.0)
@@ -606,6 +684,12 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             recipe.rn_gamma,
             nmodes=recipe.rn_nmodes,
             modes=recipe.rn_modes,
+            logf=recipe.rn_logf,
+            fmin=recipe.rn_fmin,
+            fmax=recipe.rn_fmax,
+            pshift=recipe.rn_pshift,
+            libstempo_convention=recipe.rn_libstempo,
+            tspan_s=recipe.rn_tspan_s,
         )
     if recipe.gwb_log10_amplitude is not None or recipe.gwb_user_spectrum is not None:
         if recipe.orf_cholesky is None:

@@ -3,8 +3,11 @@
 Reference analog: ``create_fourier_design_matrix_red``
 (/root/reference/pta_replicator/red_noise.py:36-103), eq. 11 of
 Lentati et al. 2013. Written backend-agnostically (``xp`` = numpy or
-jax.numpy): on device the basis is built once per pulsar and contracted with
-realization-batched coefficient draws on the MXU.
+jax.numpy) and broadcast-friendly: every function accepts an optional
+leading pulsar axis on its array arguments, so the same code serves the
+per-pulsar oracle path and the batched device path (where the basis is
+built once per pulsar and contracted with realization-batched coefficient
+draws on the MXU).
 """
 from __future__ import annotations
 
@@ -12,29 +15,32 @@ import numpy as np
 
 
 def fourier_frequencies(
-    tspan_s: float,
+    tspan_s,
     nmodes: int = 30,
     logf: bool = False,
-    fmin: float = None,
-    fmax: float = None,
+    fmin=None,
+    fmax=None,
     modes=None,
     xp=np,
 ):
-    """Sampling frequencies for the rank-reduced basis.
+    """Sampling frequencies for the rank-reduced basis, shape (..., K).
 
     Default: f_k = k/T for k = 1..nmodes (identical frequencies for
-    partially overlapping data spans); optionally log/linear spacing between
-    fmin and fmax, or an explicit mode list.
+    partially overlapping data spans); optionally log/linear spacing
+    between fmin and fmax, or an explicit mode list. ``tspan_s`` and
+    ``fmin``/``fmax`` may be scalars or (Np,)-shaped (yielding (Np, K)).
     """
     if modes is not None:
         return xp.asarray(modes)
+    T = xp.asarray(tspan_s)
     if fmin is None and fmax is None and not logf:
-        return xp.arange(1, nmodes + 1) / tspan_s
-    lo = fmin if fmin is not None else 1.0 / tspan_s
-    hi = fmax if fmax is not None else nmodes / tspan_s
+        return xp.arange(1, nmodes + 1) / T[..., None]
+    lo = 1.0 / T if fmin is None else xp.asarray(fmin) + xp.zeros_like(T)
+    hi = nmodes / T if fmax is None else xp.asarray(fmax) + xp.zeros_like(T)
+    x = xp.arange(nmodes) / max(nmodes - 1, 1)
     if logf:
-        return xp.logspace(xp.log10(lo), xp.log10(hi), nmodes)
-    return xp.linspace(lo, hi, nmodes)
+        return lo[..., None] * (hi / lo)[..., None] ** x
+    return lo[..., None] + (hi - lo)[..., None] * x
 
 
 def fourier_basis(
@@ -44,37 +50,52 @@ def fourier_basis(
     libstempo_convention: bool = False,
     xp=np,
 ):
-    """Interleaved sin/cos design matrix F of shape (ntoa, 2*nmodes).
+    """Interleaved sin/cos design matrix F of shape (..., ntoa, 2*nmodes).
 
     Column order is [sin, cos] per frequency; with
     ``libstempo_convention=True`` the order is [cos, sin] and times are
     referenced to the first TOA (reference red_noise.py:92-96) so that a
     fixed random-coefficient stream produces the same delays as libstempo.
+    Leading axes of ``toas_s`` (..., ntoa) / ``freqs`` (..., K) /
+    ``phase_shift`` (..., K) broadcast.
     """
     t = xp.asarray(toas_s)
     f = xp.asarray(freqs)
     shift = xp.zeros_like(f) if phase_shift is None else xp.asarray(phase_shift)
     if libstempo_convention:
-        arg = 2 * xp.pi * (t[:, None] - t[0]) * f[None, :] + shift[None, :]
+        arg = (
+            2 * xp.pi * (t - t[..., :1])[..., :, None] * f[..., None, :]
+            + shift[..., None, :]
+        )
         first, second = xp.cos(arg), xp.sin(arg)
     else:
-        arg = 2 * xp.pi * t[:, None] * f[None, :] + shift[None, :]
+        arg = 2 * xp.pi * t[..., :, None] * f[..., None, :] + shift[..., None, :]
         first, second = xp.sin(arg), xp.cos(arg)
-    # interleave: (ntoa, nmodes, 2) -> (ntoa, 2*nmodes)
-    F = xp.stack([first, second], axis=-1).reshape(t.shape[0], 2 * f.shape[0])
+    # interleave: (..., ntoa, nmodes, 2) -> (..., ntoa, 2*nmodes)
+    F = xp.stack([first, second], axis=-1).reshape(
+        arg.shape[:-1] + (2 * arg.shape[-1],)
+    )
     return F
 
 
-def powerlaw_prior(freqs_doubled, log10_amplitude: float, gamma: float, tspan_s: float, xp=np):
-    """Per-coefficient variance of the power-law PSD prior.
+def powerlaw_prior(freqs_doubled, log10_amplitude, gamma, tspan_s, xp=np):
+    """Per-coefficient variance of the power-law PSD prior, (..., 2K).
 
     P = A^2 (f yr)^(-gamma) / (12 pi^2 Tspan) * yr^3
-    (reference red_noise.py:126). ``freqs_doubled`` is the length-2K vector
-    with each frequency repeated for its sin and cos coefficient.
+    (reference red_noise.py:126). ``freqs_doubled`` is the length-2K
+    vector with each frequency repeated for its sin and cos coefficient;
+    amplitude/gamma/tspan may carry leading (Np,) axes.
     """
     from ..constants import YEAR_IN_SEC
 
     f = xp.asarray(freqs_doubled)
-    amp = 10.0 ** log10_amplitude
+    amp = 10.0 ** xp.asarray(log10_amplitude)
+    gamma = xp.asarray(gamma)
+    T = xp.asarray(tspan_s)
     fyr = 1.0 / YEAR_IN_SEC
-    return amp**2 * (f / fyr) ** (-gamma) / (12.0 * xp.pi**2 * tspan_s) * YEAR_IN_SEC**3
+    return (
+        amp[..., None] ** 2
+        * (f / fyr) ** (-gamma[..., None])
+        / (12.0 * xp.pi**2 * T[..., None])
+        * YEAR_IN_SEC**3
+    )

@@ -21,21 +21,23 @@ import numpy as np
 
 from .io.par import ParModel, read_par
 from .io.tim import TOAData, fabricate_toas, read_tim, write_tim
-from .timing.model import SpindownTiming, phase_residuals, weighted_mean
+from .timing.model import SpindownTiming, TimingModel, phase_residuals, weighted_mean
 from .timing.fit import design_matrix, wls_fit, gls_fit
 from .constants import DAY_IN_SEC
 
 
 class Residuals:
-    """Timing residuals of a TOA set against a spin-down model.
+    """Timing residuals of a TOA set against the timing model.
 
     Mirrors the slice of PINT's ``Residuals`` the reference consumes:
     ``time_resids`` / ``resids_value`` are phase-wrapped, weighted-mean
     subtracted residuals in seconds.
     """
 
-    def __init__(self, toas: TOAData, model: SpindownTiming):
-        self.time_resids = phase_residuals(model, toas.mjd, toas.errors_s)
+    def __init__(self, toas: TOAData, model):
+        self.time_resids = phase_residuals(
+            model, toas.mjd, toas.errors_s, freqs_mhz=toas.freqs_mhz
+        )
 
     @property
     def resids_value(self) -> np.ndarray:
@@ -90,47 +92,132 @@ class SimulatedPulsar:
         self.toas.adjust_seconds(dt_s)
         self.update_residuals()
 
-    def fit(self, fitter: str = "auto", nspin: int = 2, cov: np.ndarray = None) -> None:
-        """Refit spin-down parameters post-injection (WLS or GLS).
+    def fit(
+        self,
+        fitter: str = "auto",
+        nspin: int = 2,
+        cov: np.ndarray = None,
+        params="full",
+    ) -> None:
+        """Refit the timing model post-injection (WLS or GLS).
 
-        Reference analog: simulate.py:44-69 (PINT fitter selection). Here
-        'wls'/'auto' run weighted least squares, 'gls'/'downhill' run
-        generalized least squares with covariance ``cov`` (defaults to
-        diag(errors^2)). PINT-specific fitter kwargs of the reference
-        (e.g. max_chi2_increase) have no analog and are deliberately not
-        accepted, so ported calls fail loudly instead of silently no-oping.
+        Reference analog: simulate.py:44-69, where PINT's fitters solve
+        over the *full* model design matrix. Here ``params`` selects the
+        column set: ``'full'`` (default — spin plus every astrometry /
+        DM / binary parameter the par file declares, via
+        timing.components.full_design_matrix), ``'spin'`` (the spin-only
+        fit), or an explicit list of column names. 'wls'/'auto' run
+        weighted least squares; 'gls'/'downhill' run generalized least
+        squares with covariance ``cov`` (defaults to diag(errors^2);
+        build realistic covariances with timing.fit.noise_covariance /
+        covariance_from_recipe). PINT-specific fitter kwargs of the
+        reference (e.g. max_chi2_increase) have no analog and are
+        deliberately not accepted, so ported calls fail loudly instead of
+        silently no-oping.
+
+        Fitted parameter corrections are applied to the model *and*
+        written back to the par representation, so ``write_partim``
+        persists the fitted model (reference simulate.py:71-77).
         """
         if fitter not in ("wls", "gls", "downhill", "auto"):
             raise ValueError(f"fitter={fitter!r} must be one of 'wls', 'gls', 'downhill' or 'auto'")
+        from .timing.components import full_design_matrix
+
         self.update_residuals()
         res = self.residuals.time_resids
-        # PEPOCH frame so spin-parameter updates apply without cross terms
-        toas_s = ((self.toas.get_mjds() - self.model.pepoch_mjd) * DAY_IN_SEC).astype(np.float64)
-        M = design_matrix(toas_s, self.model.f0, nspin=nspin)
+        mjds = self.toas.get_mjds()
+        if params == "spin" or self.par is None:
+            toas_s = ((mjds - self.model.pepoch_mjd) * DAY_IN_SEC).astype(np.float64)
+            M = design_matrix(toas_s, self.model.f0, nspin=nspin)
+            names = ["OFFSET"] + [f"F{k}" for k in range(nspin)]
+        else:
+            include = "auto" if params == "full" else params
+            M, names = full_design_matrix(
+                self.par, mjds, freqs_mhz=self.toas.freqs_mhz,
+                f0=self.model.f0, nspin=nspin, include=include,
+            )
         if fitter in ("wls", "auto"):
             p, post = wls_fit(res, self.toas.errors_s, M)
         else:
             C = cov if cov is not None else np.diag(self.toas.errors_s**2)
             p, post = gls_fit(res, C, M)
-        # p = [offset_s, dF0, dF1, ...] in design_matrix's t^k/(k! F0) basis;
-        # subtracting moves model phase onto the data
         p = np.asarray(p, dtype=np.float64)
-        self.model = SpindownTiming(
-            f0=self.model.f0 - (p[1] if nspin >= 1 else 0.0),
-            f1=self.model.f1 - (p[2] if nspin >= 2 else 0.0),
-            f2=self.model.f2 - (p[3] if nspin >= 3 else 0.0),
-            pepoch_mjd=self.model.pepoch_mjd,
-        )
-        # keep the par representation in sync so write_partim persists the
-        # fitted model (the reference writes the fitted PINT model,
-        # simulate.py:71-77)
-        if self.par is not None:
-            self.par.set_param("F0", self.model.f0)
-            if nspin >= 2:
-                self.par.set_param("F1", self.model.f1)
-            if nspin >= 3:
-                self.par.set_param("F2", self.model.f2)
+        self.fit_results = dict(zip(names, p))
+        self._apply_fit(dict(zip(names, p)))
         self.update_residuals()
+
+    def _apply_fit(self, updates: dict) -> None:
+        """Apply fitted parameter corrections to the model and par file.
+
+        Sign conventions: spin columns are ``t^k/(k! F0)`` — the solved
+        coefficient is the amount the *model* frequency exceeds the data,
+        so spin params are decremented (as the round-1 fit did). Delay
+        -parameter columns are ``d(delay)/d(param)`` and residuals are
+        ``+ (true - model) * d(delay)/d(param)``, so those params are
+        incremented.
+        """
+        spin = self.model.spin if isinstance(self.model, TimingModel) else self.model
+        new_spin = SpindownTiming(
+            f0=spin.f0 - updates.get("F0", 0.0),
+            f1=spin.f1 - updates.get("F1", 0.0),
+            f2=spin.f2 - updates.get("F2", 0.0),
+            pepoch_mjd=spin.pepoch_mjd,
+        )
+        par = self.par
+        if par is not None:
+            par.set_param("F0", new_spin.f0)
+            if "F1" in updates:
+                par.set_param("F1", new_spin.f1)
+            if "F2" in updates:
+                par.set_param("F2", new_spin.f2)
+
+            rad2mas = np.degrees(1.0) * 3.6e6
+            if "RAJ" in updates and par.raj_hours is not None:
+                par.set_param("RAJ", par.raj_hours + updates["RAJ"] * 12.0 / np.pi)
+            if "DECJ" in updates and par.decj_deg is not None:
+                par.set_param("DECJ", par.decj_deg + np.degrees(updates["DECJ"]))
+            cosd = np.cos(np.deg2rad(par.decj_deg)) if par.decj_deg is not None else 1.0
+            if "PMRA" in updates:
+                from .timing.components import _parf
+
+                par.set_param(
+                    "PMRA", (_parf(par, "PMRA", 0.0) or 0.0)
+                    + updates["PMRA"] * cosd * rad2mas
+                )
+            if "PMDEC" in updates:
+                from .timing.components import _parf
+
+                par.set_param(
+                    "PMDEC", (_parf(par, "PMDEC", 0.0) or 0.0)
+                    + updates["PMDEC"] * rad2mas
+                )
+            if "PX" in updates:
+                from .timing.components import _parf
+
+                par.set_param(
+                    "PX", (_parf(par, "PX", 0.0) or 0.0)
+                    + updates["PX"] * rad2mas
+                )
+            if "DM" in updates:
+                par.set_param("DM", par.dm + updates["DM"])
+            if "DM1" in updates:
+                from .timing.components import _parf
+
+                par.set_param("DM1", (_parf(par, "DM1", 0.0) or 0.0) + updates["DM1"])
+            # binary parameters: numerical-derivative columns, += convention
+            from .timing.components import BinaryModel
+
+            binary = BinaryModel.from_par(par)
+            if binary is not None:
+                for nm in binary.fit_param_names():
+                    if nm in updates:
+                        par.set_param(nm, binary.get(nm) + updates[nm])
+            # rebuild the full model from the updated par (keeps binary/
+            # DM/astrometry in sync with what write_partim persists)
+            self.model = TimingModel.from_par(par)
+            self.model.spin = new_spin
+        else:
+            self.model = new_spin
 
     def write_partim(self, outpar: str, outtim: str, tempo2: bool = False) -> None:
         """Persist the mutated dataset (reference analog simulate.py:71-77).
@@ -184,7 +271,7 @@ def simulate_pulsar(
     if not os.path.isfile(parfile):
         raise FileNotFoundError("par file does not exist.")
     par = read_par(parfile)
-    model = SpindownTiming.from_par(par)
+    model = TimingModel.from_par(par)
     toas = fabricate_toas(obstimes, toaerr, freq_mhz=freq, observatory=observatory, flags=flags)
     psr = SimulatedPulsar(
         ephem=ephem, par=par, model=model, toas=toas, name=par.name, loc=_locate(par)
@@ -200,7 +287,7 @@ def load_pulsar(parfile: str, timfile: str, ephem: str = "DE440") -> SimulatedPu
     if not os.path.isfile(timfile):
         raise FileNotFoundError("tim file does not exist.")
     par = read_par(parfile)
-    model = SpindownTiming.from_par(par)
+    model = TimingModel.from_par(par)
     toas = read_tim(timfile)
     psr = SimulatedPulsar(
         ephem=ephem, par=par, model=model, toas=toas, name=par.name, loc=_locate(par)
@@ -237,7 +324,10 @@ def make_ideal(psr: SimulatedPulsar, iterations: int = 2) -> None:
     """Zero the residuals by absorbing them into the TOAs, then initialize
     the provenance ledger (reference analog simulate.py:193-202)."""
     for _ in range(iterations):
-        res = phase_residuals(psr.model, psr.toas.mjd, psr.toas.errors_s)
+        res = phase_residuals(
+            psr.model, psr.toas.mjd, psr.toas.errors_s,
+            freqs_mhz=psr.toas.freqs_mhz,
+        )
         psr.toas.adjust_seconds(-res)
     psr.added_signals = {}
     psr.added_signals_time = {}

@@ -65,3 +65,97 @@ def gls_fit(residuals_s, cov, M, xp=np, jitter: float = 0.0):
     Mw = xp.linalg.solve(L, M)
     rw = xp.linalg.solve(L, r)
     return _normalized_lstsq(Mw, rw, M, r, xp)
+
+
+def noise_covariance(
+    errors_s,
+    efac=1.0,
+    equad_s=0.0,
+    ecorr_s=None,
+    epoch_index=None,
+    rn_log10_amplitude=None,
+    rn_gamma=None,
+    toas_s=None,
+    rn_nmodes: int = 30,
+    tspan_s=None,
+    xp=np,
+):
+    """Assemble the dense GLS noise covariance the reference gets from
+    PINT's GLSFitter (simulate.py:57-61):
+
+        C = diag((EFAC sigma)^2 + EQUAD^2) + U diag(ECORR^2) U^T
+            + F Phi(A, gamma) F^T
+
+    ``efac``/``equad_s`` are scalars or per-TOA vectors; ``ecorr_s`` is a
+    scalar or per-epoch vector with ``epoch_index`` mapping TOAs to
+    epochs (ops.quantize / PulsarBatch.epoch_index); the red-noise term
+    uses the rank-reduced Fourier basis on ``toas_s``.
+    """
+    sigma = xp.asarray(errors_s)
+    n = sigma.shape[-1]
+    ef = xp.asarray(efac) * xp.ones_like(sigma)
+    eq = xp.asarray(equad_s) * xp.ones_like(sigma)
+    C = xp.zeros((n, n)) + xp.diag((ef * sigma) ** 2 + eq**2)
+
+    if ecorr_s is not None and epoch_index is not None:
+        idx = xp.asarray(epoch_index)
+        nep = int(np.asarray(idx).max()) + 1
+        ec = xp.asarray(ecorr_s) * xp.ones((nep,))
+        # U[t, e] = 1 iff TOA t falls in epoch e  (reference quantize_fast
+        # exploder, white_noise.py:7-44)
+        U = xp.asarray(idx[:, None] == xp.arange(nep)[None, :], dtype=C.dtype)
+        C = C + (U * ec[None, :] ** 2) @ U.T
+
+    if rn_log10_amplitude is not None:
+        if toas_s is None:
+            raise ValueError("red-noise covariance needs toas_s")
+        from ..ops.fourier import (
+            fourier_basis,
+            fourier_frequencies,
+            powerlaw_prior,
+        )
+
+        t = xp.asarray(toas_s)
+        T = tspan_s if tspan_s is not None else float(t.max() - t.min())
+        f = fourier_frequencies(T, nmodes=rn_nmodes, xp=xp)
+        F = fourier_basis(t, f, xp=xp)
+        phi = powerlaw_prior(
+            xp.repeat(f, 2), rn_log10_amplitude, rn_gamma, T, xp=xp
+        )
+        C = C + (F * phi[None, :]) @ F.T
+    return C
+
+
+def covariance_from_recipe(psr, recipe, coarsegrain: float = 0.1, xp=np):
+    """Noise covariance for one oracle pulsar from a device Recipe's
+    scalar/per-pulsar noise parameters (per-backend tables are averaged —
+    the GLS covariance is a weighting, not a likelihood).
+    """
+    import numpy as _np
+
+    from ..constants import DAY_IN_SEC
+    from ..ops.quantize import quantize
+
+    def scalarize(v):
+        return None if v is None else float(_np.mean(_np.asarray(v)))
+
+    errors = psr.toas.errors_s
+    toas_s = psr.toas.get_mjds() * DAY_IN_SEC
+    efac = scalarize(recipe.efac) or 1.0
+    equad = 10.0 ** scalarize(recipe.log10_equad) if recipe.log10_equad is not None else 0.0
+    ecorr = 10.0 ** scalarize(recipe.log10_ecorr) if recipe.log10_ecorr is not None else None
+    epoch_index = None
+    if ecorr is not None:
+        epoch_index = quantize(psr.toas.get_mjds(), dt=coarsegrain).epoch_index
+    return noise_covariance(
+        errors,
+        efac=efac,
+        equad_s=equad,
+        ecorr_s=ecorr,
+        epoch_index=epoch_index,
+        rn_log10_amplitude=scalarize(recipe.rn_log10_amplitude),
+        rn_gamma=scalarize(recipe.rn_gamma),
+        toas_s=toas_s,
+        rn_nmodes=recipe.rn_nmodes,
+        xp=xp,
+    )

@@ -64,21 +64,122 @@ class SpindownTiming:
 
 
 def phase_residuals(
-    model: SpindownTiming,
+    model,
     mjd_ld: np.ndarray,
     errors_s: np.ndarray,
     subtract_mean: bool = True,
+    freqs_mhz: np.ndarray = None,
 ) -> np.ndarray:
-    """Phase-wrapped time residuals [s] of TOAs against a spin-down model.
+    """Phase-wrapped time residuals [s] of TOAs against a timing model.
 
     Fractional phase is wrapped to [-0.5, 0.5) turns and divided by the
     instantaneous spin frequency; the error-weighted mean is removed, as in
     PINT residuals consumed by the reference at
     /root/reference/pta_replicator/simulate.py:40-42.
+
+    ``model`` is a :class:`SpindownTiming` or a :class:`TimingModel`; for
+    the latter, the spin phase is evaluated at the delay-corrected
+    emission time (binary/dispersion/astrometric delays subtracted, with
+    ``freqs_mhz`` feeding the dispersion term).
     """
-    phase = model.phase(mjd_ld)
+    mjd = np.asarray(mjd_ld, dtype=np.longdouble)
+    if hasattr(model, "delays_s"):
+        d = model.delays_s(np.asarray(mjd_ld, dtype=np.float64),
+                           freqs_mhz=freqs_mhz)
+        if d is not None:
+            mjd = mjd - np.asarray(d, dtype=np.float64) / DAY_IN_SEC
+    phase = model.phase(mjd)
     frac = phase - np.rint(phase)
-    res = (frac / model.spin_frequency(mjd_ld)).astype(np.float64)
+    res = (frac / model.spin_frequency(mjd)).astype(np.float64)
     if subtract_mean:
         res = res - weighted_mean(res, errors_s)
     return res
+
+
+@dataclass
+class TimingModel:
+    """Spin-down phase plus the physical delay components the reference
+    gets from PINT (simulate.py:40-42): binary orbit, dispersion, and an
+    approximate astrometric Roemer term (timing.components — see that
+    module's accuracy stance: the column *shapes* are right; absolute
+    barycentering is not ns-accurate without a numerical ephemeris).
+
+    The pulse phase is the spin Taylor series evaluated at the
+    delay-corrected emission time ``t - delays(t)``. ``make_ideal`` zeroes
+    whatever this model predicts, so synthesis results depend only on the
+    *differential* behavior (what a refit can absorb), which these
+    components capture with the correct time/frequency dependence.
+    """
+
+    spin: SpindownTiming
+    binary: object = None  # Optional[components.BinaryModel]
+    dm: float = 0.0
+    dm1: float = 0.0
+    dmepoch_mjd: float = 0.0
+    ra_rad: float = None
+    dec_rad: float = None
+    include_roemer: bool = True
+
+    # -- SpindownTiming-compatible surface (existing call sites)
+    @property
+    def f0(self):
+        return self.spin.f0
+
+    @property
+    def f1(self):
+        return self.spin.f1
+
+    @property
+    def f2(self):
+        return self.spin.f2
+
+    @property
+    def pepoch_mjd(self):
+        return self.spin.pepoch_mjd
+
+    def phase(self, mjd_ld):
+        return self.spin.phase(mjd_ld)
+
+    def spin_frequency(self, mjd_ld):
+        return self.spin.spin_frequency(mjd_ld)
+
+    @classmethod
+    def from_par(cls, par) -> "TimingModel":
+        from ..ops.coords import pulsar_ra_dec
+        from .components import BinaryModel, _parf
+
+        ra = dec = None
+        try:
+            ra, dec = pulsar_ra_dec(par.loc, par.name)
+        except AttributeError:  # no sky location in the par file
+            pass
+        return cls(
+            spin=SpindownTiming.from_par(par),
+            binary=BinaryModel.from_par(par),
+            dm=par.dm,
+            dm1=_parf(par, "DM1", 0.0) or 0.0,
+            dmepoch_mjd=_parf(par, "DMEPOCH", par.pepoch_mjd) or par.pepoch_mjd,
+            ra_rad=ra,
+            dec_rad=dec,
+        )
+
+    def delays_s(self, t_mjd: np.ndarray, freqs_mhz=None):
+        """Total model delay [s] at the given (topocentric) MJD epochs."""
+        from .components import AU_S, dispersion_delay, earth_position_au
+
+        t = np.asarray(t_mjd, dtype=np.float64)
+        total = np.zeros_like(t)
+        if self.binary is not None and self.binary.pb_days:
+            total = total + self.binary.delay_s(t)
+        if self.dm and freqs_mhz is not None:
+            total = total + dispersion_delay(
+                freqs_mhz, self.dm, dm1=self.dm1, t_mjd=t,
+                dmepoch_mjd=self.dmepoch_mjd,
+            )
+        if self.include_roemer and self.ra_rad is not None:
+            r = earth_position_au(t)
+            ca, sa = np.cos(self.ra_rad), np.sin(self.ra_rad)
+            cd, sd = np.cos(self.dec_rad), np.sin(self.dec_rad)
+            nhat = np.array([ca * cd, sa * cd, sd])
+            total = total - (r @ nhat) * AU_S
+        return total if total.any() else None

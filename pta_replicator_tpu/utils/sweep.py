@@ -155,11 +155,16 @@ def sweep(
         raise ValueError(f"nreal={nreal} must be a multiple of chunk={chunk}")
     nchunks = nreal // chunk
 
+    from ..models.batched import STREAM_VERSION
+
     meta = {
         "key": np.asarray(jax.random.key_data(key)).tolist(),
         "nreal": nreal,
         "chunk": chunk,
         "fit": bool(fit),
+        # op-suite PRNG stream contract: a checkpoint written under a
+        # different draw layout must refuse to resume, not mix streams
+        "stream": STREAM_VERSION,
         "physics": _fingerprint(batch, recipe),
         "reduce": _fn_id(reduce_fn),
         # NOTE: mesh is deliberately NOT part of the fingerprint — a

@@ -176,6 +176,94 @@ def test_uniform_grid_interp_matches_np_interp():
         np.testing.assert_allclose(out[i], np.interp(t[i], grid, series[i]), atol=1e-12)
 
 
+@pytest.mark.parametrize(
+    "opts",
+    [
+        dict(),
+        dict(libstempo_convention=True),
+        dict(logf=True, fmin=2e-9, fmax=4e-8),
+        dict(fmin=1.5e-9, fmax=3e-8),
+        dict(modes=np.arange(1, 13) / 2.1e8),
+        dict(tspan_s=5.5e8),
+        dict(phase_shift=np.linspace(0, 2 * np.pi, 30, endpoint=False)),
+    ],
+    ids=["default", "libstempo", "logf", "linear", "modes", "tspan",
+         "pshift"],
+)
+def test_red_noise_device_option_parity(batch, opts):
+    """Every frequency-grid/convention option of the oracle design matrix
+    (reference red_noise.py:36-103) produces identical delays on the
+    device path when fed the same coefficient stream."""
+    from pta_replicator_tpu.ops.fourier import (
+        fourier_basis,
+        fourier_frequencies,
+        powerlaw_prior,
+    )
+    from pta_replicator_tpu.constants import DAY_IN_SEC
+
+    b, psrs = batch
+    opts = dict(opts)
+    shift = opts.pop("phase_shift", None)
+    nmodes = 30 if "modes" not in opts else len(opts["modes"])
+    rng = np.random.default_rng(21)
+    eps = rng.normal(size=(b.npsr, 2 * nmodes))
+
+    dev = B.red_noise_delays(
+        jax.random.PRNGKey(0), b, -14.0, 4.33, nmodes=nmodes,
+        eps=eps, modes=opts.get("modes"),
+        logf=opts.get("logf", False),
+        fmin=opts.get("fmin"), fmax=opts.get("fmax"),
+        phase_shift=None if shift is None else jnp.asarray(shift)[None, :],
+        libstempo_convention=opts.get("libstempo_convention", False),
+        tspan_s=opts.get("tspan_s"),
+    )
+
+    for i, p in enumerate(psrs):
+        # oracle basis with the same options and coefficient stream.
+        # NOTE the time conventions: device times are batch-epoch-relative,
+        # oracle times absolute — identical bases except for a per-mode
+        # phase, which the libstempo convention (t - t0) removes and the
+        # default convention changes only which N(0,1) pair multiplies
+        # the quadrature; to compare exactly we evaluate the oracle basis
+        # on the device's relative times.
+        toas_rel = np.asarray(b.toas_s[i], np.float64)
+        toas_abs = p.toas.get_mjds() * DAY_IN_SEC
+        T = (
+            opts.get("tspan_s")
+            or float(toas_abs.max() - toas_abs.min())
+        )
+        f = fourier_frequencies(
+            T, nmodes=nmodes, logf=opts.get("logf", False),
+            fmin=opts.get("fmin"), fmax=opts.get("fmax"),
+            modes=opts.get("modes"),
+        )
+        F = fourier_basis(
+            toas_rel, f, phase_shift=shift,
+            libstempo_convention=opts.get("libstempo_convention", False),
+        )
+        prior = powerlaw_prior(np.repeat(f, 2), -14.0, 4.33, T)
+        expect = F @ (np.sqrt(prior) * eps[i])
+        np.testing.assert_allclose(
+            np.asarray(dev[i]), expect, rtol=1e-9, atol=1e-12
+        )
+
+
+def test_red_noise_pshift_statistics(batch):
+    """Random per-mode phase shifts preserve the delay variance (the PSD
+    is phase-blind) while decorrelating individual realizations."""
+    b, _ = batch
+    keys = jax.random.split(jax.random.PRNGKey(3), 400)
+    base = jax.vmap(
+        lambda k: B.red_noise_delays(k, b, -13.6, 4.0)
+    )(keys)
+    shifted = jax.vmap(
+        lambda k: B.red_noise_delays(k, b, -13.6, 4.0, pshift=True)
+    )(keys)
+    v0, v1 = float(jnp.var(base)), float(jnp.var(shifted))
+    assert abs(v1 / v0 - 1.0) < 0.2
+    assert not np.allclose(np.asarray(base[0]), np.asarray(shifted[0]))
+
+
 def test_cgw_catalog_matches_oracle(batch):
     """Deterministic op: device catalog == oracle catalog, exactly."""
     b, psrs = batch

@@ -1,0 +1,267 @@
+"""Full-timing-model refit: binary/dispersion/astrometry design columns.
+
+VERDICT.md round-2 criterion: a post-injection fit on real B1855+09 (ELL1
+binary) must absorb binary-shaped signal power the way the reference's
+full-model PINT refit does (/root/reference/pta_replicator/simulate.py:
+44-69), which a spin-only quadratic fit cannot.
+"""
+import numpy as np
+import pytest
+
+from pta_replicator_tpu import load_pulsar, make_ideal
+from pta_replicator_tpu.io.par import read_par
+from pta_replicator_tpu.timing.components import (
+    BinaryModel,
+    dispersion_delay,
+    earth_position_au,
+    full_design_matrix,
+)
+from pta_replicator_tpu.timing.fit import noise_covariance, gls_fit, wls_fit
+
+B1855_PAR = "/root/reference/test_partim/par/B1855+09.par"
+B1855_TIM = "/root/reference/test_partim/tim/B1855+09.tim"
+JPSR_PAR = "/root/reference/test_partim_small/par/JPSR00.par"
+JPSR_TIM = "/root/reference/test_partim_small/tim/fake_JPSR00_noiseonly.tim"
+
+
+def _rms(x):
+    return float(np.sqrt(np.mean(np.asarray(x) ** 2)))
+
+
+# ----------------------------------------------------------- binary physics
+
+def test_ell1_circular_limit():
+    """eps1 = eps2 = 0 reduces the ELL1 Roemer to x sin(2 pi (t-tasc)/Pb)."""
+    b = BinaryModel(model="ELL1", pb_days=10.0, a1_ls=5.0, tasc_mjd=55000.0)
+    t = 55000.0 + np.linspace(0, 30, 500)
+    expect = 5.0 * np.sin(2 * np.pi * (t - 55000.0) / 10.0)
+    np.testing.assert_allclose(b.delay_s(t), expect, atol=1e-12)
+
+
+def test_ell1_matches_dd_at_low_eccentricity():
+    """The ELL1 expansion agrees with the full Kepler solve to O(e^2)
+    (Lange et al. 2001): eps1 = e sin(om), eps2 = e cos(om), and the DD
+    epoch of periastron T0 = TASC + PB * om / (2 pi)."""
+    e, om_deg, pb, x = 1e-4, 63.0, 12.3, 9.2
+    om = np.deg2rad(om_deg)
+    tasc = 55000.0
+    ell1 = BinaryModel(
+        model="ELL1", pb_days=pb, a1_ls=x, tasc_mjd=tasc,
+        eps1=e * np.sin(om), eps2=e * np.cos(om),
+    )
+    dd = BinaryModel(
+        model="DD", pb_days=pb, a1_ls=x, ecc=e, om_deg=om_deg,
+        t0_mjd=tasc + pb * om / (2 * np.pi),
+    )
+    t = 55000.0 + np.linspace(0, 40, 800)
+    d_ell1, d_dd = ell1.delay_s(t), dd.delay_s(t)
+    # agreement to O(x e^2) ~ 1e-7 s, with the constant -3/2 x eta offset
+    # of the expansion removed (it is absorbed by the pulse-phase offset)
+    diff = (d_ell1 - d_dd) - np.mean(d_ell1 - d_dd)
+    assert _rms(diff) < 5.0 * x * e**2
+
+
+def test_shapiro_delay_shape():
+    """Shapiro term peaks at superior conjunction (sin phi = 1) and grows
+    with M2."""
+    kw = dict(model="ELL1", pb_days=10.0, a1_ls=5.0, tasc_mjd=55000.0,
+              sini=0.999)
+    t = 55000.0 + np.linspace(0, 10, 2000)
+    b_light = BinaryModel(**kw, m2_msun=0.1)
+    b_heavy = BinaryModel(**kw, m2_msun=0.4)
+    s_light = b_light.delay_s(t) - BinaryModel(**kw).delay_s(t)
+    s_heavy = b_heavy.delay_s(t) - BinaryModel(**kw).delay_s(t)
+    assert abs(np.argmax(-s_heavy) - np.argmax(np.sin(2 * np.pi * (t - 55000.0) / 10.0))) < 10
+    np.testing.assert_allclose(s_heavy / s_light, 4.0, rtol=1e-6)
+
+
+def test_dispersion_delay_scaling():
+    f = np.array([400.0, 800.0, 1600.0])
+    d = dispersion_delay(f, dm=10.0)
+    np.testing.assert_allclose(d[0] / d[1], 4.0, rtol=1e-12)
+    np.testing.assert_allclose(d[0], 10.0 / (2.41e-4 * 400.0**2), rtol=1e-12)
+
+
+def test_earth_orbit_sanity():
+    """|r| in [0.98, 1.02] AU, one-year periodicity, ecliptic tilt."""
+    t = 51544.5 + np.linspace(0, 730, 2000)
+    r = earth_position_au(t)
+    d = np.linalg.norm(r, axis=-1)
+    assert d.min() > 0.975 and d.max() < 1.025
+    r0 = earth_position_au(np.array([51544.5, 51544.5 + 365.25]))
+    assert np.linalg.norm(r0[0] - r0[1]) < 0.02
+    # z-extent reflects the obliquity
+    assert 0.35 < np.abs(r[:, 2]).max() < 0.45
+
+
+# ------------------------------------------------------- full design matrix
+
+def test_full_design_matrix_b1855_columns():
+    par = read_par(B1855_PAR)
+    t = np.linspace(53400, 57500, 300)
+    f = np.full(300, 1400.0)
+    M, names = full_design_matrix(par, t, freqs_mhz=f)
+    # ELL1 binary with Shapiro: PB A1 TASC EPS1 EPS2 M2 SINI all present
+    for nm in ("OFFSET", "F0", "F1", "RAJ", "DECJ", "PMRA", "PMDEC", "PX",
+               "DM", "PB", "A1", "TASC", "EPS1", "EPS2", "M2", "SINI"):
+        assert nm in names, nm
+    assert M.shape == (300, len(names))
+    assert np.all(np.isfinite(M))
+
+
+# ------------------------------------------- the B1855+09 refit criterion
+
+@pytest.fixture(scope="module")
+def b1855():
+    psr = load_pulsar(B1855_PAR, B1855_TIM)
+    make_ideal(psr)
+    return psr
+
+
+def test_b1855_loads_with_binary_model(b1855):
+    m = b1855.model
+    assert m.binary is not None and m.binary.model == "ELL1"
+    assert m.binary.pb_days == pytest.approx(12.327171191603620594)
+    assert _rms(b1855.residuals.resids_value) < 1e-9
+
+
+def test_b1855_binary_refit_absorbs_orbital_signal(b1855):
+    """Inject an exact A1/EPS1 perturbation signal; the full-model fit
+    absorbs it (>100x rms reduction) and recovers the parameter offsets,
+    while the spin-only fit cannot absorb orbital harmonics."""
+    import copy
+
+    psr = copy.deepcopy(b1855)
+    t = psr.toas.get_mjds()
+    b = psr.model.binary
+    dA1, dEPS1 = 3e-7, 2e-8
+    signal = (
+        b.replace("A1", b.a1_ls + dA1).replace("EPS1", b.eps1 + dEPS1).delay_s(t)
+        - b.delay_s(t)
+    )
+    psr.inject("orbital_error", {}, signal)
+    pre = _rms(psr.residuals.resids_value)
+
+    spin_only = copy.deepcopy(psr)
+    spin_only.fit(fitter="wls", params="spin")
+    post_spin = _rms(spin_only.residuals.resids_value)
+
+    psr.fit(fitter="wls", params="full")
+    post_full = _rms(psr.residuals.resids_value)
+
+    assert post_full < pre / 100.0
+    assert post_full < post_spin / 10.0  # spin fit can't absorb the orbit
+    assert psr.fit_results["A1"] == pytest.approx(dA1, rel=5e-2)
+    assert psr.fit_results["EPS1"] == pytest.approx(dEPS1, rel=5e-2)
+    # the fitted parameters persisted to the par representation
+    assert float(psr.par.params["A1"][0]) == pytest.approx(b.a1_ls + dA1, rel=1e-9)
+
+
+def test_b1855_dm_refit(b1855):
+    """A DM offset (1/f^2 signature across the real multi-band TOAs) is
+    absorbed and recovered by the full fit."""
+    import copy
+
+    psr = copy.deepcopy(b1855)
+    dDM = 1e-4
+    psr.inject(
+        "dm_error", {},
+        np.asarray(dispersion_delay(psr.toas.freqs_mhz, dDM), np.float64),
+    )
+    assert np.std(psr.toas.freqs_mhz) > 50.0  # real multi-band data
+    psr.fit(fitter="wls", params="full")
+    assert psr.fit_results["DM"] == pytest.approx(dDM, rel=5e-2)
+    assert _rms(psr.residuals.resids_value) < 1e-7
+
+
+def test_astrometry_refit_jpsr():
+    """An annual sky-position-offset signature is absorbed by the full
+    fit on the small fixture pulsar."""
+    psr = load_pulsar(JPSR_PAR, JPSR_TIM)
+    make_ideal(psr)
+    t = psr.toas.get_mjds()
+    from pta_replicator_tpu.timing.components import astrometry_columns
+
+    cols, names = astrometry_columns(
+        t, psr.model.ra_rad, psr.model.dec_rad, psr.model.pepoch_mjd
+    )
+    dra = 5e-9  # rad
+    psr.inject("pos_error", {}, np.asarray(cols[0] * dra, np.float64))
+    pre = _rms(psr.residuals.resids_value)
+    psr.fit(fitter="wls", params="full")
+    assert _rms(psr.residuals.resids_value) < pre / 5.0
+    assert psr.fit_results["RAJ"] == pytest.approx(dra, rel=0.3)
+
+
+# ------------------------------------------------------------ GLS refit
+
+def test_gls_covariance_blocks():
+    """The assembled covariance has the white diagonal, the ECORR epoch
+    blocks, and the red-noise long-timescale structure."""
+    n = 40
+    rng = np.random.default_rng(2)
+    errors = np.full(n, 1e-6)
+    epoch_index = np.repeat(np.arange(10), 4)
+    toas = np.sort(rng.uniform(0, 3.16e8, n))
+    C = noise_covariance(
+        errors, efac=1.2, equad_s=5e-7, ecorr_s=2e-6,
+        epoch_index=epoch_index,
+        rn_log10_amplitude=-13.0, rn_gamma=4.0, toas_s=toas, rn_nmodes=15,
+    )
+    assert C.shape == (n, n)
+    np.testing.assert_allclose(C, C.T)
+    assert np.all(np.linalg.eigvalsh(C) > 0)
+    # white part on the diagonal
+    white = (1.2 * 1e-6) ** 2 + (5e-7) ** 2
+    assert np.all(np.diag(C) > white)
+    # same-epoch pairs carry the ECORR block; different-epoch pairs don't
+    C_noRN = noise_covariance(
+        errors, efac=1.2, equad_s=5e-7, ecorr_s=2e-6,
+        epoch_index=epoch_index,
+    )
+    assert C_noRN[0, 1] == pytest.approx((2e-6) ** 2)
+    assert C_noRN[0, 5] == 0.0
+
+
+def test_gls_vs_wls_differ_on_red_noise():
+    """VERDICT criterion: with a realistic (red-noise-dominated)
+    covariance, GLS and WLS produce measurably different fits."""
+    from pta_replicator_tpu import add_red_noise
+    from pta_replicator_tpu.timing.fit import design_matrix
+
+    psr = load_pulsar(JPSR_PAR, JPSR_TIM)
+    make_ideal(psr)
+    add_red_noise(psr, -12.8, 5.0, seed=42)
+    res = psr.residuals.resids_value
+    toas_s = ((psr.toas.get_mjds() - psr.model.pepoch_mjd) * 86400.0).astype(float)
+    M = design_matrix(toas_s, psr.model.f0, nspin=2)
+    C = noise_covariance(
+        psr.toas.errors_s,
+        rn_log10_amplitude=-12.8, rn_gamma=5.0,
+        toas_s=psr.toas.get_mjds() * 86400.0, rn_nmodes=30,
+    )
+    p_wls, post_wls = wls_fit(res, psr.toas.errors_s, M)
+    p_gls, post_gls = gls_fit(res, C, M)
+    # the fits must disagree by far more than numerical noise: the GLS
+    # weighting knows the low-frequency power is noise, not signal
+    rel = np.abs(np.asarray(p_wls) - np.asarray(p_gls)) / (
+        np.abs(np.asarray(p_wls)) + 1e-30
+    )
+    assert float(np.max(rel)) > 1e-3
+
+
+def test_covariance_from_recipe():
+    from pta_replicator_tpu.models.batched import Recipe
+    from pta_replicator_tpu.timing.fit import covariance_from_recipe
+
+    psr = load_pulsar(JPSR_PAR, JPSR_TIM)
+    make_ideal(psr)
+    recipe = Recipe(
+        efac=np.asarray(1.1), log10_equad=np.asarray(-6.5),
+        log10_ecorr=np.asarray(-6.8),
+        rn_log10_amplitude=np.asarray(-14.0), rn_gamma=np.asarray(4.0),
+    )
+    C = covariance_from_recipe(psr, recipe)
+    assert C.shape == (psr.toas.ntoas,) * 2
+    assert np.all(np.linalg.eigvalsh(C) > 0)
+    psr.fit(fitter="gls", cov=C)  # end-to-end GLS refit runs
