@@ -326,16 +326,30 @@ def full_design_matrix(
     if include == "spin" or par is None:
         return xp.stack(cols, axis=-1), names
 
+    # Sky position: equatorial pars directly; ecliptic pars (all three real
+    # NANOGrav fixtures are ELONG/ELAT) through the same conversion the
+    # reference applies at every sky-position site
+    # (/root/reference/pta_replicator/red_noise.py:210-221, B-name 1950 rule).
+    # The fitted basis is the local 2-D tangent plane either way, so the
+    # columns are reported under the equatorial names.
+    radec = None
     if par.raj_hours is not None and par.decj_deg is not None:
-        ra = par.raj_hours * np.pi / 12.0
-        dec = np.deg2rad(par.decj_deg)
+        radec = (par.raj_hours * np.pi / 12.0, np.deg2rad(par.decj_deg))
+    elif par.elong_deg is not None and par.elat_deg is not None:
+        from ..ops.coords import pulsar_ra_dec
+
+        radec = pulsar_ra_dec(par.loc, par.name)
+    if radec is not None:
+        ra, dec = radec
         posepoch = _parf(par, "POSEPOCH", pepoch) or pepoch
         acols, anames = astrometry_columns(t, ra, dec, posepoch, xp=xp)
         have = par.params
+        pm_keys = ("PMRA", "PMDEC", "PMELONG", "PMELAT", "PMLAMBDA", "PMBETA")
+        has_pm = any(k in have for k in pm_keys)
         keep = [
             i for i, nm in enumerate(anames)
             if nm in ("RAJ", "DECJ")
-            or (nm in ("PMRA", "PMDEC") and ("PMRA" in have or "PMDEC" in have))
+            or (nm in ("PMRA", "PMDEC") and has_pm)
             or (nm == "PX" and "PX" in have)
         ]
         cols += [acols[i] for i in keep]

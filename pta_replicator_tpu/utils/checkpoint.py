@@ -21,7 +21,8 @@ from ..batch import PulsarBatch
 from ..io.par import ParModel
 from ..io.tim import TOAData
 from ..simulate import SimulatedPulsar
-from ..timing.model import SpindownTiming
+from ..timing.components import BinaryModel
+from ..timing.model import SpindownTiming, TimingModel
 
 
 def save_pulsar(psr: SimulatedPulsar, path: str) -> None:
@@ -66,7 +67,7 @@ def load_pulsar_checkpoint(path: str) -> SimulatedPulsar:
     psr = SimulatedPulsar(
         ephem=meta["ephem"],
         par=par,
-        model=SpindownTiming(**meta["model"]),
+        model=_rebuild_model(meta["model"]),
         toas=toas,
         name=meta["name"],
         loc=meta["loc"],
@@ -77,6 +78,22 @@ def load_pulsar_checkpoint(path: str) -> SimulatedPulsar:
     )
     psr.update_residuals()
     return psr
+
+
+def _rebuild_model(meta_model: dict):
+    """Rebuild the timing model from its ``dataclasses.asdict`` form.
+
+    Composite :class:`TimingModel` checkpoints (current format) carry a
+    nested ``spin`` dict and an optional ``binary`` dict; flat dicts are
+    pre-round-2 :class:`SpindownTiming` checkpoints and stay loadable.
+    """
+    if "spin" not in meta_model:
+        return SpindownTiming(**meta_model)
+    kwargs = dict(meta_model)
+    kwargs["spin"] = SpindownTiming(**kwargs["spin"])
+    if kwargs.get("binary") is not None:
+        kwargs["binary"] = BinaryModel(**kwargs["binary"])
+    return TimingModel(**kwargs)
 
 
 def _jsonable(obj):
