@@ -67,6 +67,61 @@ def _fail(error: str):
     )
 
 
+def _stage_breakdown(batch, recipe, nreal: int = 20) -> dict:
+    """ms/realization for each injection stage, measured standalone
+    (separate jits, host-readback fencing), at the bench workload shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.models import batched as B
+
+    keys = jax.random.split(jax.random.PRNGKey(7), nreal)
+    args8 = [recipe.cgw_params[i] for i in range(8)]
+
+    def vm(f):
+        return jax.jit(lambda ks: jax.vmap(f)(ks))
+
+    M = recipe.orf_cholesky
+    stages = {
+        "white_noise": vm(lambda k: B.white_noise_delays(
+            k, batch, efac=recipe.efac, log10_equad=recipe.log10_equad)),
+        "jitter": vm(lambda k: B.jitter_delays(k, batch, recipe.log10_ecorr)),
+        "red_noise": vm(lambda k: B.red_noise_delays(
+            k, batch, recipe.rn_log10_amplitude, recipe.rn_gamma)),
+        "gwb": vm(lambda k: B.gwb_delays(
+            k, batch, recipe.gwb_log10_amplitude, recipe.gwb_gamma, M,
+            npts=recipe.gwb_npts, howml=recipe.gwb_howml)),
+        "quad_fit": vm(lambda k: B.quadratic_fit_subtract(
+            jax.random.normal(k, batch.toas_s.shape, batch.toas_s.dtype),
+            batch)),
+        "cgw_catalog_once": jax.jit(lambda ks: B.cgw_catalog_delays(
+            batch, *args8, chunk=recipe.cgw_chunk)
+            + 0.0 * ks[0, 0].astype(batch.toas_s.dtype)),
+    }
+
+    import numpy as np
+    import time
+
+    for f in stages.values():
+        np.asarray(f(keys))  # compile everything up front
+
+    # queue reps back-to-back, fence once (a per-call readback would
+    # measure the tunnel roundtrip, not the device); two interleaved
+    # passes + min per stage to shave tunnel-throughput drift
+    reps = 10
+    best = {}
+    for _ in range(2):
+        for name, f in stages.items():
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                r = f(keys)
+            float(jnp.sum(jnp.abs(r)))
+            per = (time.perf_counter() - t0) / reps
+            per /= 1 if name.endswith("_once") else nreal
+            best[name] = min(best.get(name, per), per)
+    return {name: round(per * 1e3, 4) for name, per in best.items()}
+
+
 def _bench():
     """The measured workload; runs in a child process (BENCH_CHILD=1)."""
     import jax
@@ -134,39 +189,88 @@ def _bench():
         cgw_backend=os.environ.get("BENCH_BACKEND", "auto"),
     )
 
-    # one-shot hardware cross-check of the two CW backends (the Pallas
-    # kernel had zero real-TPU evidence in round 1): resolve the backend
-    # the measured run will actually use (same auto-selection path as
-    # cgw_catalog_delays, honoring BENCH_BACKEND), then compare it
-    # against the portable scan path
-    extra = {"jax_backend": jax.default_backend()}
+    # ---- evidence block: self-authenticating metadata (ADVICE.md r2)
+    extra = {
+        "jax_backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "jax_version": jax.__version__,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+    # ---- real-data ingest timing (VERDICT r2 item 8): par/tim -> frozen
+    # batch cold start on the one real NANOGrav fixture with a tim file
     try:
-        used = recipe.cgw_backend
-        if used == "auto":
-            used = (
-                "pallas"
-                if jax.default_backend() == "tpu"
-                and B._pallas_usable(
-                    batch.npsr, batch.ntoa_max, ncw, batch.toas_s.dtype,
-                    True, True,
-                )
-                else "scan"
+        par = "/root/reference/test_partim/par/B1855+09.par"
+        tim = "/root/reference/test_partim/tim/B1855+09.tim"
+        if os.path.exists(par) and os.path.exists(tim):
+            from pta_replicator_tpu import load_pulsar, make_ideal
+            from pta_replicator_tpu.batch import freeze
+
+            t0 = time.perf_counter()
+            psr = load_pulsar(par, tim)
+            make_ideal(psr)
+            b1855 = freeze([psr], dtype=jnp.float32)
+            extra["ingest_b1855_s"] = round(time.perf_counter() - t0, 3)
+            extra["ingest_b1855_ntoa"] = int(b1855.ntoa_max)
+    except Exception as exc:
+        extra["ingest_error"] = repr(exc)
+
+    # ---- CW backend evidence: probe the Pallas kernel on this hardware
+    # and measure BOTH backends (auto resolves to scan — docs/DESIGN.md
+    # section 4 — so this is where the demotion decision re-tests itself
+    # each round). A failed probe records its exception string.
+    args8 = [recipe.cgw_params[i] for i in range(8)]
+
+    # one jitted fn per backend, reused across interleaved passes (a
+    # fresh closure per pass would recompile the full CW graph each
+    # time). The traced scalar input keeps the graph from being
+    # constant-folded, which would fake a near-zero scan timing and
+    # corrupt the scan-vs-pallas evidence.
+    _cw_fns = {
+        backend: jax.jit(
+            lambda eps, backend=backend: B.cgw_catalog_delays(
+                batch, *args8, chunk=recipe.cgw_chunk, backend=backend
             )
+            + eps
+        )
+        for backend in ("scan", "pallas")
+    }
+
+    def _time_cw(backend, reps=10):
+        fn = _cw_fns[backend]
+        zero = jnp.zeros((), batch.toas_s.dtype)
+        np.asarray(fn(zero))  # compile (cached after first pass) + run
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(zero)
+        np.asarray(out)  # host readback fences the FIFO queue
+        return (time.perf_counter() - t0) / reps * 1e3, out
+
+    try:
+        used = recipe.cgw_backend if recipe.cgw_backend != "auto" else "scan"
         extra["cgw_backend_used"] = used
-        if used != "scan":
-            d_used = B.cgw_catalog_delays(
-                batch, *[recipe.cgw_params[i] for i in range(8)],
-                chunk=recipe.cgw_chunk, backend=used,
+        if jax.default_backend() == "tpu":
+            ok = B._pallas_usable(
+                batch.npsr, batch.ntoa_max, ncw, batch.toas_s.dtype,
+                True, True,
             )
-            d_scan = B.cgw_catalog_delays(
-                batch, *[recipe.cgw_params[i] for i in range(8)],
-                chunk=recipe.cgw_chunk, backend="scan",
-            )
-            num = float(np.asarray(jnp.sqrt(jnp.mean((d_used - d_scan) ** 2))))
-            den = float(np.asarray(jnp.sqrt(jnp.mean(d_scan**2))))
-            extra["cgw_vs_scan_rel_rms"] = num / den if den else 0.0
+            extra["pallas_probe"] = B.pallas_probe_report()
+            # interleave the two backends and keep per-backend minima:
+            # tunnel throughput drifts by tens of percent between blocks,
+            # more than the backends differ from each other
+            t_scan, d_scan = _time_cw("scan")
+            if ok:
+                t_pal, d_pal = _time_cw("pallas")
+                t_scan = min(t_scan, _time_cw("scan")[0])
+                t_pal = min(t_pal, _time_cw("pallas")[0])
+                extra["cgw_pallas_ms"] = round(t_pal, 3)
+                num = float(np.asarray(jnp.sqrt(jnp.mean((d_pal - d_scan) ** 2))))
+                den = float(np.asarray(jnp.sqrt(jnp.mean(d_scan**2))))
+                extra["cgw_pallas_vs_scan_rel_rms"] = num / den if den else 0.0
+            extra["cgw_scan_ms"] = round(t_scan, 3)
     except Exception as exc:  # cross-check must never kill the bench
         extra["cgw_crosscheck_error"] = repr(exc)
+
 
     chunk = int(os.environ.get("BENCH_CHUNK", "100"))  # realizations/call
 
@@ -187,22 +291,55 @@ def _bench():
             jnp.sum(res**2 * batch.mask, axis=-1) / jnp.sum(batch.mask, axis=-1)
         )
 
-    # warm-up / compile. NOTE: sync via host readback of the (chunk, Np)
+    # AOT-compile once and reuse the SAME executable for warm-up, the
+    # timed loop, and cost_analysis (calling the jit wrapper after
+    # .lower().compile() would build a second executable — minutes of
+    # extra compile on the tunneled backend, risking BENCH_TIMEOUT)
+    compiled = run_chunk.lower(jax.random.PRNGKey(0)).compile()
+
+    # warm-up. NOTE: sync via host readback of the (chunk, Np)
     # reduction, not block_until_ready() — on the remote-tunneled TPU
     # backend block_until_ready returns at dispatch, before execution.
     # Device execution is FIFO, so reading the last chunk's result back
     # fences every queued chunk.
-    out = run_chunk(jax.random.PRNGKey(0))
+    out = compiled(jax.random.PRNGKey(0))
     np.asarray(out)
 
     nrep = int(os.environ.get("BENCH_NREP", "5"))
     t0 = time.perf_counter()
     for i in range(nrep):
-        out = run_chunk(jax.random.PRNGKey(i + 1))
+        out = compiled(jax.random.PRNGKey(i + 1))
     np.asarray(out)
     elapsed = time.perf_counter() - t0
 
     rate = nrep * chunk / elapsed
+    extra["measure_elapsed_s"] = round(elapsed, 3)
+
+    # ---- achieved FLOP/s + MFU from XLA's own cost model (VERDICT r2
+    # weak #3: "fast" must be a measured claim). Peak reference: bf16
+    # MXU peak for the recorded device_kind; the workload is f32, so
+    # this MFU is a conservative lower bound on hardware utilization.
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops_per_chunk = float(ca.get("flops", 0.0))
+        if flops_per_chunk > 0:
+            achieved = flops_per_chunk * nrep / elapsed
+            extra["xla_flops_per_chunk"] = flops_per_chunk
+            extra["achieved_tflops_per_s"] = round(achieved / 1e12, 3)
+            peak = {"TPU v5 lite": 197e12}.get(extra["device_kind"])
+            if peak:
+                extra["mfu_vs_bf16_peak_pct"] = round(100 * achieved / peak, 3)
+    except Exception as exc:
+        extra["cost_analysis_error"] = repr(exc)
+
+    # ---- per-stage breakdown (VERDICT r2 item 3): ms per realization of
+    # each injection op, measured standalone over a small key batch
+    try:
+        extra["stages_ms_per_realization"] = _stage_breakdown(batch, recipe)
+    except Exception as exc:
+        extra["stage_breakdown_error"] = repr(exc)
     print(
         json.dumps(
             {

@@ -297,23 +297,36 @@ def gwb_delays(
 #: cached result of the one-shot Pallas viability probe, keyed by the
 #: (npsr, toa_tile, src_tile, dtype, psr_term, evolve) kernel variant
 _PALLAS_PROBE: dict = {}
+#: diagnosis of failed probes, same keys — surfaced by the bench JSON so
+#: an on-hardware Mosaic failure is recorded evidence, not a lost warning
+_PALLAS_PROBE_ERRORS: dict = {}
+
+
+def pallas_probe_report() -> dict:
+    """Outcome of every Pallas viability probe run in this process:
+    ``{key-string: True | error-string}``."""
+    return {
+        " ".join(map(str, k)): _PALLAS_PROBE_ERRORS.get(k, ok)
+        for k, ok in _PALLAS_PROBE.items()
+    }
 
 
 def _pallas_usable(
     npsr: int, ntoa: int, nsrc: int, dtype, psr_term: bool, evolve: bool
 ) -> bool:
     """Compile-and-run the Pallas CW kernel once at exactly the tile
-    sizes, pulsar count, and dtype the production call will use on the
-    current default backend. ``backend='auto'`` consults this so a Mosaic
-    compile or runtime failure degrades the flagship op to the portable
-    scan path instead of taking it down (the kernel had zero
-    real-hardware evidence in round 1 — ADVICE.md). A failed probe is
-    cached and warns once; callers who believe the failure was transient
-    can clear ``_PALLAS_PROBE`` or pass ``backend='pallas'`` explicitly."""
+    sizes, pulsar count, and dtype a production ``backend='pallas'`` call
+    would use on the current default backend. Since round 3 the library's
+    ``backend='auto'`` no longer consults this (auto is always scan —
+    docs/DESIGN.md section 4); the probe remains the viability evidence
+    path for bench.py, which records each probe's outcome or exception
+    string per round. A failed probe is cached and warns once; clear
+    ``_PALLAS_PROBE`` to retry."""
     # mirror cw_catalog_response's tile derivation so the probe compiles
     # the same kernel instantiation production will
-    src_tile = min(128, max(8, nsrc))
-    toa_tile = min(1024, max(128, ntoa))
+    from ..ops.pallas_cw import cw_tiles
+
+    src_tile, toa_tile = cw_tiles(nsrc, ntoa)
     key = (
         npsr, toa_tile, src_tile, jnp.dtype(dtype).name, psr_term, evolve,
     )
@@ -351,6 +364,7 @@ def _pallas_usable(
                 f"back to 'scan' for this process: {exc!r}"
             )
             _PALLAS_PROBE[key] = False
+            _PALLAS_PROBE_ERRORS[key] = repr(exc)
     return _PALLAS_PROBE[key]
 
 
@@ -456,9 +470,10 @@ def cgw_catalog_delays(
       tiles (the (chunk x Nt) workspace stays VMEM-scale while the scan
       accumulates the (Np, Nt) sum).
 
-    ``"auto"`` picks pallas on TPU backends (after a one-shot compile
-    probe), scan elsewhere. Deterministic (no key): source parameters are
-    data.
+    ``"auto"`` picks scan on every backend (measured statistically tied
+    with the kernel on a real v5e, and scan has no Mosaic failure modes —
+    docs/DESIGN.md section 4); pass ``"pallas"`` explicitly to use the
+    kernel. Deterministic (no key): source parameters are data.
     """
     from ..ops.pallas_cw import cw_catalog_planes, cw_catalog_response
 
@@ -495,14 +510,14 @@ def cgw_catalog_delays(
 
     nsrc = src_c.shape[1]
     if backend == "auto":
-        backend = (
-            "pallas"
-            if jax.default_backend() == "tpu"
-            and _pallas_usable(
-                batch.npsr, batch.ntoa_max, nsrc, dtype, psr_term, evolve
-            )
-            else "scan"
-        )
+        # scan everywhere: on a real v5e the (working, bit-identical)
+        # Pallas kernel and XLA's fused scan measure statistically tied
+        # at the flagship shape, so the portable path with no
+        # Mosaic-compile or vmem-budget failure modes wins by default —
+        # docs/DESIGN.md section 4 records the full diagnosis. 'pallas'
+        # remains available explicitly, and bench.py re-measures both
+        # backends every round.
+        backend = "scan"
     if backend not in ("pallas", "pallas_interpret", "scan"):
         raise ValueError(f"unknown CW-catalog backend {backend!r}")
     if backend in ("pallas", "pallas_interpret"):
