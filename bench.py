@@ -73,34 +73,10 @@ def _stage_breakdown(batch, recipe, nreal: int = 20) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.utils.profiling import injection_stage_fns
 
     keys = jax.random.split(jax.random.PRNGKey(7), nreal)
-    args8 = [recipe.cgw_params[i] for i in range(8)]
-
-    def vm(f):
-        return jax.jit(lambda ks: jax.vmap(f)(ks))
-
-    M = recipe.orf_cholesky
-    stages = {
-        "white_noise": vm(lambda k: B.white_noise_delays(
-            k, batch, efac=recipe.efac, log10_equad=recipe.log10_equad)),
-        "jitter": vm(lambda k: B.jitter_delays(k, batch, recipe.log10_ecorr)),
-        "red_noise": vm(lambda k: B.red_noise_delays(
-            k, batch, recipe.rn_log10_amplitude, recipe.rn_gamma)),
-        "gwb": vm(lambda k: B.gwb_delays(
-            k, batch, recipe.gwb_log10_amplitude, recipe.gwb_gamma, M,
-            npts=recipe.gwb_npts, howml=recipe.gwb_howml)),
-        "quad_fit": vm(lambda k: B.quadratic_fit_subtract(
-            jax.random.normal(k, batch.toas_s.shape, batch.toas_s.dtype),
-            batch)),
-        "cgw_catalog_once": jax.jit(lambda ks: B.cgw_catalog_delays(
-            batch, *args8, chunk=recipe.cgw_chunk)
-            + 0.0 * ks[0, 0].astype(batch.toas_s.dtype)),
-    }
-
-    import numpy as np
-    import time
+    stages = injection_stage_fns(batch, recipe)
 
     for f in stages.values():
         np.asarray(f(keys))  # compile everything up front

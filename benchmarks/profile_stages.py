@@ -21,7 +21,15 @@ def main():
                     help="3x122 toy shapes instead of NG15 scale")
     args = ap.parse_args()
 
+    import os
+
     import jax
+
+    # honor JAX_PLATFORMS even when a pre-registered remote-TPU plugin
+    # overrode it at interpreter start (same treatment as bench.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     import jax.numpy as jnp
 
     from pta_replicator_tpu.batch import synthetic_batch
@@ -49,27 +57,28 @@ def main():
         10 ** rng.uniform(-8.8, -7.6, ncw), rng.uniform(0, 2 * np.pi, ncw),
         rng.uniform(0, np.pi, ncw), np.arccos(rng.uniform(-1, 1, ncw)),
     ]))
+    recipe = B.Recipe(
+        efac=jnp.asarray(1.1),
+        log10_equad=jnp.asarray(-6.5),
+        log10_ecorr=jnp.asarray(-6.5),
+        rn_log10_amplitude=jnp.asarray(-14.0),
+        rn_gamma=jnp.asarray(4.33),
+        gwb_log10_amplitude=jnp.asarray(-14.0),
+        gwb_gamma=jnp.asarray(4.33),
+        orf_cholesky=M,
+        cgw_params=cat,
+        gwb_npts=npts,
+        gwb_howml=howml,
+        cgw_chunk=ncw,
+    )
 
     R = args.nreal
     keys = jax.random.split(jax.random.PRNGKey(0), R)
 
-    def vm(f):
-        return jax.jit(lambda ks: jax.vmap(f)(ks))
+    # one stage table shared with bench.py's per-stage evidence
+    from pta_replicator_tpu.utils.profiling import injection_stage_fns
 
-    stages = {
-        "white_noise": vm(lambda k: B.white_noise_delays(
-            k, batch, efac=1.1, log10_equad=-6.5)),
-        "jitter": vm(lambda k: B.jitter_delays(k, batch, -6.5)),
-        "red_noise": vm(lambda k: B.red_noise_delays(k, batch, -14.0, 4.33)),
-        "gwb": vm(lambda k: B.gwb_delays(
-            k, batch, -14.0, 4.33, M, npts=npts, howml=howml)),
-        "quad_fit": vm(lambda k: B.quadratic_fit_subtract(
-            jax.random.normal(k, batch.toas_s.shape, batch.toas_s.dtype),
-            batch)),
-        "cgw_catalog(once)": jax.jit(lambda ks: B.cgw_catalog_delays(
-            batch, *[cat[i] for i in range(8)], chunk=ncw)
-            + 0.0 * ks[0, 0].astype(batch.toas_s.dtype)),
-    }
+    stages = injection_stage_fns(batch, recipe)
 
     def run(f):
         t0 = time.perf_counter()
@@ -80,11 +89,12 @@ def main():
     for name, f in stages.items():
         t_compile = run(f)
         t_run = min(run(f) for _ in range(3))
+        per_real = t_run / (1 if name.endswith("_once") else R)
         print(json.dumps({
             "stage": name,
             "compile_plus_run_s": round(t_compile, 3),
             "run_s": round(t_run, 4),
-            "per_realization_ms": round(1e3 * t_run / R, 3),
+            "per_realization_ms": round(1e3 * per_real, 3),
         }), flush=True)
 
 

@@ -46,6 +46,26 @@ def _per_toa(params, index, mask):
     return jnp.take_along_axis(params, index, axis=1) * mask
 
 
+def _rows_draw(draw, key, rows, local_shape, *args):
+    """Draw a pulsar-major random block, optionally as an exact row
+    window of the *global* draw.
+
+    ``rows=None``: plain ``draw(key, local_shape, *args)``. Under a
+    pulsar-sharded ``shard_map`` (parallel.mesh.shardmap_realize),
+    ``rows=(npsr_global, row_start)``: every shard regenerates the full
+    (npsr_global, ...) stream from the replicated key and slices its own
+    rows — bitwise equal to the unsharded computation, with zero
+    collectives (same device-replicated-RNG idea as the GWB mix in
+    :func:`gwb_delays`). The redundant generation is cheap next to the
+    ops that consume it.
+    """
+    if rows is None:
+        return draw(key, local_shape, *args)
+    npsr_global, row_start = rows
+    full = draw(key, (npsr_global,) + tuple(local_shape[1:]), *args)
+    return jax.lax.dynamic_slice_in_dim(full, row_start, local_shape[0], 0)
+
+
 # ------------------------------------------------------------- injection ops
 
 def white_noise_delays(
@@ -54,14 +74,16 @@ def white_noise_delays(
     efac=1.0,
     log10_equad=None,
     tnequad: bool = False,
+    rows=None,
 ):
     """EFAC/EQUAD white noise. ``efac``/``log10_equad`` are scalars, (Np,)
-    vectors, or (Np, n_backends) per-backend tables."""
+    vectors, or (Np, n_backends) per-backend tables. ``rows``: global-row
+    window for pulsar-sharded SPMD (see :func:`_rows_draw`)."""
     dtype = batch.toas_s.dtype
     k1, k2 = jax.random.split(key)
     shape = batch.toas_s.shape
-    eps1 = jax.random.normal(k1, shape, dtype)
-    eps2 = jax.random.normal(k2, shape, dtype)
+    eps1 = _rows_draw(jax.random.normal, k1, rows, shape, dtype)
+    eps2 = _rows_draw(jax.random.normal, k2, rows, shape, dtype)
     ef = jnp.asarray(efac, dtype)
     ef = jnp.broadcast_to(ef, (batch.npsr,)) if ef.ndim == 0 else ef
     efac_t = _per_toa(ef, batch.backend_index, batch.mask)
@@ -77,11 +99,13 @@ def white_noise_delays(
     return dt + efac_t * equad_t * eps2
 
 
-def jitter_delays(key, batch: PulsarBatch, log10_ecorr):
+def jitter_delays(key, batch: PulsarBatch, log10_ecorr, rows=None):
     """ECORR jitter: one draw per (pulsar, epoch), scaled per-epoch and
-    gathered onto TOAs. ``log10_ecorr``: scalar, (Np,), or (Np, NB)."""
-    eps = jax.random.normal(
-        key, (batch.npsr, batch.max_epochs), batch.toas_s.dtype
+    gathered onto TOAs. ``log10_ecorr``: scalar, (Np,), or (Np, NB).
+    ``rows``: global-row window for pulsar-sharded SPMD."""
+    eps = _rows_draw(
+        jax.random.normal, key, rows,
+        (batch.npsr, batch.max_epochs), batch.toas_s.dtype,
     )
     ec = 10.0 ** jnp.asarray(log10_ecorr, batch.toas_s.dtype)
     if ec.ndim == 0:
@@ -172,6 +196,7 @@ def red_noise_delays(
     libstempo_convention: bool = False,
     tspan_s=None,
     eps=None,
+    rows=None,
 ):
     """Per-pulsar power-law red noise on the rank-reduced Fourier basis.
 
@@ -188,8 +213,9 @@ def red_noise_delays(
     if pshift and phase_shift is None:
         k_eps, k_shift = jax.random.split(key)
         nm = nmodes if modes is None else len(modes)
-        phase_shift = jax.random.uniform(
-            k_shift, (batch.npsr, nm), dtype, 0.0, 2.0 * jnp.pi
+        phase_shift = _rows_draw(
+            jax.random.uniform, k_shift, rows,
+            (batch.npsr, nm), dtype, 0.0, 2.0 * jnp.pi,
         )
     else:
         k_eps = key
@@ -199,7 +225,7 @@ def red_noise_delays(
         libstempo_convention=libstempo_convention, tspan_s=tspan_s,
     )
     if eps is None:
-        eps = jax.random.normal(k_eps, prior2.shape, dtype)
+        eps = _rows_draw(jax.random.normal, k_eps, rows, prior2.shape, dtype)
     coeff = jnp.sqrt(prior2) * jnp.asarray(eps, dtype)
     return jnp.einsum("pnk,pk->pn", F, coeff) * batch.mask
 
@@ -252,7 +278,14 @@ def gwb_delays(
     nf = f.shape[0]
     dur = batch.stop_s - batch.start_s
 
-    w = jax.random.normal(key, (2, batch.npsr, nf), dtype)
+    # draw per-pulsar spectra at the ORF's *column* count, not batch.npsr:
+    # identical when M is the usual square (Np, Np) factor, but under
+    # explicit pulsar sharding (shard_map with M rows sharded over 'psr')
+    # every shard holds a (Np_local, Np_global) row block and — because
+    # the key is replicated — regenerates the same global w, so the local
+    # mix M_local @ w equals the corresponding rows of the unsharded
+    # result with zero collectives (parallel/mesh.shardmap_realize).
+    w = jax.random.normal(key, (2, jnp.shape(orf_cholesky)[1], nf), dtype)
     w = jax.lax.complex(w[0], w[1])
 
     hcf = characteristic_strain(
@@ -428,7 +461,11 @@ def _cw_scan_response(
         src_tile, psr_tile = tiles
         return carry + per_psr(toas_rel, psr_tile, src_tile), None
 
-    init = jnp.zeros(toas_rel.shape, dtype)
+    # derive the carry init from the (possibly device-varying) input so
+    # its sharding/vma type matches the body output under shard_map with
+    # a sharded pulsar axis (a fresh jnp.zeros is 'unvarying' and fails
+    # scan's carry type check there)
+    init = toas_rel * jnp.zeros((), dtype)
     total, _ = jax.lax.scan(step, init, (src_tiles, psr_tiles))
     return total
 
@@ -671,14 +708,21 @@ class Recipe:
     cgw_psr_term: bool = field(metadata=dict(static=True), default=True)
     cgw_evolve: bool = field(metadata=dict(static=True), default=True)
     cgw_phase_approx: bool = field(metadata=dict(static=True), default=False)
-    #: CW-catalog backend: "auto" (pallas on TPU, scan elsewhere),
-    #: "pallas", "pallas_interpret", or "scan"
+    #: CW-catalog backend: "auto" (resolves to "scan" everywhere — the
+    #: Pallas kernel measures tied on a real v5e and has more failure
+    #: modes, docs/DESIGN.md section 4), "pallas", "pallas_interpret",
+    #: or "scan"
     cgw_backend: str = field(metadata=dict(static=True), default="auto")
     transient_psr: int = field(metadata=dict(static=True), default=0)
 
 
-def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
-    """One realization: (Np, Nt) summed delays from the enabled signals."""
+def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
+    """One realization: (Np, Nt) summed delays from the enabled signals.
+
+    ``rows=(npsr_global, row_start)`` runs the stochastic draws as exact
+    row windows of the global streams (pulsar-sharded SPMD — see
+    :func:`_rows_draw`; the GWB handles its own globality through the
+    sharded ORF rows)."""
     k_wn, k_ec, k_rn, k_gwb = jax.random.split(key, 4)
     total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
     if recipe.efac is not None or recipe.log10_equad is not None:
@@ -688,9 +732,10 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             efac=recipe.efac if recipe.efac is not None else 1.0,
             log10_equad=recipe.log10_equad,
             tnequad=recipe.tnequad,
+            rows=rows,
         )
     if recipe.log10_ecorr is not None:
-        total = total + jitter_delays(k_ec, batch, recipe.log10_ecorr)
+        total = total + jitter_delays(k_ec, batch, recipe.log10_ecorr, rows=rows)
     if recipe.rn_log10_amplitude is not None:
         total = total + red_noise_delays(
             k_rn,
@@ -705,6 +750,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe):
             pshift=recipe.rn_pshift,
             libstempo_convention=recipe.rn_libstempo,
             tspan_s=recipe.rn_tspan_s,
+            rows=rows,
         )
     if recipe.gwb_log10_amplitude is not None or recipe.gwb_user_spectrum is not None:
         if recipe.orf_cholesky is None:

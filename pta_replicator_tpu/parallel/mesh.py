@@ -97,12 +97,17 @@ def sharded_realize(
     return _constraint_engine(mesh, fit)(keys, batch, recipe)
 
 
-def _realize_block(keys, batch: PulsarBatch, recipe: Recipe, fit: bool):
-    """The per-block realization pipeline shared by both mesh engines."""
+def _realize_block(
+    keys, batch: PulsarBatch, recipe: Recipe, fit: bool, rows=None
+):
+    """The per-block realization pipeline shared by both mesh engines.
+
+    ``rows=(npsr_global, row_start)`` makes every stochastic draw an
+    exact row window of the global stream (pulsar-sharded shard_map)."""
     static = deterministic_delays(batch, recipe)
 
     def one(k):
-        d = realization_delays(k, batch, recipe) + static
+        d = realization_delays(k, batch, recipe, rows=rows) + static
         d = quadratic_fit_subtract(d, batch) if fit else d
         return residualize(d, batch)
 
@@ -124,26 +129,106 @@ def _constraint_engine(mesh: Mesh, fit: bool):
     return run
 
 
-@functools.lru_cache(maxsize=64)
-def _shardmap_engine(mesh: Mesh, fit: bool):
-    """Jitted shard_map engine, cached per (mesh, fit). P() acts as a
-    prefix spec: the whole batch/recipe trees replicate."""
+def _shard_map():
     try:
         from jax import shard_map  # jax >= 0.8
     except ImportError:  # pragma: no cover
         from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+@functools.lru_cache(maxsize=64)
+def _shardmap_engine(mesh: Mesh, fit: bool):
+    """Jitted shard_map engine, cached per (mesh, fit). P() acts as a
+    prefix spec: the whole batch/recipe trees replicate."""
 
     def local(keys_shard, batch, recipe):
         return _realize_block(keys_shard, batch, recipe, fit)
 
     return jax.jit(
-        shard_map(
+        _shard_map()(
             local,
             mesh=mesh,
             in_specs=(P("real"), P(), P()),
             out_specs=P("real"),
         )
     )
+
+
+@functools.lru_cache(maxsize=64)
+def _shardmap_psr_engine(mesh: Mesh, fit: bool, recipe_treedef, recipe_specs):
+    """Jitted shard_map engine for meshes with a sharded pulsar axis.
+
+    The batch (all leaves pulsar-major) shards along 'psr' via a prefix
+    spec; per-pulsar recipe leaves get per-leaf specs (built by the
+    caller, cached here by their flattened form). The GWB ORF Cholesky
+    rows shard with the pulsars, and gwb_delays regenerates the global
+    per-pulsar spectra from the replicated key, so the cross-pulsar mix
+    needs no collective (see gwb_delays).
+    """
+    recipe_spec_tree = jax.tree_util.tree_unflatten(
+        recipe_treedef, list(recipe_specs)
+    )
+    n_shards = mesh.shape["psr"]
+
+    def local(keys_shard, batch, recipe):
+        rows = (
+            batch.npsr * n_shards,
+            jax.lax.axis_index("psr") * batch.npsr,
+        )
+        return _realize_block(keys_shard, batch, recipe, fit, rows=rows)
+
+    return jax.jit(
+        _shard_map()(
+            local,
+            mesh=mesh,
+            in_specs=(P("real"), P("psr"), recipe_spec_tree),
+            out_specs=P("real", "psr"),
+        )
+    )
+
+
+#: Recipe fields whose leading axis is the pulsar axis (sharded along
+#: 'psr' in the explicit-SPMD engine). Dispatching by NAME, not by
+#: shape: a shape heuristic mis-shards any unrelated leaf whose leading
+#: dim happens to equal npsr (e.g. the (8, Ns) cgw_params on an
+#: 8-pulsar array, or npsr explicit rn_modes).
+_PSR_MAJOR_RECIPE_FIELDS = frozenset(
+    {
+        "efac",
+        "log10_equad",
+        "log10_ecorr",
+        "rn_log10_amplitude",
+        "rn_gamma",
+        "rn_fmin",
+        "rn_fmax",
+        "rn_tspan_s",
+        "orf_cholesky",
+    }
+)
+#: per-pulsar only in their 2-D (Np, Ns) form ((Ns,) / scalar replicate)
+_PSR_MAJOR_IF_2D_FIELDS = frozenset({"cgw_pdist", "cgw_pphase"})
+
+
+def _recipe_psr_specs(recipe: Recipe, npsr: int):
+    """Per-leaf PartitionSpecs for a psr-sharded shard_map engine."""
+
+    def spec_for(path, leaf):
+        name = path[0].name if path else ""
+        ndim = getattr(leaf, "ndim", 0)
+        psr_major = (name in _PSR_MAJOR_RECIPE_FIELDS and ndim >= 1) or (
+            name in _PSR_MAJOR_IF_2D_FIELDS and ndim == 2
+        )
+        if not psr_major:
+            return P()
+        if leaf.shape[0] != npsr:
+            raise ValueError(
+                f"Recipe.{name} has leading dim {leaf.shape[0]}, expected "
+                f"npsr={npsr} for a pulsar-sharded mesh"
+            )
+        return P("psr")
+
+    return jax.tree_util.tree_map_with_path(spec_for, recipe)
 
 
 def shardmap_realize(
@@ -156,22 +241,54 @@ def shardmap_realize(
 ):
     """Explicit-SPMD variant of :func:`sharded_realize` via ``shard_map``:
     every device runs the per-shard program on its own block of PRNG keys
-    with the batch replicated — zero collectives by construction (the
-    realization axis is embarrassingly parallel), which also makes it the
-    natural multi-host form (each host computes exactly its shards,
-    scaling-book style). Results are identical to the constraint-based
-    path for any mesh with an unsharded pulsar axis.
+    — zero collectives by construction, which also makes it the natural
+    multi-host form (each host computes exactly its shards, scaling-book
+    style). With ``n_psr == 1`` the batch replicates; with a sharded
+    pulsar axis the batch and the per-pulsar recipe leaves (incl. the ORF
+    Cholesky rows) shard along 'psr', and the GWB mix stays
+    collective-free because every shard regenerates the same global
+    frequency draws from the replicated key (see gwb_delays). Results are
+    identical to the constraint-based path either way
+    (test_shardmap_matches_constraint_path).
     """
     if mesh is None:
         mesh = make_mesh()
     n_real_axis = mesh.shape["real"]
     if nreal % n_real_axis:
         raise ValueError(f"nreal={nreal} not divisible by mesh 'real'={n_real_axis}")
-    if mesh.shape.get("psr", 1) != 1:
+    keys = jax.random.split(key, nreal)
+
+    n_psr_axis = mesh.shape.get("psr", 1)
+    if n_psr_axis == 1:
+        return _shardmap_engine(mesh, fit)(keys, batch, recipe)
+
+    npsr = batch.npsr
+    if npsr % n_psr_axis:
         raise ValueError(
-            "shardmap_realize replicates the pulsar axis; use a mesh with "
-            "n_psr=1 (sharded_realize supports pulsar sharding)"
+            f"npsr={npsr} not divisible by mesh 'psr'={n_psr_axis}"
+        )
+    if getattr(recipe, "transient_waveform", None) is not None:
+        raise ValueError(
+            "noise transients target a global pulsar index and are not "
+            "supported with a sharded pulsar axis; use n_psr=1 or "
+            "sharded_realize"
+        )
+    if (
+        recipe.gwb_log10_amplitude is not None
+        or recipe.gwb_user_spectrum is not None
+    ) and recipe.orf_cholesky is None:
+        # materialize the uncorrelated-GWB fallback at GLOBAL size so its
+        # rows shard correctly (a per-shard identity would hand every
+        # shard the same draws)
+        import dataclasses
+
+        recipe = dataclasses.replace(
+            recipe,
+            orf_cholesky=jnp.sqrt(2.0)
+            * jnp.eye(npsr, dtype=batch.toas_s.dtype),
         )
 
-    keys = jax.random.split(key, nreal)
-    return _shardmap_engine(mesh, fit)(keys, batch, recipe)
+    spec_tree = _recipe_psr_specs(recipe, npsr)
+    leaves, treedef = jax.tree_util.tree_flatten(spec_tree)
+    engine = _shardmap_psr_engine(mesh, fit, treedef, tuple(leaves))
+    return engine(keys, batch, recipe)

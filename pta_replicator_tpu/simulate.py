@@ -240,15 +240,50 @@ class SimulatedPulsar:
             dict(self.loc),
         )
 
-    def to_enterprise(self, ephem: str = "DE440"):
-        """Reference analog simulate.py:91-95. Not supported: enterprise's
-        PintPulsar wraps a PINT model, which this standalone framework does
-        not carry. Export via :meth:`to_arrays` or :meth:`write_partim`
-        (the written par/tim pair loads directly into enterprise)."""
-        raise NotImplementedError(
-            "to_enterprise requires a PINT timing model; use to_arrays() or "
-            "write_partim() and load the par/tim pair into enterprise."
-        )
+    def to_enterprise(
+        self,
+        ephem: str = "DE440",
+        timing_package: str = "pint",
+        tmpdir: str = None,
+        **kwargs,
+    ):
+        """Convert to an ``enterprise.pulsar.Pulsar`` for downstream PTA
+        analysis (reference analog simulate.py:91-95).
+
+        ``enterprise`` is an *optional* dependency (it is not required by
+        this standalone framework): when importable, the conversion
+        round-trips through a freshly written par/tim pair — the same
+        dataset ``write_partim`` persists, which is byte-equivalent to
+        what the reference's mutated TOAs represent — and hands it to
+        enterprise's loader (``timing_package='pint'`` to match the
+        reference, or ``'tempo2'``/libstempo). When enterprise is absent,
+        raises ImportError naming the manual equivalent. Extra ``kwargs``
+        forward to ``enterprise.pulsar.Pulsar``.
+        """
+        try:
+            from enterprise.pulsar import Pulsar
+        except ImportError as exc:
+            raise ImportError(
+                "to_enterprise needs the optional 'enterprise-pulsar' "
+                "package (with its PINT or libstempo backend). Manual "
+                "equivalent: psr.write_partim(par, tim); "
+                "enterprise.pulsar.Pulsar(par, tim)."
+            ) from exc
+
+        import os
+        import tempfile
+
+        with tempfile.TemporaryDirectory(dir=tmpdir) as d:
+            parfile = os.path.join(d, f"{self.name or 'pulsar'}.par")
+            timfile = os.path.join(d, f"{self.name or 'pulsar'}.tim")
+            self.write_partim(parfile, timfile)
+            return Pulsar(
+                parfile,
+                timfile,
+                ephem=ephem,
+                timing_package=timing_package,
+                **kwargs,
+            )
 
 
 def _locate(par: ParModel) -> dict:

@@ -126,36 +126,108 @@ def noise_covariance(
     return C
 
 
-def covariance_from_recipe(psr, recipe, coarsegrain: float = 0.1, xp=np):
-    """Noise covariance for one oracle pulsar from a device Recipe's
-    scalar/per-pulsar noise parameters (per-backend tables are averaged —
-    the GLS covariance is a weighting, not a likelihood).
+def covariance_from_recipe(
+    psr,
+    recipe,
+    coarsegrain: float = 0.1,
+    xp=np,
+    psr_index=None,
+    backend_names=None,
+    flagid: str = "f",
+):
+    """Noise covariance for one oracle pulsar from a device Recipe.
+
+    Recipe leaves resolve exactly, never by averaging: scalars pass
+    through, (Np,) per-pulsar vectors are selected by ``psr_index``, and
+    (Np, NB) per-backend tables are gathered per TOA against
+    ``backend_names`` (the :class:`~pta_replicator_tpu.batch.PulsarBatch`
+    vocabulary the tables were built for, matched on the ``flagid`` TOA
+    flag — same rule as the freeze step). ECORR tables become per-epoch
+    values through the same flag-aware quantization and first-TOA-of-epoch
+    backend assignment the batch uses, so multi-backend GLS weighting
+    matches the injected noise instead of its mean (reference analog:
+    PINT's GLSFitter consuming the full per-backend noise model,
+    /root/reference/pta_replicator/simulate.py:57-61).
     """
     import numpy as _np
 
     from ..constants import DAY_IN_SEC
     from ..ops.quantize import quantize
 
-    def scalarize(v):
-        return None if v is None else float(_np.mean(_np.asarray(v)))
+    mjds = psr.toas.get_mjds()
 
-    errors = psr.toas.errors_s
-    toas_s = psr.toas.get_mjds() * DAY_IN_SEC
-    efac = scalarize(recipe.efac) or 1.0
-    equad = 10.0 ** scalarize(recipe.log10_equad) if recipe.log10_equad is not None else 0.0
-    ecorr = 10.0 ** scalarize(recipe.log10_ecorr) if recipe.log10_ecorr is not None else None
-    epoch_index = None
-    if ecorr is not None:
-        epoch_index = quantize(psr.toas.get_mjds(), dt=coarsegrain).epoch_index
+    def row(v):
+        v = _np.asarray(v, dtype=_np.float64)
+        if v.ndim == 0:
+            return v
+        if psr_index is None:
+            raise ValueError(
+                "recipe carries per-pulsar arrays; pass psr_index (the "
+                "pulsar's row in the tables), and backend_names for "
+                "(Np, NB) per-backend tables"
+            )
+        return v[psr_index]
+
+    def flag_indices(values):
+        """Map flag values onto backend_names columns (freeze vocab)."""
+        if backend_names is None:
+            raise ValueError(
+                "per-backend (Np, NB) recipe tables need backend_names "
+                "(PulsarBatch.backend_names) to map TOA flags to columns"
+            )
+        vocab = {str(name): k for k, name in enumerate(backend_names)}
+        values = [str(v) for v in values]
+        missing = sorted({v for v in values if v not in vocab})
+        if missing:
+            raise ValueError(
+                f"TOA -{flagid} flags {missing} not in backend_names"
+            )
+        return _np.asarray([vocab[v] for v in values])
+
+    def toa_backend_index():
+        return flag_indices(psr.toas.get_flag(flagid))
+
+    def per_toa(v):
+        v = row(v)
+        return v if v.ndim == 0 else v[toa_backend_index()]
+
+    efac = per_toa(recipe.efac) if recipe.efac is not None else 1.0
+    equad = (
+        10.0 ** per_toa(recipe.log10_equad)
+        if recipe.log10_equad is not None
+        else 0.0
+    )
+
+    ecorr = epoch_index = None
+    if recipe.log10_ecorr is not None:
+        ec = row(recipe.log10_ecorr)
+        if ec.ndim == 0:
+            epoch_index = quantize(mjds, dt=coarsegrain).epoch_index
+            ecorr = 10.0**ec
+        else:
+            # quantize's ave_flags IS the first-TOA-of-epoch backend rule
+            # the freeze step uses (batch.py; reference quantize_fast
+            # white_noise.py:33-35)
+            flags = [str(v) for v in psr.toas.get_flag(flagid)]
+            bins = quantize(mjds, flags=flags, dt=coarsegrain)
+            epoch_index = bins.epoch_index
+            ecorr = 10.0 ** _np.asarray(ec)[flag_indices(bins.ave_flags)]
+
+    rn_amp = (
+        row(recipe.rn_log10_amplitude)
+        if recipe.rn_log10_amplitude is not None
+        else None
+    )
+    rn_gamma = row(recipe.rn_gamma) if recipe.rn_gamma is not None else None
     return noise_covariance(
-        errors,
+        psr.toas.errors_s,
         efac=efac,
         equad_s=equad,
         ecorr_s=ecorr,
         epoch_index=epoch_index,
-        rn_log10_amplitude=scalarize(recipe.rn_log10_amplitude),
-        rn_gamma=scalarize(recipe.rn_gamma),
-        toas_s=toas_s,
+        rn_log10_amplitude=rn_amp,
+        rn_gamma=rn_gamma,
+        toas_s=mjds * DAY_IN_SEC,
         rn_nmodes=recipe.rn_nmodes,
         xp=xp,
     )

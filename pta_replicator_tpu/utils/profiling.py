@@ -47,3 +47,81 @@ def device_trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def injection_stage_fns(batch, recipe) -> dict:
+    """Jitted per-stage benchmark functions over a (R,) key batch.
+
+    One stage table shared by ``bench.py`` (per-stage evidence in the
+    bench JSON) and ``benchmarks/profile_stages.py`` (standalone
+    profiler), so the two cannot drift. Every fn maps ``keys (R, 2) ->
+    array`` and is safe to time by queueing calls and fencing once with
+    a host readback. ``cgw_catalog_once`` is key-independent; the
+    ``0.0 * ks[0, 0]`` term keeps XLA from constant-folding it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import batched as B
+
+    def vm(f):
+        return jax.jit(lambda ks: jax.vmap(f)(ks))
+
+    stages = {}
+    if recipe.efac is not None or recipe.log10_equad is not None:
+        stages["white_noise"] = vm(
+            lambda k: B.white_noise_delays(
+                k,
+                batch,
+                efac=recipe.efac if recipe.efac is not None else 1.0,
+                log10_equad=recipe.log10_equad,
+                tnequad=recipe.tnequad,
+            )
+        )
+    if recipe.log10_ecorr is not None:
+        stages["jitter"] = vm(
+            lambda k: B.jitter_delays(k, batch, recipe.log10_ecorr)
+        )
+    if recipe.rn_log10_amplitude is not None:
+        stages["red_noise"] = vm(
+            lambda k: B.red_noise_delays(
+                k,
+                batch,
+                recipe.rn_log10_amplitude,
+                recipe.rn_gamma,
+                nmodes=recipe.rn_nmodes,
+            )
+        )
+    if recipe.orf_cholesky is not None and (
+        recipe.gwb_log10_amplitude is not None
+        or recipe.gwb_user_spectrum is not None
+    ):
+        stages["gwb"] = vm(
+            lambda k: B.gwb_delays(
+                k,
+                batch,
+                recipe.gwb_log10_amplitude,
+                recipe.gwb_gamma,
+                recipe.orf_cholesky,
+                npts=recipe.gwb_npts,
+                howml=recipe.gwb_howml,
+                user_spectrum=recipe.gwb_user_spectrum,
+            )
+        )
+    stages["quad_fit"] = vm(
+        lambda k: B.quadratic_fit_subtract(
+            jax.random.normal(k, batch.toas_s.shape, batch.toas_s.dtype),
+            batch,
+        )
+    )
+    if recipe.cgw_params is not None:
+        stages["cgw_catalog_once"] = jax.jit(
+            lambda ks: B.cgw_catalog_delays(
+                batch,
+                *[recipe.cgw_params[i] for i in range(8)],
+                chunk=recipe.cgw_chunk,
+                backend=recipe.cgw_backend,
+            )
+            + 0.0 * ks[0, 0].astype(batch.toas_s.dtype)
+        )
+    return stages

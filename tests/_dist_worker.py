@@ -1,0 +1,88 @@
+"""Worker for the two-process distributed rehearsal test.
+
+Launched (twice) by tests/test_distributed_multiprocess.py:
+
+    python tests/_dist_worker.py <coordinator_port> <process_id> <out.npz>
+
+Each process owns 4 virtual CPU devices; ``distributed.initialize`` joins
+them into one 8-device runtime, ``shardmap_realize`` runs the explicit
+SPMD engine over the joint ('real'=8) mesh, and the process saves its own
+``local_realizations`` block for the parent to check against the
+single-process result. This is the multi-host rehearsal the real Cloud
+TPU deployment uses (parallel/distributed.py module docstring), with DCN
+replaced by localhost GRPC.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main():
+    port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.ops.orf import hellings_downs_matrix
+    from pta_replicator_tpu.parallel import (
+        distributed,
+        make_mesh,
+        shardmap_realize,
+    )
+
+    topo = distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=pid,
+    )
+    assert topo["process_count"] == 2, topo
+    assert topo["local_device_count"] == 4, topo
+    assert topo["global_device_count"] == 8, topo
+
+    # identical workload on every process (the SPMD contract), mirroring
+    # test_sharding.small_setup
+    batch = synthetic_batch(npsr=4, ntoa=64, nbackend=2, seed=1)
+    phat = np.asarray(batch.phat)
+    locs = np.stack(
+        [np.arctan2(phat[:, 1], phat[:, 0]), np.arccos(phat[:, 2])], axis=1
+    )
+    orf = hellings_downs_matrix(locs)
+    recipe = B.Recipe(
+        efac=jnp.ones((4, 2)),
+        log10_equad=jnp.full((4, 2), -6.3),
+        log10_ecorr=jnp.full((4, 2), -6.5),
+        rn_log10_amplitude=jnp.full(4, -14.0),
+        rn_gamma=jnp.full(4, 4.33),
+        gwb_log10_amplitude=jnp.asarray(-14.0),
+        gwb_gamma=jnp.asarray(4.33),
+        orf_cholesky=jnp.asarray(np.linalg.cholesky(np.asarray(orf))),
+        gwb_npts=100,
+        gwb_howml=4.0,
+    )
+
+    mesh = make_mesh(8, 1)
+    out = shardmap_realize(
+        jax.random.PRNGKey(9), batch, recipe, nreal=16, mesh=mesh, fit=True
+    )
+    local = distributed.local_realizations(out)
+    np.savez(
+        out_path,
+        local=local,
+        process_index=topo["process_index"],
+        local_device_count=topo["local_device_count"],
+        global_device_count=topo["global_device_count"],
+    )
+    print(f"worker {pid}: local block {local.shape} saved", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,22 +82,72 @@ def test_nreal_divisibility_error(small_setup):
         sharded_realize(jax.random.PRNGKey(0), batch, recipe, nreal=6, mesh=mesh)
 
 
-def test_shardmap_matches_constraint_path(small_setup):
+@pytest.mark.parametrize("n_real,n_psr", [(8, 1), (4, 2)])
+def test_shardmap_matches_constraint_path(small_setup, n_real, n_psr):
     """The explicit-SPMD shard_map engine produces the same realizations
-    as the sharding-constraint engine on a realization-only mesh."""
+    as the sharding-constraint engine — on a realization-only mesh AND
+    with the pulsar axis sharded (GWB ORF rows + row-windowed draws)."""
     from pta_replicator_tpu.parallel import shardmap_realize
 
     batch, recipe = small_setup
     key = jax.random.PRNGKey(9)
-    mesh = make_mesh(8, 1)
+    mesh = make_mesh(n_real, n_psr)
     a = sharded_realize(key, batch, recipe, nreal=16, mesh=mesh, fit=True)
     b = shardmap_realize(key, batch, recipe, nreal=16, mesh=mesh, fit=True)
     rms = float(np.sqrt(np.mean(np.asarray(a) ** 2)))
     np.testing.assert_allclose(
         np.asarray(b), np.asarray(a), rtol=1e-9, atol=1e-9 * rms
     )
-    with pytest.raises(ValueError, match="n_psr=1"):
-        shardmap_realize(key, batch, recipe, nreal=16, mesh=make_mesh(4, 2))
+
+
+def test_shardmap_psr_sharded_with_cw_catalog(small_setup):
+    """Deterministic CW catalog under a sharded pulsar axis: the scan
+    carry must inherit the input's device-varying type (regression: a
+    fresh jnp.zeros carry fails shard_map's scan vma check)."""
+    import dataclasses
+
+    from pta_replicator_tpu.parallel import shardmap_realize
+
+    batch, recipe = small_setup
+    rng = np.random.default_rng(3)
+    ncw = 6
+    cat = jnp.asarray(np.stack([
+        np.arccos(rng.uniform(-1, 1, ncw)), rng.uniform(0, 2 * np.pi, ncw),
+        10 ** rng.uniform(8, 9.3, ncw), rng.uniform(50, 900, ncw),
+        10 ** rng.uniform(-8.6, -7.8, ncw), rng.uniform(0, 2 * np.pi, ncw),
+        rng.uniform(0, np.pi, ncw), np.arccos(rng.uniform(-1, 1, ncw)),
+    ]))
+    recipe = dataclasses.replace(recipe, cgw_params=cat, cgw_chunk=4)
+    key = jax.random.PRNGKey(21)
+    ref = B.realize(key, batch, recipe, nreal=8, fit=True)
+    out = shardmap_realize(
+        key, batch, recipe, nreal=8, mesh=make_mesh(4, 2), fit=True
+    )
+    rms = float(np.sqrt(np.mean(np.asarray(ref) ** 2)))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-9 * rms
+    )
+
+
+def test_shardmap_psr_sharded_uncorrelated_gwb(small_setup):
+    """With no ORF (uncorrelated common process) the psr-sharded engine
+    materializes the global sqrt(2)*I factor so shards draw distinct
+    rows; result matches the single-device path."""
+    import dataclasses
+
+    from pta_replicator_tpu.parallel import shardmap_realize
+
+    batch, recipe = small_setup
+    recipe = dataclasses.replace(recipe, orf_cholesky=None)
+    key = jax.random.PRNGKey(11)
+    ref = B.realize(key, batch, recipe, nreal=8, fit=True)
+    out = shardmap_realize(
+        key, batch, recipe, nreal=8, mesh=make_mesh(4, 2), fit=True
+    )
+    rms = float(np.sqrt(np.mean(np.asarray(ref) ** 2)))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-9 * rms
+    )
 
 
 def test_distributed_helpers(small_setup):

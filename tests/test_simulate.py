@@ -111,3 +111,35 @@ def test_real_nanograv_pulsar_end_to_end(tmp_path):
     orig = {l.split()[0] for l in open(par) if l.split()}
     new = {l.split()[0] for l in open(tmp_path / "o.par") if l.split()}
     assert orig <= new  # binary/DM/astrometry params ride along unmodified
+
+
+def test_to_enterprise_optional_dependency(partim_small):
+    """C8: to_enterprise converts through a written par/tim pair when
+    `enterprise` is importable; otherwise it raises ImportError naming
+    the manual equivalent (NOT NotImplementedError — the export is
+    implemented, the dependency is optional)."""
+    pardir, timdir = partim_small
+    psr = load_pulsar(
+        pardir + "/JPSR00.par", timdir + "/fake_JPSR00_noiseonly.tim"
+    )
+    make_ideal(psr)
+    try:
+        import enterprise.pulsar  # noqa: F401
+
+        have_enterprise = True
+    except ImportError:
+        have_enterprise = False
+
+    if not have_enterprise:
+        with pytest.raises(ImportError, match="write_partim"):
+            psr.to_enterprise()
+        return
+
+    ent = psr.to_enterprise()
+    assert ent.toas.shape == (psr.toas.ntoas,)
+    np.testing.assert_allclose(
+        np.sort(ent.toas) / 86400.0,
+        np.sort(psr.toas.get_mjds()),
+        rtol=0,
+        atol=1e-7,  # enterprise returns SSB-corrected days*86400
+    )

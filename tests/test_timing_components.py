@@ -251,6 +251,76 @@ def test_gls_vs_wls_differ_on_red_noise():
     assert float(np.max(rel)) > 1e-3
 
 
+def test_covariance_from_recipe_per_backend():
+    """VERDICT r2 item 7: a multi-backend pulsar's GLS covariance must
+    carry each TOA's own backend EFAC/EQUAD/ECORR — not the table mean."""
+    from pta_replicator_tpu.batch import freeze
+    from pta_replicator_tpu.models.batched import Recipe
+    from pta_replicator_tpu.timing.fit import covariance_from_recipe
+
+    psr = load_pulsar(B1855_PAR, B1855_TIM)
+    batch = freeze([psr])
+    nb = len(batch.backend_names)
+    assert nb >= 2, "B1855+09 must have multiple backends"
+
+    efac = np.linspace(0.8, 1.6, nb)
+    log10_eq = np.linspace(-6.8, -6.2, nb)
+    log10_ec = np.linspace(-6.9, -6.4, nb)
+    recipe = Recipe(
+        efac=efac[None, :],
+        log10_equad=log10_eq[None, :],
+        log10_ecorr=log10_ec[None, :],
+    )
+    C = covariance_from_recipe(
+        psr, recipe, psr_index=0, backend_names=batch.backend_names
+    )
+    n = psr.toas.ntoas
+    idx = np.asarray(batch.backend_index[0][:n])
+    sigma = psr.toas.errors_s
+
+    # epoch structure + first-TOA-of-epoch backend (the reference's
+    # quantize_fast labels each epoch by its first member's flag,
+    # white_noise.py:33-35; the freeze step uses the same rule)
+    from pta_replicator_tpu.ops.quantize import quantize
+
+    mjds = psr.toas.get_mjds()
+    bins = quantize(mjds, dt=0.1)
+    ep = bins.epoch_index
+    order = np.argsort(mjds, kind="stable")
+    uniq_e, first_pos = np.unique(ep[order], return_index=True)
+    epoch_backend = np.zeros(bins.nepochs, dtype=np.int64)
+    epoch_backend[uniq_e] = idx[order[first_pos]]
+
+    white = (efac[idx] * sigma) ** 2 + (10.0 ** log10_eq[idx]) ** 2
+    ecorr2 = (10.0 ** log10_ec[epoch_backend[ep]]) ** 2
+    np.testing.assert_allclose(np.diag(C), white + ecorr2, rtol=1e-10)
+
+    # the scalarized (mean) weighting must NOT reproduce this diagonal
+    mean_white = (efac.mean() * sigma) ** 2 + (
+        10.0 ** np.mean(log10_eq)
+    ) ** 2 + (10.0 ** np.mean(log10_ec)) ** 2
+    assert not np.allclose(np.diag(C), mean_white, rtol=1e-3, atol=0.0)
+
+    # same-epoch cross terms carry that epoch's backend ECORR^2
+    pair = None
+    for e in range(bins.nepochs):
+        where = np.nonzero(ep == e)[0]
+        if len(where) >= 2:
+            pair = (where[0], where[1])
+            break
+    assert pair is not None
+    i, j = pair
+    np.testing.assert_allclose(
+        C[i, j], (10.0 ** log10_ec[epoch_backend[ep[i]]]) ** 2, rtol=1e-10
+    )
+
+    # per-pulsar arrays without context must fail loudly, not average
+    with pytest.raises(ValueError, match="psr_index"):
+        covariance_from_recipe(psr, recipe)
+    with pytest.raises(ValueError, match="backend_names"):
+        covariance_from_recipe(psr, recipe, psr_index=0)
+
+
 def test_covariance_from_recipe():
     from pta_replicator_tpu.models.batched import Recipe
     from pta_replicator_tpu.timing.fit import covariance_from_recipe
