@@ -19,16 +19,19 @@ def main():
     ap.add_argument("--nreal", type=int, default=20)
     ap.add_argument("--small", action="store_true",
                     help="3x122 toy shapes instead of NG15 scale")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. 'cpu'); default: "
+                         "whatever backend the session resolves")
     args = ap.parse_args()
-
-    import os
 
     import jax
 
-    # honor JAX_PLATFORMS even when a pre-registered remote-TPU plugin
-    # overrode it at interpreter start (same treatment as bench.py)
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # opt-in platform override (e.g. --platform cpu for a local run).
+    # Deliberately NOT read from JAX_PLATFORMS: hosted environments
+    # preset that to a remote plugin, and forwarding it can hang on an
+    # unreachable device (.claude/skills/verify gotchas).
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     import jax.numpy as jnp
 

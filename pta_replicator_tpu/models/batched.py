@@ -56,8 +56,11 @@ def _rows_draw(draw, key, rows, local_shape, *args):
     (npsr_global, ...) stream from the replicated key and slices its own
     rows — bitwise equal to the unsharded computation, with zero
     collectives (same device-replicated-RNG idea as the GWB mix in
-    :func:`gwb_delays`). The redundant generation is cheap next to the
-    ops that consume it.
+    :func:`gwb_delays`). Deliberate tradeoff: the RNG-bit generation is
+    replicated per shard, so 'psr' sharding only reduces the non-RNG
+    portion of per-device work (basis contractions, epoch gathers, the
+    ORF mix rows) — it is a memory/model-parallel axis, not a way to
+    speed up draw-bound stages. Scale those with the 'real' axis.
     """
     if rows is None:
         return draw(key, local_shape, *args)

@@ -98,8 +98,17 @@ class SimulatedPulsar:
         nspin: int = 2,
         cov: np.ndarray = None,
         params="full",
+        recipe=None,
+        psr_index: int = None,
+        backend_names=None,
     ) -> None:
         """Refit the timing model post-injection (WLS or GLS).
+
+        For GLS, either pass ``cov`` directly or pass the ``recipe`` the
+        dataset was synthesized with (plus ``psr_index``/``backend_names``
+        when its tables are per-pulsar/per-backend) and the exact noise
+        covariance is assembled via
+        :func:`~pta_replicator_tpu.timing.fit.covariance_from_recipe`.
 
         Reference analog: simulate.py:44-69, where PINT's fitters solve
         over the *full* model design matrix. Here ``params`` selects the
@@ -137,8 +146,20 @@ class SimulatedPulsar:
                 f0=self.model.f0, nspin=nspin, include=include,
             )
         if fitter in ("wls", "auto"):
+            if recipe is not None or cov is not None:
+                raise ValueError(
+                    "recipe/cov describe a GLS noise covariance; pass "
+                    "fitter='gls' (a WLS fit would silently ignore them)"
+                )
             p, post = wls_fit(res, self.toas.errors_s, M)
         else:
+            if cov is None and recipe is not None:
+                from .timing.fit import covariance_from_recipe
+
+                cov = covariance_from_recipe(
+                    self, recipe, psr_index=psr_index,
+                    backend_names=backend_names,
+                )
             C = cov if cov is not None else np.diag(self.toas.errors_s**2)
             p, post = gls_fit(res, C, M)
         p = np.asarray(p, dtype=np.float64)

@@ -336,3 +336,28 @@ def test_covariance_from_recipe():
     assert C.shape == (psr.toas.ntoas,) * 2
     assert np.all(np.linalg.eigvalsh(C) > 0)
     psr.fit(fitter="gls", cov=C)  # end-to-end GLS refit runs
+
+
+def test_fit_gls_builds_covariance_from_recipe():
+    """fit(fitter='gls', recipe=...) assembles the exact noise covariance
+    internally (same result as passing covariance_from_recipe output)."""
+    import copy
+
+    from pta_replicator_tpu import add_red_noise
+    from pta_replicator_tpu.models.batched import Recipe
+    from pta_replicator_tpu.timing.fit import covariance_from_recipe
+
+    psr = load_pulsar(JPSR_PAR, JPSR_TIM)
+    make_ideal(psr)
+    add_red_noise(psr, -13.0, 4.0, seed=7)
+    recipe = Recipe(
+        efac=np.asarray(1.1),
+        rn_log10_amplitude=np.asarray(-13.0),
+        rn_gamma=np.asarray(4.0),
+    )
+    a, b = copy.deepcopy(psr), copy.deepcopy(psr)
+    a.fit(fitter="gls", recipe=recipe)
+    b.fit(fitter="gls", cov=covariance_from_recipe(b, recipe))
+    np.testing.assert_allclose(
+        a.residuals.resids_value, b.residuals.resids_value, rtol=0, atol=1e-15
+    )
