@@ -264,6 +264,7 @@ def gwb_delays(
     power: float = 1.0,
     user_spectrum=None,
     synthesis: str = "auto",
+    synthesis_precision=None,
 ):
     """Correlated GWB across the array: the one cross-pulsar op.
 
@@ -319,9 +320,19 @@ def gwb_delays(
     if synthesis == "matmul":
         cos_m, sin_m = dft_synthesis_matrices(nf, npts)
         scale = 2.0 / ((2 * nf - 2) * dt_grid)
+        # synthesis_precision tunes the MXU pass count of the DFT
+        # contraction (None = backend default; 'highest' = full f32;
+        # lower settings trade GWB waveform accuracy for speed -- the
+        # knob exists so the tradeoff is measurable, DESIGN.md section 7)
         grid_series = (
-            jnp.real(res_f) @ jnp.asarray(cos_m, dtype)
-            - jnp.imag(res_f) @ jnp.asarray(sin_m, dtype)
+            jnp.matmul(
+                jnp.real(res_f), jnp.asarray(cos_m, dtype),
+                precision=synthesis_precision,
+            )
+            - jnp.matmul(
+                jnp.imag(res_f), jnp.asarray(sin_m, dtype),
+                precision=synthesis_precision,
+            )
         ) * jnp.asarray(scale, dtype)
     else:
         res_t = jnp.fft.irfft(res_f, n=2 * nf - 2, axis=-1) / dt_grid
@@ -711,6 +722,11 @@ class Recipe:
     cgw_psr_term: bool = field(metadata=dict(static=True), default=True)
     cgw_evolve: bool = field(metadata=dict(static=True), default=True)
     cgw_phase_approx: bool = field(metadata=dict(static=True), default=False)
+    #: GWB DFT-synthesis matmul precision (None = backend default;
+    #: 'highest' forces full-f32 MXU passes; see gwb_delays)
+    gwb_synthesis_precision: object = field(
+        metadata=dict(static=True), default=None
+    )
     #: CW-catalog backend: "auto" (resolves to "scan" everywhere — the
     #: Pallas kernel measures tied on a real v5e and has more failure
     #: modes, docs/DESIGN.md section 4), "pallas", "pallas_interpret",
@@ -775,6 +791,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
             f0=recipe.gwb_f0,
             beta=recipe.gwb_beta,
             power=recipe.gwb_power,
+            synthesis_precision=recipe.gwb_synthesis_precision,
         )
     return total
 

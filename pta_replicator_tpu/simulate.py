@@ -353,12 +353,22 @@ def load_pulsar(parfile: str, timfile: str, ephem: str = "DE440") -> SimulatedPu
 
 
 def load_from_directories(
-    pardir: str, timdir: str, ephem: str = "DE440", num_psrs: int = None, debug: bool = False
+    pardir: str,
+    timdir: str,
+    ephem: str = "DE440",
+    num_psrs: int = None,
+    debug: bool = False,
+    workers: int = None,
 ) -> list:
     """Load a pulsar array from directories of par and tim files.
 
-    Reference analog: simulate.py:170-190 (".t2" par variants filtered out,
-    sorted par/tim lists zipped pairwise).
+    Reference analog: simulate.py:170-190 (".t2" par variants filtered
+    out, sorted par/tim lists zipped pairwise) — but where the
+    reference's 68-pulsar cold start is a serial PINT loop (its ingest
+    hot path, SURVEY.md section 3.1), this loads pulsars concurrently:
+    the native tim tokenizer releases the GIL during the C call, so a
+    thread pool overlaps file scans. ``workers``: thread count (default
+    min(8, n_pulsars); 1 = serial). Order is deterministic either way.
     """
     if not os.path.isdir(pardir):
         raise FileNotFoundError("par directory does not exist.")
@@ -366,14 +376,26 @@ def load_from_directories(
         raise FileNotFoundError("tim directory does not exist.")
     pars = [p for p in sorted(glob.glob(os.path.join(pardir, "*.par"))) if ".t2" not in p]
     tims = sorted(glob.glob(os.path.join(timdir, "*.tim")))
-    psrs = []
-    for parf, timf in zip(pars, tims):
-        if num_psrs and len(psrs) >= num_psrs:
-            break
+    pairs = list(zip(pars, tims))
+    if num_psrs:
+        pairs = pairs[:num_psrs]
+
+    def load_one(pt):
+        # per-pair announcement so a load failure is attributable to the
+        # file it came from (the point of the debug flag)
         if debug:
-            print(f"loading par={parf}, tim={timf}")
-        psrs.append(load_pulsar(parf, timf, ephem=ephem))
-    return psrs
+            print(f"loading par={pt[0]}, tim={pt[1]}", flush=True)
+        return load_pulsar(pt[0], pt[1], ephem=ephem)
+
+    if workers is None:
+        workers = min(8, len(pairs)) or 1
+    if workers <= 1 or len(pairs) <= 1:
+        return [load_one(pt) for pt in pairs]
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(load_one, pairs))
 
 
 def make_ideal(psr: SimulatedPulsar, iterations: int = 2) -> None:

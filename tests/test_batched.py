@@ -611,3 +611,23 @@ def test_fit_subtract_removes_quadratic(batch):
     fake = 1e-6 + 3e-14 * t + 5e-22 * t**2
     out = np.asarray(B.quadratic_fit_subtract(jnp.asarray(fake), b))
     assert np.abs(out).max() < 1e-12
+
+
+def test_gwb_synthesis_precision_knob(batch):
+    """The synthesis_precision knob plumbs through gwb_delays and Recipe;
+    'highest' must agree with the default on CPU (same arithmetic)."""
+    from pta_replicator_tpu.ops.orf import hellings_downs_matrix
+
+    batch, _ = batch
+    phat = np.asarray(batch.phat)
+    locs = np.stack(
+        [np.arctan2(phat[:, 1], phat[:, 0]), np.arccos(phat[:, 2])], axis=1
+    )
+    M = jnp.asarray(np.linalg.cholesky(hellings_downs_matrix(locs)))
+    key = jax.random.PRNGKey(5)
+    kw = dict(npts=100, howml=4.0)
+    d_def = B.gwb_delays(key, batch, -14.0, 4.33, M, **kw)
+    d_hi = B.gwb_delays(
+        key, batch, -14.0, 4.33, M, synthesis_precision="highest", **kw
+    )
+    np.testing.assert_allclose(np.asarray(d_def), np.asarray(d_hi), rtol=1e-12)

@@ -143,3 +143,18 @@ def test_to_enterprise_optional_dependency(partim_small):
         rtol=0,
         atol=1e-7,  # enterprise returns SSB-corrected days*86400
     )
+
+
+def test_load_from_directories_parallel_matches_serial(partim_small):
+    """Threaded ingest returns the same pulsars in the same order as the
+    serial loop (the C tim tokenizer releases the GIL, so workers>1
+    overlaps file scans)."""
+    pardir, timdir = partim_small
+    serial = load_from_directories(pardir, timdir, workers=1)
+    threaded = load_from_directories(pardir, timdir, workers=3)
+    assert [p.name for p in threaded] == [p.name for p in serial]
+    for a, b in zip(serial, threaded):
+        np.testing.assert_array_equal(
+            np.asarray(a.toas.mjd, float), np.asarray(b.toas.mjd, float)
+        )
+        np.testing.assert_array_equal(a.toas.errors_s, b.toas.errors_s)
