@@ -117,7 +117,16 @@ def part_b_device(psrs):
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--plot", action="store_true")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform for part B (e.g. 'cpu'); "
+                         "default: the session's backend. Deliberately "
+                         "not read from JAX_PLATFORMS (hosted "
+                         "environments preset it to a remote plugin)")
     args = ap.parse_args()
     psrs = part_a_oracle(plot=args.plot)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     part_b_device(psrs)
     print("done.")

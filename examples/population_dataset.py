@@ -103,4 +103,15 @@ def main():
 
 
 if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform for part B (e.g. 'cpu'); "
+                         "default: the session's backend")
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
     main()

@@ -205,3 +205,42 @@ def test_anisotropic_gwb_device_correlations(small_setup):
     corr = cov / np.sqrt(np.outer(np.diag(cov), np.diag(cov)))
     expect = orf / np.sqrt(np.outer(np.diag(orf), np.diag(orf)))
     np.testing.assert_allclose(corr, expect, atol=0.1)
+
+
+def test_shardmap_psr_sharded_guards(small_setup):
+    """Loud failures for the psr-sharded engine's unsupported inputs:
+    a global-pulsar-index transient, npsr not divisible by the axis, and
+    a per-pulsar recipe leaf with the wrong leading dim."""
+    import dataclasses
+
+    from pta_replicator_tpu.parallel import shardmap_realize
+
+    batch, recipe = small_setup
+    key = jax.random.PRNGKey(0)
+    mesh = make_mesh(2, 2)
+
+    r_tr = dataclasses.replace(
+        recipe,
+        transient_waveform=jnp.zeros(16),
+        transient_grid=jnp.asarray([0.0, 1.0e7]),
+        transient_psr=2,
+    )
+    with pytest.raises(ValueError, match="transient"):
+        shardmap_realize(key, batch, r_tr, nreal=8, mesh=mesh)
+
+    b3 = synthetic_batch(npsr=3, ntoa=32, nbackend=2, seed=2)
+    r3 = dataclasses.replace(
+        recipe,
+        efac=jnp.ones(3),
+        log10_equad=jnp.full(3, -6.3),
+        log10_ecorr=jnp.full(3, -6.5),
+        rn_log10_amplitude=jnp.full(3, -14.0),
+        rn_gamma=jnp.full(3, 4.33),
+        orf_cholesky=jnp.eye(3),
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        shardmap_realize(key, b3, r3, nreal=8, mesh=mesh)
+
+    r_bad = dataclasses.replace(recipe, efac=jnp.ones(6))
+    with pytest.raises(ValueError, match="leading dim"):
+        shardmap_realize(key, batch, r_bad, nreal=8, mesh=mesh)
