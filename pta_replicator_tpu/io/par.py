@@ -105,6 +105,53 @@ class ParModel:
             self.lines.append(f"{key}\t\t{text}")
         self.params[key] = [text] + self.params.get(key, [None, None])[1:]
 
+    def _jump_lines(self):
+        """(line_index, tokens) of every flag-matched JUMP declaration —
+        the single filter behind :attr:`jumps` and :meth:`set_jump`, so
+        their index mappings can never drift apart."""
+        for i, line in enumerate(self.lines):
+            tokens = line.split()
+            if (
+                len(tokens) >= 4
+                and tokens[0].upper() == "JUMP"
+                and tokens[1].startswith("-")
+            ):
+                try:
+                    float(tokens[3].replace("D", "E").replace("d", "e"))
+                except ValueError:
+                    continue
+                yield i, tokens
+
+    @property
+    def jumps(self):
+        """Flag-matched JUMP declarations, in par-file order.
+
+        Each entry is ``(flag_name, flag_value, offset_s)`` parsed from
+        ``JUMP -<flag> <value> <offset> [fit] [err]`` lines — the NANOGrav
+        convention all three reference fixtures use (e.g.
+        /root/reference/test_partim/par/B1855+09.par "JUMP -fe L-wide ...").
+        ``params`` cannot hold these (multiple JUMP lines would collide on
+        one key), so they parse from the verbatim line store. MJD-range /
+        frequency-range JUMP forms are skipped.
+        """
+        return [
+            (
+                tokens[1].lstrip("-"),
+                tokens[2],
+                float(tokens[3].replace("D", "E").replace("d", "e")),
+            )
+            for _, tokens in self._jump_lines()
+        ]
+
+    def set_jump(self, index: int, offset_s: float) -> None:
+        """Update the ``index``-th flag-matched JUMP line's offset value."""
+        for seen, (i, tokens) in enumerate(self._jump_lines()):
+            if seen == index:
+                tokens[3] = format(offset_s, ".20g")
+                self.lines[i] = "\t".join(tokens)
+                return
+        raise IndexError(f"par file has no flag-matched JUMP #{index}")
+
     def write(self, path: str) -> None:
         """Write the par file back out, preserving original content."""
         with open(path, "w") as fh:

@@ -36,7 +36,8 @@ class Residuals:
 
     def __init__(self, toas: TOAData, model):
         self.time_resids = phase_residuals(
-            model, toas.mjd, toas.errors_s, freqs_mhz=toas.freqs_mhz
+            model, toas.mjd, toas.errors_s, freqs_mhz=toas.freqs_mhz,
+            flags=toas.flags,
         )
 
     @property
@@ -144,6 +145,7 @@ class SimulatedPulsar:
             M, names = full_design_matrix(
                 self.par, mjds, freqs_mhz=self.toas.freqs_mhz,
                 f0=self.model.f0, nspin=nspin, include=include,
+                flags=self.toas.flags,
             )
         if fitter in ("wls", "auto"):
             if recipe is not None or cov is not None:
@@ -225,6 +227,12 @@ class SimulatedPulsar:
                 from .timing.components import _parf
 
                 par.set_param("DM1", (_parf(par, "DM1", 0.0) or 0.0) + updates["DM1"])
+            # flag-matched JUMP columns (indicator derivative, += like
+            # every delay parameter); multi-line JUMPs edit by position
+            for k, (_name, _val, offset) in enumerate(par.jumps):
+                nm = f"JUMP{k + 1}"
+                if nm in updates:
+                    par.set_jump(k, offset + updates[nm])
             # binary parameters: numerical-derivative columns, += convention
             from .timing.components import BinaryModel
 
@@ -404,7 +412,7 @@ def make_ideal(psr: SimulatedPulsar, iterations: int = 2) -> None:
     for _ in range(iterations):
         res = phase_residuals(
             psr.model, psr.toas.mjd, psr.toas.errors_s,
-            freqs_mhz=psr.toas.freqs_mhz,
+            freqs_mhz=psr.toas.freqs_mhz, flags=psr.toas.flags,
         )
         psr.toas.adjust_seconds(-res)
     psr.added_signals = {}

@@ -278,6 +278,17 @@ _BINARY_STEPS = {
 }
 
 
+def jump_mask(flags, flag_name: str, flag_value: str) -> np.ndarray:
+    """0/1 indicator of the TOAs a flag-matched JUMP applies to — the ONE
+    matching rule shared by the delay model (TimingModel.delays_s) and
+    the design matrix, so the fitted column always corrects exactly the
+    delay it models."""
+    return np.asarray(
+        [str(f.get(flag_name)) == flag_value for f in flags],
+        dtype=np.float64,
+    )
+
+
 def binary_columns(binary: BinaryModel, t_mjd, xp=np) -> Tuple[list, list]:
     """Central-difference derivative columns d(delay)/d(param) for every
     fitted binary parameter (the reference gets these from PINT's
@@ -303,10 +314,13 @@ def full_design_matrix(
     nspin: int = 2,
     include: str = "auto",
     xp=np,
+    flags=None,
 ) -> Tuple[np.ndarray, List[str]]:
     """Timing design matrix over the full model the par file declares:
     spin (offset + F0..Fn), astrometry (RAJ/DECJ/PM/PX when present),
-    DM (+DM1), and binary parameters (numerical derivatives).
+    DM (+DM1), binary parameters (numerical derivatives), and
+    flag-matched JUMP indicator columns (named JUMP1..JUMPn in par-file
+    order; require ``flags`` = per-TOA flag dicts).
 
     ``include``: 'auto' (everything the par file has), 'spin' (reference
     of the round-1 fit), or a list of column names to keep. Returns
@@ -371,6 +385,23 @@ def full_design_matrix(
         bcols, bnames = binary_columns(binary, t, xp=xp)
         cols += bcols
         names += bnames
+
+    # flag-matched JUMPs: the reference's PINT refit fits these on every
+    # real NANOGrav fixture (JUMP -fe <receiver> lines); the column is
+    # the indicator of the matching TOAs (d(delay)/d(JUMP) = 1 there)
+    jumps = getattr(par, "jumps", ())
+    if jumps and flags is not None:
+        for k, (name, value, _offset) in enumerate(jumps):
+            match = jump_mask(flags, name, value)
+            # a jump covering none or ALL of the loaded TOAs is
+            # degenerate (the all-ones case duplicates OFFSET — the fit
+            # would split the mean arbitrarily and then persist that
+            # arbitrary value to the par); skip it like PINT rejects
+            # all-covering jumps. Names stay positional (JUMPk = k-th
+            # par-file declaration) so write-back indexing is unaffected.
+            if 0.0 < match.sum() < len(match):
+                cols.append(xp.asarray(match))
+                names.append(f"JUMP{k + 1}")
 
     if isinstance(include, (list, tuple, set)):
         keep = [i for i, nm in enumerate(names) if nm in include or nm == "OFFSET"]

@@ -69,6 +69,7 @@ def phase_residuals(
     errors_s: np.ndarray,
     subtract_mean: bool = True,
     freqs_mhz: np.ndarray = None,
+    flags=None,
 ) -> np.ndarray:
     """Phase-wrapped time residuals [s] of TOAs against a timing model.
 
@@ -85,7 +86,7 @@ def phase_residuals(
     mjd = np.asarray(mjd_ld, dtype=np.longdouble)
     if hasattr(model, "delays_s"):
         d = model.delays_s(np.asarray(mjd_ld, dtype=np.float64),
-                           freqs_mhz=freqs_mhz)
+                           freqs_mhz=freqs_mhz, flags=flags)
         if d is not None:
             mjd = mjd - np.asarray(d, dtype=np.float64) / DAY_IN_SEC
     phase = model.phase(mjd)
@@ -119,6 +120,10 @@ class TimingModel:
     ra_rad: float = None
     dec_rad: float = None
     include_roemer: bool = True
+    #: flag-matched JUMP offsets: ((flag_name, flag_value, offset_s), ...)
+    #: — the reference's PINT model fits these on every real NANOGrav
+    #: fixture (e.g. test_partim/par/B1855+09.par "JUMP -fe L-wide")
+    jumps: tuple = ()
 
     # -- SpindownTiming-compatible surface (existing call sites)
     @property
@@ -161,14 +166,26 @@ class TimingModel:
             dmepoch_mjd=_parf(par, "DMEPOCH", par.pepoch_mjd) or par.pepoch_mjd,
             ra_rad=ra,
             dec_rad=dec,
+            jumps=tuple(tuple(j) for j in getattr(par, "jumps", ())),
         )
 
-    def delays_s(self, t_mjd: np.ndarray, freqs_mhz=None):
-        """Total model delay [s] at the given (topocentric) MJD epochs."""
+    def delays_s(self, t_mjd: np.ndarray, freqs_mhz=None, flags=None):
+        """Total model delay [s] at the given (topocentric) MJD epochs.
+
+        ``flags``: per-TOA flag dicts (TOAData.flags) — required for the
+        JUMP component to land on its flag-matched TOAs; without them
+        jumps contribute nothing (they then cancel in make_ideal like
+        every other absolute term).
+        """
         from .components import AU_S, dispersion_delay, earth_position_au
 
         t = np.asarray(t_mjd, dtype=np.float64)
         total = np.zeros_like(t)
+        if self.jumps and flags is not None:
+            from .components import jump_mask
+
+            for name, value, offset in self.jumps:
+                total = total + offset * jump_mask(flags, name, value)
         if self.binary is not None and self.binary.pb_days:
             total = total + self.binary.delay_s(t)
         if self.dm and freqs_mhz is not None:

@@ -93,6 +93,10 @@ def _rebuild_model(meta_model: dict):
     kwargs["spin"] = SpindownTiming(**kwargs["spin"])
     if kwargs.get("binary") is not None:
         kwargs["binary"] = BinaryModel(**kwargs["binary"])
+    if kwargs.get("jumps"):  # JSON round-trips tuples as lists
+        kwargs["jumps"] = tuple(
+            (str(n), str(v), float(o)) for n, v, o in kwargs["jumps"]
+        )
     return TimingModel(**kwargs)
 
 

@@ -361,3 +361,56 @@ def test_fit_gls_builds_covariance_from_recipe():
     np.testing.assert_allclose(
         a.residuals.resids_value, b.residuals.resids_value, rtol=0, atol=1e-15
     )
+
+
+def test_b1855_jump_refit(b1855):
+    """A receiver-jump perturbation (offset on the -fe L-wide TOAs, the
+    JUMP the real par declares) is absorbed by the full fit and
+    recovered; the spin-only fit cannot absorb a backend step."""
+    import copy
+
+    psr = copy.deepcopy(b1855)
+    assert psr.par.jumps == [("fe", "L-wide", pytest.approx(-1.717050495e-05))]
+    match = np.asarray(
+        [f.get("fe") == "L-wide" for f in psr.toas.flags], dtype=float
+    )
+    assert 0 < match.sum() < len(match)  # genuinely multi-receiver data
+    dJ = 5e-7
+    psr.inject("jump_error", {}, dJ * match)
+    pre = _rms(psr.residuals.resids_value)
+
+    spin_only = copy.deepcopy(psr)
+    spin_only.fit(fitter="wls", params="spin")
+    post_spin = _rms(spin_only.residuals.resids_value)
+
+    psr.fit(fitter="wls", params="full")
+    post_full = _rms(psr.residuals.resids_value)
+
+    assert "JUMP1" in psr.fit_results
+    assert post_full < pre / 50.0
+    assert post_full < post_spin / 5.0
+    assert psr.fit_results["JUMP1"] == pytest.approx(dJ, rel=5e-2)
+    # the fitted jump persisted to the par line (write_partim fidelity):
+    # new value = declared value + exactly the fitted update
+    assert psr.par.jumps[0][2] == pytest.approx(
+        -1.7170504954499434e-05 + psr.fit_results["JUMP1"], abs=1e-18
+    )
+
+
+def test_degenerate_jump_column_skipped():
+    """A JUMP covering ALL loaded TOAs would duplicate OFFSET (rank
+    deficiency -> arbitrary persisted value); the design matrix must
+    skip it while keeping positional names for the remaining jumps."""
+    par = read_par(B1855_PAR)
+    t = np.linspace(53400, 57500, 50)
+    f = np.full(50, 1400.0)
+    # every TOA matches JUMP1's flag -> degenerate
+    flags_all = [{"fe": "L-wide"} for _ in range(50)]
+    _, names = full_design_matrix(par, t, freqs_mhz=f, flags=flags_all)
+    assert "JUMP1" not in names
+    # half the TOAs match -> the column exists
+    flags_half = [
+        {"fe": "L-wide" if i % 2 else "430"} for i in range(50)
+    ]
+    _, names = full_design_matrix(par, t, freqs_mhz=f, flags=flags_half)
+    assert "JUMP1" in names
