@@ -38,6 +38,13 @@ def _parse_dms(text: str) -> float:
     return _parse_hms(text)  # same sexagesimal structure
 
 
+def _parse_float(token) -> float:
+    """Float with tempo's legacy D/d exponent style normalized — the ONE
+    numeric-token parser for par values (JUMP offsets, FD terms, DMX
+    values and ranges), so no site can forget the normalization."""
+    return float(str(token).replace("D", "E").replace("d", "e"))
+
+
 @dataclass
 class ParModel:
     """A parsed pulsar timing model.
@@ -117,7 +124,7 @@ class ParModel:
                 and tokens[1].startswith("-")
             ):
                 try:
-                    float(tokens[3].replace("D", "E").replace("d", "e"))
+                    _parse_float(tokens[3])
                 except ValueError:
                     continue
                 yield i, tokens
@@ -135,11 +142,7 @@ class ParModel:
         frequency-range JUMP forms are skipped.
         """
         return [
-            (
-                tokens[1].lstrip("-"),
-                tokens[2],
-                float(tokens[3].replace("D", "E").replace("d", "e")),
-            )
+            (tokens[1].lstrip("-"), tokens[2], _parse_float(tokens[3]))
             for _, tokens in self._jump_lines()
         ]
 
@@ -151,6 +154,45 @@ class ParModel:
                 self.lines[i] = "\t".join(tokens)
                 return
         raise IndexError(f"par file has no flag-matched JUMP #{index}")
+
+    @property
+    def fd_terms(self):
+        """[FD1, FD2, ...] profile-evolution coefficients [s], in order.
+        PINT/tempo2 convention: delay = sum_k FDk * ln(f_GHz)^k."""
+        out = []
+        k = 1
+        while f"FD{k}" in self.params:
+            try:
+                out.append(_parse_float(self.params[f"FD{k}"][0]))
+            except ValueError:
+                break
+            k += 1
+        return out
+
+    @property
+    def dmx_windows(self):
+        """NANOGrav DMX dispersion windows: [(label, dmx, r1_mjd, r2_mjd)]
+        sorted by label, parsed from DMX_xxxx / DMXR1_xxxx / DMXR2_xxxx
+        parameter triples."""
+        out = []
+        for key, tokens in self.params.items():
+            if not key.startswith("DMX_"):
+                continue
+            idx = key[4:]
+            r1 = self.params.get(f"DMXR1_{idx}")
+            r2 = self.params.get(f"DMXR2_{idx}")
+            if not (r1 and r2):
+                continue
+            try:
+                out.append((
+                    idx,
+                    _parse_float(tokens[0]),
+                    _parse_float(r1[0]),
+                    _parse_float(r2[0]),
+                ))
+            except ValueError:
+                continue
+        return sorted(out)
 
     def write(self, path: str) -> None:
         """Write the par file back out, preserving original content."""

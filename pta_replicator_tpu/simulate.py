@@ -227,6 +227,14 @@ class SimulatedPulsar:
                 from .timing.components import _parf
 
                 par.set_param("DM1", (_parf(par, "DM1", 0.0) or 0.0) + updates["DM1"])
+            # FD and DMX columns: plain single-key params, += convention
+            for k, value in enumerate(par.fd_terms, start=1):
+                if f"FD{k}" in updates:
+                    par.set_param(f"FD{k}", value + updates[f"FD{k}"])
+            for label, value, _r1, _r2 in par.dmx_windows:
+                nm = f"DMX_{label}"
+                if nm in updates:
+                    par.set_param(nm, value + updates[nm])
             # flag-matched JUMP columns (indicator derivative, += like
             # every delay parameter); multi-line JUMPs edit by position
             for k, (_name, _val, offset) in enumerate(par.jumps):

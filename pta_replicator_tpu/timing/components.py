@@ -278,6 +278,22 @@ _BINARY_STEPS = {
 }
 
 
+def fd_column(freqs_mhz, k: int, xp=np):
+    """d(delay)/d(FDk) = ln(f_GHz)^k (PINT/tempo2 FD convention)."""
+    return xp.log(xp.asarray(freqs_mhz) / 1000.0) ** k
+
+
+def dmx_column(t_mjd, freqs_mhz, r1_mjd: float, r2_mjd: float, xp=np):
+    """d(delay)/d(DMX) = 1/(K_DM f^2) inside the [r1, r2) window, 0
+    outside — the per-window dispersion offsets of the NANOGrav DMX
+    model (147-325 windows on the real fixtures)."""
+    t = xp.asarray(t_mjd)
+    f = xp.asarray(freqs_mhz)
+    # inclusive on both ends, matching PINT's DMX range semantics
+    inside = (t >= r1_mjd) & (t <= r2_mjd)
+    return xp.where(inside, 1.0 / (K_DM * f**2), 0.0)
+
+
 def jump_mask(flags, flag_name: str, flag_value: str) -> np.ndarray:
     """0/1 indicator of the TOAs a flag-matched JUMP applies to — the ONE
     matching rule shared by the delay model (TimingModel.delays_s) and
@@ -318,9 +334,11 @@ def full_design_matrix(
 ) -> Tuple[np.ndarray, List[str]]:
     """Timing design matrix over the full model the par file declares:
     spin (offset + F0..Fn), astrometry (RAJ/DECJ/PM/PX when present),
-    DM (+DM1), binary parameters (numerical derivatives), and
-    flag-matched JUMP indicator columns (named JUMP1..JUMPn in par-file
-    order; require ``flags`` = per-TOA flag dicts).
+    dispersion (per-window DMX columns when the par declares DMX, else
+    global DM(+DM1) — fitting both would be rank-deficient), FD
+    profile-evolution terms, binary parameters (numerical derivatives),
+    and flag-matched JUMP indicator columns (named JUMP1..JUMPn in
+    par-file order; require ``flags`` = per-TOA flag dicts).
 
     ``include``: 'auto' (everything the par file has), 'spin' (reference
     of the round-1 fit), or a list of column names to keep. Returns
@@ -369,7 +387,23 @@ def full_design_matrix(
         cols += [acols[i] for i in keep]
         names += [anames[i] for i in keep]
 
-    if freqs_mhz is not None and "DM" in par.params:
+    dmx = getattr(par, "dmx_windows", ()) if freqs_mhz is not None else ()
+    dmx_active = []
+    if dmx:
+        for label, _value, r1, r2 in dmx:
+            col = dmx_column(t, freqs_mhz, r1, r2, xp=xp)
+            # windows with no loaded TOAs contribute an all-zero column:
+            # skip them (their values are unconstrained by this data)
+            if float(np.sum(np.asarray(col) != 0.0)):
+                cols.append(col)
+                names.append(f"DMX_{label}")
+                dmx_active.append(label)
+    if freqs_mhz is not None and "DM" in par.params and not dmx_active:
+        # the global DM column is exactly the sum of all-covering DMX
+        # columns — fitting both is rank-deficient, and the reference's
+        # pars hold DM fixed when DMX is declared (no fit flag on DM,
+        # fit flags on every DMX_xxxx) — so DM/DM1 columns only appear
+        # on DMX-less models
         f = xp.asarray(freqs_mhz)
         cols.append(1.0 / (K_DM * f**2))
         names.append("DM")
@@ -379,6 +413,15 @@ def full_design_matrix(
                 ((t - dmepoch) / YEAR_DAYS) / (K_DM * f**2)
             )
             names.append("DM1")
+
+    if freqs_mhz is not None and np.unique(np.asarray(freqs_mhz)).size > 1:
+        # single-frequency data makes every FD column a constant —
+        # collinear with OFFSET (same degeneracy class as an
+        # all-covering JUMP); skip them all rather than persist an
+        # arbitrary split
+        for k in range(1, len(getattr(par, "fd_terms", ())) + 1):
+            cols.append(fd_column(freqs_mhz, k, xp=xp))
+            names.append(f"FD{k}")
 
     binary = BinaryModel.from_par(par)
     if binary is not None and binary.pb_days:

@@ -124,6 +124,11 @@ class TimingModel:
     #: — the reference's PINT model fits these on every real NANOGrav
     #: fixture (e.g. test_partim/par/B1855+09.par "JUMP -fe L-wide")
     jumps: tuple = ()
+    #: FD profile-evolution coefficients (FD1.. [s]): delay =
+    #: sum_k FDk * ln(f_GHz)^k
+    fd: tuple = ()
+    #: NANOGrav DMX dispersion windows: ((label, dmx, r1_mjd, r2_mjd), ...)
+    dmx: tuple = ()
 
     # -- SpindownTiming-compatible surface (existing call sites)
     @property
@@ -167,6 +172,8 @@ class TimingModel:
             ra_rad=ra,
             dec_rad=dec,
             jumps=tuple(tuple(j) for j in getattr(par, "jumps", ())),
+            fd=tuple(getattr(par, "fd_terms", ())),
+            dmx=tuple(tuple(w) for w in getattr(par, "dmx_windows", ())),
         )
 
     def delays_s(self, t_mjd: np.ndarray, freqs_mhz=None, flags=None):
@@ -193,6 +200,25 @@ class TimingModel:
                 freqs_mhz, self.dm, dm1=self.dm1, t_mjd=t,
                 dmepoch_mjd=self.dmepoch_mjd,
             )
+        if self.dmx and freqs_mhz is not None:
+            from .components import K_DM
+
+            # windows are sorted and disjoint: one searchsorted pass
+            # instead of n_windows full-array masks (147-325 on the real
+            # fixtures, on the update_residuals hot path)
+            starts = np.asarray([w[2] for w in self.dmx])
+            ends = np.asarray([w[3] for w in self.dmx])
+            vals = np.asarray([w[1] for w in self.dmx])
+            idx = np.searchsorted(starts, t, side="right") - 1
+            idx_c = np.clip(idx, 0, len(self.dmx) - 1)
+            inside = (idx >= 0) & (t <= ends[idx_c])
+            dmx_t = np.where(inside, vals[idx_c], 0.0)
+            total = total + dmx_t / (K_DM * np.asarray(freqs_mhz) ** 2)
+        if self.fd and freqs_mhz is not None:
+            from .components import fd_column
+
+            for k, coeff in enumerate(self.fd, start=1):
+                total = total + coeff * fd_column(freqs_mhz, k)
         if self.include_roemer and self.ra_rad is not None:
             r = earth_position_au(t)
             ca, sa = np.cos(self.ra_rad), np.sin(self.ra_rad)

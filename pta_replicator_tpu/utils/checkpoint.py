@@ -97,6 +97,12 @@ def _rebuild_model(meta_model: dict):
         kwargs["jumps"] = tuple(
             (str(n), str(v), float(o)) for n, v, o in kwargs["jumps"]
         )
+    if kwargs.get("fd"):
+        kwargs["fd"] = tuple(float(c) for c in kwargs["fd"])
+    if kwargs.get("dmx"):
+        kwargs["dmx"] = tuple(
+            (str(l), float(v), float(a), float(b)) for l, v, a, b in kwargs["dmx"]
+        )
     return TimingModel(**kwargs)
 
 

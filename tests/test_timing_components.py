@@ -158,12 +158,21 @@ def test_b1855_binary_refit_absorbs_orbital_signal(b1855):
     assert float(psr.par.params["A1"][0]) == pytest.approx(b.a1_ls + dA1, rel=1e-9)
 
 
-def test_b1855_dm_refit(b1855):
-    """A DM offset (1/f^2 signature across the real multi-band TOAs) is
-    absorbed and recovered by the full fit."""
-    import copy
+def test_b1855_dm_refit(tmp_path):
+    """On a DMX-less model the global DM column carries the 1/f^2
+    signature: strip B1855's DMX windows, inject a DM offset across the
+    real multi-band TOAs, and the full fit recovers it."""
+    from pta_replicator_tpu import load_pulsar
 
-    psr = copy.deepcopy(b1855)
+    stripped = tmp_path / "b1855_nodmx.par"
+    with open(B1855_PAR) as fh, open(stripped, "w") as out:
+        for line in fh:
+            if not line.startswith(("DMX_", "DMXR1_", "DMXR2_")):
+                out.write(line)
+    psr = load_pulsar(str(stripped), B1855_TIM)
+    make_ideal(psr)
+    assert psr.par.dmx_windows == []
+
     dDM = 1e-4
     psr.inject(
         "dm_error", {},
@@ -414,3 +423,52 @@ def test_degenerate_jump_column_skipped():
     ]
     _, names = full_design_matrix(par, t, freqs_mhz=f, flags=flags_half)
     assert "JUMP1" in names
+
+
+def test_b1855_fd_refit(b1855):
+    """An FD-shaped (chromatic profile-evolution) perturbation is
+    absorbed by the full fit and its coefficient recovered."""
+    import copy
+
+    from pta_replicator_tpu.timing.components import fd_column
+
+    psr = copy.deepcopy(b1855)
+    assert len(psr.par.fd_terms) == 3
+    dFD1 = 2e-5
+    psr.inject(
+        "fd_error", {},
+        np.asarray(dFD1 * fd_column(psr.toas.freqs_mhz, 1), np.float64),
+    )
+    psr.fit(fitter="wls", params="full")
+    assert psr.fit_results["FD1"] == pytest.approx(dFD1, rel=0.1)
+    assert _rms(psr.residuals.resids_value) < 1e-7
+    # write-back: par FD1 = declared + fitted
+    assert psr.par.fd_terms[0] == pytest.approx(
+        0.00011146578515037641 + psr.fit_results["FD1"], abs=1e-18
+    )
+
+
+def test_b1855_dmx_refit(b1855):
+    """Windowed DM offsets (the NANOGrav DMX model, 147 windows on this
+    par) are fitted per-window; a global DM shift is absorbed as a
+    near-uniform DMX update, and the global DM column is absent (it
+    would be collinear with the all-covering windows)."""
+    import copy
+
+    psr = copy.deepcopy(b1855)
+    assert len(psr.par.dmx_windows) == 147
+    dDM = 1e-4
+    from pta_replicator_tpu.timing.components import dispersion_delay
+
+    psr.inject(
+        "dm_error", {},
+        np.asarray(dispersion_delay(psr.toas.freqs_mhz, dDM), np.float64),
+    )
+    psr.fit(fitter="wls", params="full")
+    assert "DM" not in psr.fit_results
+    fitted = [
+        v for k, v in psr.fit_results.items() if k.startswith("DMX_")
+    ]
+    assert len(fitted) > 100  # most windows hold TOAs
+    assert np.median(fitted) == pytest.approx(dDM, rel=0.05)
+    assert _rms(psr.residuals.resids_value) < 1e-7
