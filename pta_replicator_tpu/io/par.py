@@ -192,7 +192,10 @@ class ParModel:
                 ))
             except ValueError:
                 continue
-        return sorted(out)
+        # time order (not label order): the delay model's searchsorted
+        # pass requires monotonic window starts, and labels need not be
+        # zero-padded ('10' sorts before '2' lexicographically)
+        return sorted(out, key=lambda w: w[2])
 
     def write(self, path: str) -> None:
         """Write the par file back out, preserving original content."""
@@ -225,7 +228,7 @@ def read_par(path: str) -> ParModel:
             model.decj_deg = _parse_dms(value)
         elif key in _FLOAT_KEYS:
             try:
-                fval = float(value.replace("D", "E").replace("d", "e"))
+                fval = _parse_float(value)
             except ValueError:
                 continue
             if key == "F0":

@@ -722,6 +722,9 @@ class Recipe:
     cgw_psr_term: bool = field(metadata=dict(static=True), default=True)
     cgw_evolve: bool = field(metadata=dict(static=True), default=True)
     cgw_phase_approx: bool = field(metadata=dict(static=True), default=False)
+    #: (Np, Nt, K) full-model design tensor for the per-realization
+    #: refit (timing.fit.design_tensor); None = quadratic F0/F1 proxy
+    fit_design: Optional[jax.Array] = None
     #: GWB DFT-synthesis matmul precision (None = backend default;
     #: 'highest' forces full-f32 MXU passes; see gwb_delays)
     gwb_synthesis_precision: object = field(
@@ -820,6 +823,57 @@ def quadratic_fit_subtract(delays, batch: PulsarBatch):
     return (delays - jnp.einsum("pni,pi->pn", M, coef)) * batch.mask
 
 
+def design_fit_subtract(delays, batch: PulsarBatch, design, ridge=1e-10):
+    """Project out the weighted best-fit of an arbitrary per-pulsar
+    design tensor — the device form of the oracle's FULL-model refit
+    (timing.fit.wls_fit over timing.components.full_design_matrix,
+    reference analog: the per-realization PINT fit, simulate.py:44-69).
+
+    ``design``: (Np, Nt, K) delay-derivative columns, built once on the
+    CPU frontier by :func:`~pta_replicator_tpu.timing.fit.design_tensor`
+    and padded to a common K with all-zero columns (those are
+    neutralized here, not fitted). Column-normalized normal equations +
+    Cholesky solve: one (Np, K, K) batched factorization per
+    realization, MXU-friendly. Note the f32 caveat: squaring the
+    condition number costs accuracy on nearly-collinear columns — run
+    f64 (or validate against the oracle fit) when exact parameter
+    recovery matters; residual *power absorption* is robust.
+    """
+    dtype = delays.dtype
+    design = jnp.asarray(design, dtype)
+    w = batch.mask / batch.errors_s  # sqrt of the WLS weights
+    Mw = design * w[..., None]  # (Np, Nt, K)
+    norms = jnp.sqrt(jnp.sum(Mw**2, axis=-2))  # (Np, K)
+    zero_col = norms == 0.0  # padding columns
+    norms = jnp.where(zero_col, 1.0, norms)
+    Mn = Mw / norms[:, None, :]
+    A = jnp.einsum("pnk,pnl->pkl", Mn, Mn)
+    # all-zero padding columns get a unit diagonal and a zero rhs, so
+    # their coefficients solve to exactly 0
+    K = design.shape[-1]
+    A = A + jnp.eye(K, dtype=dtype) * zero_col[:, None, :].astype(dtype)
+    # tiny Tikhonov term (columns are unit-normalized, so diag(A) = 1):
+    # exactly duplicated columns would make A singular and jnp.linalg
+    # .solve would silently return NaN for the whole pulsar; the ridge
+    # turns that into a deterministic even split at ~1e-10 relative cost
+    A = A + ridge * jnp.eye(K, dtype=dtype)
+    b = jnp.einsum("pnk,pn->pk", Mn, delays * w)
+    coef = jnp.linalg.solve(A, b[..., None])[..., 0]
+    model = jnp.einsum("pnk,pk->pn", Mn, coef) / jnp.where(
+        jnp.abs(w) > 0, w, 1.0
+    )
+    return (delays - model) * batch.mask
+
+
+def fit_subtract(delays, batch: PulsarBatch, recipe: Recipe):
+    """The per-realization refit step: the full-model design fit when the
+    recipe carries a design tensor, else the quadratic (F0/F1-proxy)
+    fit."""
+    if recipe.fit_design is not None:
+        return design_fit_subtract(delays, batch, recipe.fit_design)
+    return quadratic_fit_subtract(delays, batch)
+
+
 def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
     """Realization-independent delays (CW outlier catalog, bursts, memory,
     transients): computed once per batch, shared across the whole
@@ -873,7 +927,7 @@ def realize(key, batch: PulsarBatch, recipe: Recipe, nreal: int, fit: bool = Fal
 
     def one(k):
         d = realization_delays(k, batch, recipe) + static
-        d = quadratic_fit_subtract(d, batch) if fit else d
+        d = fit_subtract(d, batch, recipe) if fit else d
         return residualize(d, batch)
 
     return jax.vmap(one)(keys)

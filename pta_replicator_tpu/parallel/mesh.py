@@ -29,7 +29,7 @@ from ..batch import PulsarBatch
 from ..models.batched import (
     Recipe,
     deterministic_delays,
-    quadratic_fit_subtract,
+    fit_subtract,
     realization_delays,
     residualize,
 )
@@ -108,7 +108,7 @@ def _realize_block(
 
     def one(k):
         d = realization_delays(k, batch, recipe, rows=rows) + static
-        d = quadratic_fit_subtract(d, batch) if fit else d
+        d = fit_subtract(d, batch, recipe) if fit else d
         return residualize(d, batch)
 
     return jax.vmap(one)(keys)
@@ -204,6 +204,7 @@ _PSR_MAJOR_RECIPE_FIELDS = frozenset(
         "rn_fmax",
         "rn_tspan_s",
         "orf_cholesky",
+        "fit_design",
     }
 )
 #: per-pulsar only in their 2-D (Np, Ns) form ((Ns,) / scalar replicate)

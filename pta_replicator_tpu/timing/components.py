@@ -50,11 +50,13 @@ OBLIQUITY = np.deg2rad(23.439291)
 
 def _parf(par, key: str, default: Optional[float] = None) -> Optional[float]:
     """Float value of a par-file parameter (first token), or default."""
+    from ..io.par import _parse_float
+
     tok = par.params.get(key)
     if not tok:
         return default
     try:
-        return float(str(tok[0]).replace("D", "E").replace("d", "e"))
+        return _parse_float(tok[0])
     except ValueError:
         return default
 
@@ -284,9 +286,10 @@ def fd_column(freqs_mhz, k: int, xp=np):
 
 
 def dmx_column(t_mjd, freqs_mhz, r1_mjd: float, r2_mjd: float, xp=np):
-    """d(delay)/d(DMX) = 1/(K_DM f^2) inside the [r1, r2) window, 0
-    outside — the per-window dispersion offsets of the NANOGrav DMX
-    model (147-325 windows on the real fixtures)."""
+    """d(delay)/d(DMX) = 1/(K_DM f^2) inside the [r1, r2] window
+    (inclusive both ends, PINT's DMX range semantics), 0 outside — the
+    per-window dispersion offsets of the NANOGrav DMX model (147-325
+    windows on the real fixtures)."""
     t = xp.asarray(t_mjd)
     f = xp.asarray(freqs_mhz)
     # inclusive on both ends, matching PINT's DMX range semantics
@@ -387,7 +390,16 @@ def full_design_matrix(
         cols += [acols[i] for i in keep]
         names += [anames[i] for i in keep]
 
-    dmx = getattr(par, "dmx_windows", ()) if freqs_mhz is not None else ()
+    # every chromatic column family (DMX, DM, FD) needs more than one
+    # observing frequency: on single-band data they all collapse to
+    # constants collinear with OFFSET, and the rank-deficient solve
+    # would persist arbitrary splits to the par (same degeneracy class
+    # as an all-covering JUMP)
+    multiband = (
+        freqs_mhz is not None
+        and np.unique(np.asarray(freqs_mhz)).size > 1
+    )
+    dmx = getattr(par, "dmx_windows", ()) if multiband else ()
     dmx_active = []
     if dmx:
         for label, _value, r1, r2 in dmx:
@@ -398,7 +410,7 @@ def full_design_matrix(
                 cols.append(col)
                 names.append(f"DMX_{label}")
                 dmx_active.append(label)
-    if freqs_mhz is not None and "DM" in par.params and not dmx_active:
+    if multiband and "DM" in par.params and not dmx_active:
         # the global DM column is exactly the sum of all-covering DMX
         # columns — fitting both is rank-deficient, and the reference's
         # pars hold DM fixed when DMX is declared (no fit flag on DM,
@@ -414,11 +426,7 @@ def full_design_matrix(
             )
             names.append("DM1")
 
-    if freqs_mhz is not None and np.unique(np.asarray(freqs_mhz)).size > 1:
-        # single-frequency data makes every FD column a constant —
-        # collinear with OFFSET (same degeneracy class as an
-        # all-covering JUMP); skip them all rather than persist an
-        # arbitrary split
+    if multiband:
         for k in range(1, len(getattr(par, "fd_terms", ())) + 1):
             cols.append(fd_column(freqs_mhz, k, xp=xp))
             names.append(f"FD{k}")

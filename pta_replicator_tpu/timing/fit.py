@@ -126,6 +126,41 @@ def noise_covariance(
     return C
 
 
+def design_tensor(psrs, ntoa_max=None, nspin: int = 2, include="auto"):
+    """Padded (Np, Nt_max, K_max) full-model design tensor for the
+    batched device refit (models.batched.design_fit_subtract).
+
+    Builds each pulsar's :func:`~pta_replicator_tpu.timing.components.
+    full_design_matrix` (spin, astrometry, DMX/DM, FD, binary, JUMPs) on
+    the CPU frontier and zero-pads TOAs and columns to common sizes —
+    padding rows carry zero batch mask and padding columns are
+    neutralized by the device solver. Pass the SAME pulsar list (same
+    order) used to freeze the batch. Returns ``(tensor, names)`` with
+    ``names[i]`` the column labels of pulsar ``i``.
+    """
+    from .components import full_design_matrix
+
+    mats, names = [], []
+    for psr in psrs:
+        M, nm = full_design_matrix(
+            psr.par,
+            psr.toas.get_mjds(),
+            freqs_mhz=psr.toas.freqs_mhz,
+            f0=psr.model.f0,
+            nspin=nspin,
+            include=include,
+            flags=psr.toas.flags,
+        )
+        mats.append(np.asarray(M, np.float64))
+        names.append(nm)
+    nt = ntoa_max or max(m.shape[0] for m in mats)
+    kmax = max(m.shape[1] for m in mats)
+    out = np.zeros((len(mats), nt, kmax))
+    for i, m in enumerate(mats):
+        out[i, : m.shape[0], : m.shape[1]] = m
+    return out, names
+
+
 def covariance_from_recipe(
     psr,
     recipe,

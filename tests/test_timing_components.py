@@ -97,17 +97,33 @@ def test_earth_orbit_sanity():
 
 # ------------------------------------------------------- full design matrix
 
-def test_full_design_matrix_b1855_columns():
+def test_full_design_matrix_b1855_columns(b1855, tmp_path):
+    # real TOAs/frequencies: the DMX windows are ~0.15 d wide, so only
+    # real observation epochs land inside them, and the multi-band
+    # frequency coverage keeps the chromatic columns non-degenerate
     par = read_par(B1855_PAR)
-    t = np.linspace(53400, 57500, 300)
-    f = np.full(300, 1400.0)
-    M, names = full_design_matrix(par, t, freqs_mhz=f)
-    # ELL1 binary with Shapiro: PB A1 TASC EPS1 EPS2 M2 SINI all present
+    t = b1855.toas.get_mjds()
+    f = b1855.toas.freqs_mhz
+    M, names = full_design_matrix(
+        par, t, freqs_mhz=f, flags=b1855.toas.flags
+    )
     for nm in ("OFFSET", "F0", "F1", "RAJ", "DECJ", "PMRA", "PMDEC", "PX",
-               "DM", "PB", "A1", "TASC", "EPS1", "EPS2", "M2", "SINI"):
+               "FD1", "FD2", "FD3", "PB", "A1", "TASC", "EPS1", "EPS2",
+               "M2", "SINI", "JUMP1"):
         assert nm in names, nm
-    assert M.shape == (300, len(names))
+    assert "DM" not in names  # collinear with the all-covering DMX set
+    assert sum(nm.startswith("DMX_") for nm in names) > 100
+    assert M.shape == (len(t), len(names))
     assert np.all(np.isfinite(M))
+
+    # with the DMX windows stripped, the global DM column appears
+    stripped = tmp_path / "nodmx.par"
+    with open(B1855_PAR) as fh, open(stripped, "w") as out:
+        for line in fh:
+            if not line.startswith(("DMX_", "DMXR1_", "DMXR2_")):
+                out.write(line)
+    M, names = full_design_matrix(read_par(str(stripped)), t, freqs_mhz=f)
+    assert "DM" in names
 
 
 # ------------------------------------------- the B1855+09 refit criterion
