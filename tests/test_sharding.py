@@ -5,6 +5,8 @@ Validates the full TPU scale-out story without TPU hardware: the 2-D
 pure layout choice (results identical to the single-device path up to
 float reduction order in partitioned contractions).
 """
+import dataclasses
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -13,7 +15,24 @@ import pytest
 from pta_replicator_tpu.batch import synthetic_batch
 from pta_replicator_tpu.models import batched as B
 from pta_replicator_tpu.ops.orf import hellings_downs_matrix
-from pta_replicator_tpu.parallel import make_mesh, sharded_realize
+from pta_replicator_tpu.parallel import (
+    make_mesh,
+    sharded_realize,
+    shardmap_realize,
+)
+
+
+def assert_shardmap_matches_realize(batch, recipe, key, mesh, nreal=8):
+    """shardmap_realize over ``mesh`` must reproduce the single-device
+    B.realize result (one tolerance policy for every engine test)."""
+    ref = B.realize(key, batch, recipe, nreal=nreal, fit=True)
+    out = shardmap_realize(
+        key, batch, recipe, nreal=nreal, mesh=mesh, fit=True
+    )
+    rms = float(np.sqrt(np.mean(np.asarray(ref) ** 2)))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-9 * rms
+    )
 
 
 @pytest.fixture(scope="module")
@@ -87,8 +106,6 @@ def test_shardmap_matches_constraint_path(small_setup, n_real, n_psr):
     """The explicit-SPMD shard_map engine produces the same realizations
     as the sharding-constraint engine — on a realization-only mesh AND
     with the pulsar axis sharded (GWB ORF rows + row-windowed draws)."""
-    from pta_replicator_tpu.parallel import shardmap_realize
-
     batch, recipe = small_setup
     key = jax.random.PRNGKey(9)
     mesh = make_mesh(n_real, n_psr)
@@ -104,10 +121,6 @@ def test_shardmap_psr_sharded_with_cw_catalog(small_setup):
     """Deterministic CW catalog under a sharded pulsar axis: the scan
     carry must inherit the input's device-varying type (regression: a
     fresh jnp.zeros carry fails shard_map's scan vma check)."""
-    import dataclasses
-
-    from pta_replicator_tpu.parallel import shardmap_realize
-
     batch, recipe = small_setup
     rng = np.random.default_rng(3)
     ncw = 6
@@ -118,14 +131,8 @@ def test_shardmap_psr_sharded_with_cw_catalog(small_setup):
         rng.uniform(0, np.pi, ncw), np.arccos(rng.uniform(-1, 1, ncw)),
     ]))
     recipe = dataclasses.replace(recipe, cgw_params=cat, cgw_chunk=4)
-    key = jax.random.PRNGKey(21)
-    ref = B.realize(key, batch, recipe, nreal=8, fit=True)
-    out = shardmap_realize(
-        key, batch, recipe, nreal=8, mesh=make_mesh(4, 2), fit=True
-    )
-    rms = float(np.sqrt(np.mean(np.asarray(ref) ** 2)))
-    np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-9 * rms
+    assert_shardmap_matches_realize(
+        batch, recipe, jax.random.PRNGKey(21), make_mesh(4, 2)
     )
 
 
@@ -133,20 +140,10 @@ def test_shardmap_psr_sharded_uncorrelated_gwb(small_setup):
     """With no ORF (uncorrelated common process) the psr-sharded engine
     materializes the global sqrt(2)*I factor so shards draw distinct
     rows; result matches the single-device path."""
-    import dataclasses
-
-    from pta_replicator_tpu.parallel import shardmap_realize
-
     batch, recipe = small_setup
     recipe = dataclasses.replace(recipe, orf_cholesky=None)
-    key = jax.random.PRNGKey(11)
-    ref = B.realize(key, batch, recipe, nreal=8, fit=True)
-    out = shardmap_realize(
-        key, batch, recipe, nreal=8, mesh=make_mesh(4, 2), fit=True
-    )
-    rms = float(np.sqrt(np.mean(np.asarray(ref) ** 2)))
-    np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-9 * rms
+    assert_shardmap_matches_realize(
+        batch, recipe, jax.random.PRNGKey(11), make_mesh(4, 2)
     )
 
 
@@ -211,10 +208,6 @@ def test_shardmap_psr_sharded_guards(small_setup):
     """Loud failures for the psr-sharded engine's unsupported inputs:
     a global-pulsar-index transient, npsr not divisible by the axis, and
     a per-pulsar recipe leaf with the wrong leading dim."""
-    import dataclasses
-
-    from pta_replicator_tpu.parallel import shardmap_realize
-
     batch, recipe = small_setup
     key = jax.random.PRNGKey(0)
     mesh = make_mesh(2, 2)
@@ -244,3 +237,16 @@ def test_shardmap_psr_sharded_guards(small_setup):
     r_bad = dataclasses.replace(recipe, efac=jnp.ones(6))
     with pytest.raises(ValueError, match="leading dim"):
         shardmap_realize(key, batch, r_bad, nreal=8, mesh=mesh)
+
+
+def test_shardmap_psr_sharded_with_design_fit(small_setup):
+    """The per-realization full-design refit (Recipe.fit_design) works
+    under a sharded pulsar axis: the (Np, Nt, K) tensor shards its rows
+    and the per-pulsar solves stay local."""
+    batch, recipe = small_setup
+    rng = np.random.default_rng(5)
+    D = jnp.asarray(rng.normal(size=(batch.npsr, batch.ntoa_max, 5)))
+    recipe = dataclasses.replace(recipe, fit_design=D)
+    assert_shardmap_matches_realize(
+        batch, recipe, jax.random.PRNGKey(31), make_mesh(4, 2)
+    )
