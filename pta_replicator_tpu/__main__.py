@@ -89,12 +89,29 @@ def main(argv=None):
     p.add_argument("--out", required=True)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--fit", action="store_true")
+    p.add_argument("--full-fit", action="store_true",
+                   help="per-realization FULL-model refit (spin, "
+                        "astrometry, DMX/DM, FD, binary, JUMP columns "
+                        "from the loaded par files) instead of the "
+                        "quadratic proxy; implies --fit")
     p.add_argument("--sharded", action="store_true",
                    help="shard realizations over all visible devices")
     p.add_argument("--checkpoint", default=None,
                    help="resumable sweep checkpoint path (chunked)")
     p.add_argument("--chunk", type=int, default=256)
+    for sp in sub.choices.values():
+        sp.add_argument(
+            "--platform", default=None,
+            help="force a jax platform (e.g. 'cpu'); default: the "
+                 "session's backend. Deliberately not read from "
+                 "JAX_PLATFORMS (hosted environments preset it to a "
+                 "remote plugin that hangs when unreachable)")
     args = ap.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     from . import load_from_directories, make_ideal
 
@@ -121,6 +138,16 @@ def main(argv=None):
 
     with open(args.recipe) as fh:
         recipe = _build_recipe(json.load(fh), psrs)
+    if args.full_fit:
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from .timing.fit import design_tensor
+
+        args.fit = True
+        D, _names = design_tensor(psrs, ntoa_max=batch.ntoa_max)
+        recipe = dataclasses.replace(recipe, fit_design=jnp.asarray(D))
     key = jax.random.PRNGKey(args.seed)
 
     if args.checkpoint:

@@ -56,3 +56,37 @@ def test_cli_rejects_unknown_recipe_key(tmp_path, partim_small):
         main(["realize", "--pardir", pardir, "--timdir", timdir,
               "--recipe", str(recipe), "--nreal", "4",
               "--out", str(tmp_path / "x.npz")])
+
+
+def test_cli_full_fit(tmp_path, partim_small, capsys):
+    """--full-fit builds the per-pulsar design tensor from the loaded
+    pars and runs the full-model per-realization refit (implies --fit)."""
+    pardir, timdir = partim_small
+    recipe = tmp_path / "recipe.json"
+    recipe.write_text(json.dumps({"efac": 1.1, "orf": "none",
+                                  "gwb_log10_amplitude": -14.0,
+                                  "gwb_gamma": 4.33,
+                                  "gwb_npts": 100, "gwb_howml": 4.0}))
+    out = tmp_path / "res.npz"
+    main(["realize", "--pardir", pardir, "--timdir", timdir,
+          "--recipe", str(recipe), "--nreal", "4", "--out", str(out),
+          "--full-fit"])
+    report = json.loads(capsys.readouterr().out.strip())
+    assert report["shape"] == [4, 3, 122]
+    with np.load(out) as z:
+        full = z["residuals"]
+    assert np.isfinite(full).all()
+
+    # --full-fit must actually differ from the quadratic --fit proxy AND
+    # absorb at least as much power (more columns, same realizations) —
+    # a silent fallback to --fit would fail both checks
+    out2 = tmp_path / "res_quad.npz"
+    main(["realize", "--pardir", pardir, "--timdir", timdir,
+          "--recipe", str(recipe), "--nreal", "4", "--out", str(out2),
+          "--fit"])
+    json.loads(capsys.readouterr().out.strip())
+    with np.load(out2) as z:
+        quad = z["residuals"]
+    assert not np.allclose(full, quad, rtol=1e-6, atol=0.0)
+    rms = lambda x: float(np.sqrt(np.mean(x**2)))
+    assert rms(full) <= rms(quad) * (1.0 + 1e-9)
