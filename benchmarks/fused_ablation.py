@@ -1,0 +1,120 @@
+"""Fused-path stage ablation on real hardware.
+
+The standalone per-stage timings in bench.py's ``_stage_breakdown`` are
+dispatch-dominated on the tunneled backend (they sum to ~7x the fused
+cost). This tool measures what each stage *actually* costs inside the
+fused chunk: it times the headline bench workload (imported from
+bench.build_workload, so the two harnesses cannot drift apart) with one
+stage removed at a time — the delta vs the full graph is that stage's
+true marginal cost after XLA fusion.
+
+Usage: python benchmarks/fused_ablation.py [chunk] [nrep]
+(run from the repo root; keeps /root/.axon_site on PYTHONPATH)
+Prints one JSON line: per-config ms/realization + marginal deltas.
+"""
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    nrep = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+
+    import jax
+
+    platform = os.environ.get("BENCH_PLATFORM")  # e.g. 'cpu' for smoke tests
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+
+    from bench import build_workload
+    from pta_replicator_tpu.models.batched import (
+        deterministic_delays,
+        quadratic_fit_subtract,
+        realization_delays,
+        residualize,
+    )
+
+    batch, recipe = build_workload()
+
+    configs = {
+        "full": {},
+        "no_white": {"efac": None, "log10_equad": None},
+        "no_ecorr": {"log10_ecorr": None},
+        "no_rn": {"rn_log10_amplitude": None},
+        "no_gwb": {"gwb_log10_amplitude": None},
+    }
+
+    def make_chunk_fn(recipe, with_fit=True):
+        def run_chunk(key, static):
+            keys = jax.random.split(key, chunk)
+
+            def one(k):
+                d = realization_delays(k, batch, recipe) + static
+                if with_fit:
+                    d = quadratic_fit_subtract(d, batch)
+                return residualize(d, batch)
+
+            res = jax.vmap(one)(keys)
+            return jnp.sqrt(
+                jnp.sum(res**2 * batch.mask, axis=-1)
+                / jnp.sum(batch.mask, axis=-1)
+            )
+
+        return jax.jit(run_chunk)
+
+    # static CW delays computed once, outside all timed graphs (eagerly:
+    # concrete params keep the f64 host plane precompute — see
+    # parallel.mesh.static_delays)
+    static = deterministic_delays(batch, recipe)
+    np.asarray(static)
+
+    out = {}
+
+    def time_fn(fn, *args):
+        compiled = fn.lower(jax.random.PRNGKey(0), *args).compile()
+        np.asarray(compiled(jax.random.PRNGKey(0), *args))  # warm
+        best = np.inf
+        for _ in range(2):  # two passes, keep min (tunnel drift)
+            t0 = time.perf_counter()
+            for i in range(nrep):
+                r = compiled(jax.random.PRNGKey(i + 1), *args)
+            np.asarray(r)
+            best = min(best, (time.perf_counter() - t0) / (nrep * chunk))
+        return best * 1e3
+
+    for name, override in configs.items():
+        r = dataclasses.replace(recipe, **override)
+        out[name] = round(time_fn(make_chunk_fn(r), static), 5)
+
+    out["no_fit"] = round(time_fn(make_chunk_fn(recipe, False), static), 5)
+
+    full_ms = out["full"]
+    deltas = {
+        k.replace("no_", ""): round(full_ms - v, 5)
+        for k, v in out.items()
+        if k.startswith("no_")
+    }
+    print(
+        json.dumps(
+            {
+                "chunk": chunk,
+                "nrep": nrep,
+                "device": jax.devices()[0].device_kind,
+                "ms_per_realization": out,
+                "marginal_ms": deltas,
+                "rate_full": round(1e3 / full_ms, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

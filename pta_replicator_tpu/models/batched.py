@@ -916,14 +916,29 @@ def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
     return total
 
 
-def realize(key, batch: PulsarBatch, recipe: Recipe, nreal: int, fit: bool = False):
+def realize(
+    key,
+    batch: PulsarBatch,
+    recipe: Recipe,
+    nreal: int,
+    fit: bool = False,
+    static=None,
+):
     """Batch of independent realizations: (R, Np, Nt) residuals.
 
     vmap over PRNG keys gives the realization axis; shard it across
     devices with parallel.sharded_realize.
+
+    ``static``: precomputed :func:`deterministic_delays` result. The
+    deterministic delays (CW catalog, bursts, memory) depend only on
+    (batch, recipe), so a caller invoking ``realize`` repeatedly — a
+    chunked sweep — should compute them once and pass them in; rebuilding
+    the CW catalog inside every jitted call costs ~10 ms/call at the
+    bench workload, which dominates a 100-realization chunk.
     """
     keys = jax.random.split(key, nreal)
-    static = deterministic_delays(batch, recipe)
+    if static is None:
+        static = deterministic_delays(batch, recipe)
 
     def one(k):
         d = realization_delays(k, batch, recipe) + static

@@ -1,5 +1,11 @@
 from . import distributed
-from .mesh import make_mesh, shard_batch, sharded_realize, shardmap_realize
+from .mesh import (
+    make_mesh,
+    shard_batch,
+    sharded_realize,
+    shardmap_realize,
+    static_delays,
+)
 
 __all__ = [
     "distributed",
@@ -7,4 +13,5 @@ __all__ = [
     "shard_batch",
     "sharded_realize",
     "shardmap_realize",
+    "static_delays",
 ]

@@ -78,12 +78,18 @@ def sharded_realize(
     nreal: int,
     mesh: Optional[Mesh] = None,
     fit: bool = False,
+    static=None,
 ):
     """(R, Np, Nt) residual realizations with R sharded over 'real' and the
     pulsar axis sharded over 'psr'.
 
     Returns a jitted, committed global array; per-device shards hold
     R/n_real realizations of Np/n_psr pulsars. nreal must divide evenly.
+
+    ``static``: precomputed deterministic (CW/burst/memory) delays for
+    this (batch, recipe) — see :func:`static_delays`. Callers issuing
+    many chunked calls (utils.sweep) should compute them once; ``None``
+    recomputes them inside the engine each call.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -93,18 +99,48 @@ def sharded_realize(
 
     keys = jax.random.split(key, nreal)
     keys = jax.device_put(keys, NamedSharding(mesh, P("real")))
+    if static is None:
+        # computing the deterministic delays inside the jitted engine
+        # would trace the source params and lose the f64 host plane
+        # precompute (see static_delays) — default to the accurate path
+        # for every caller, opt-in `static=` merely skips the recompute.
+        # Computed from the pre-shard batch: the CW plane precompute
+        # reads host values, which a multi-host global array can't serve.
+        static = static_delays(batch, recipe, mesh=mesh)
     batch = shard_batch(batch, mesh)
-    return _constraint_engine(mesh, fit)(keys, batch, recipe)
+    return _constraint_engine(mesh, fit)(keys, batch, recipe, static)
+
+
+def static_delays(batch: PulsarBatch, recipe: Recipe, mesh: Optional[Mesh] = None):
+    """Deterministic (realization-independent) delays, laid out for
+    ``mesh`` when given: the once-per-sweep precompute whose result feeds
+    ``sharded_realize(..., static=...)`` / ``realize(..., static=...)``.
+
+    Deliberately computed EAGERLY, not under ``jax.jit(deterministic_
+    delays)(batch, recipe)``: the CW catalog's f32 accuracy comes from an
+    epoch-folded float64 *host* precompute of its coefficient planes,
+    which requires concrete (non-tracer) source parameters
+    (models.batched.cgw_catalog_delays). Passing batch/recipe through a
+    jit boundary turns them into tracers and silently demotes the planes
+    to ambient f32 (~1e-1 relative error on chirp phases vs ~1e-4 — see
+    tests/test_regressions.py::test_static_delays_uses_f64_host_planes).
+    This runs once per sweep, so eager dispatch costs nothing.
+    """
+    out = deterministic_delays(batch, recipe)
+    if mesh is not None:
+        out = jax.device_put(out, NamedSharding(mesh, P("psr", None)))
+    return out
 
 
 def _realize_block(
-    keys, batch: PulsarBatch, recipe: Recipe, fit: bool, rows=None
+    keys, batch: PulsarBatch, recipe: Recipe, fit: bool, rows=None, static=None
 ):
     """The per-block realization pipeline shared by both mesh engines.
 
     ``rows=(npsr_global, row_start)`` makes every stochastic draw an
     exact row window of the global stream (pulsar-sharded shard_map)."""
-    static = deterministic_delays(batch, recipe)
+    if static is None:
+        static = deterministic_delays(batch, recipe)
 
     def one(k):
         d = realization_delays(k, batch, recipe, rows=rows) + static
@@ -122,8 +158,8 @@ def _constraint_engine(mesh: Mesh, fit: bool):
     out_spec = NamedSharding(mesh, P("real", "psr", None))
 
     @jax.jit
-    def run(keys, batch, recipe):
-        out = _realize_block(keys, batch, recipe, fit)
+    def run(keys, batch, recipe, static):
+        out = _realize_block(keys, batch, recipe, fit, static=static)
         return jax.lax.with_sharding_constraint(out, out_spec)
 
     return run
@@ -140,16 +176,17 @@ def _shard_map():
 @functools.lru_cache(maxsize=64)
 def _shardmap_engine(mesh: Mesh, fit: bool):
     """Jitted shard_map engine, cached per (mesh, fit). P() acts as a
-    prefix spec: the whole batch/recipe trees replicate."""
+    prefix spec: the whole batch/recipe trees replicate, and so does the
+    optional precomputed ``static`` (None or a replicated (Np, Nt))."""
 
-    def local(keys_shard, batch, recipe):
-        return _realize_block(keys_shard, batch, recipe, fit)
+    def local(keys_shard, batch, recipe, static):
+        return _realize_block(keys_shard, batch, recipe, fit, static=static)
 
     return jax.jit(
         _shard_map()(
             local,
             mesh=mesh,
-            in_specs=(P("real"), P(), P()),
+            in_specs=(P("real"), P(), P(), P()),
             out_specs=P("real"),
         )
     )
@@ -164,25 +201,28 @@ def _shardmap_psr_engine(mesh: Mesh, fit: bool, recipe_treedef, recipe_specs):
     caller, cached here by their flattened form). The GWB ORF Cholesky
     rows shard with the pulsars, and gwb_delays regenerates the global
     per-pulsar spectra from the replicated key, so the cross-pulsar mix
-    needs no collective (see gwb_delays).
+    needs no collective (see gwb_delays). The optional precomputed
+    ``static`` delays are pulsar-major and shard with the batch.
     """
     recipe_spec_tree = jax.tree_util.tree_unflatten(
         recipe_treedef, list(recipe_specs)
     )
     n_shards = mesh.shape["psr"]
 
-    def local(keys_shard, batch, recipe):
+    def local(keys_shard, batch, recipe, static):
         rows = (
             batch.npsr * n_shards,
             jax.lax.axis_index("psr") * batch.npsr,
         )
-        return _realize_block(keys_shard, batch, recipe, fit, rows=rows)
+        return _realize_block(
+            keys_shard, batch, recipe, fit, rows=rows, static=static
+        )
 
     return jax.jit(
         _shard_map()(
             local,
             mesh=mesh,
-            in_specs=(P("real"), P("psr"), recipe_spec_tree),
+            in_specs=(P("real"), P("psr"), recipe_spec_tree, P("psr")),
             out_specs=P("real", "psr"),
         )
     )
@@ -239,6 +279,7 @@ def shardmap_realize(
     nreal: int,
     mesh: Optional[Mesh] = None,
     fit: bool = False,
+    static=None,
 ):
     """Explicit-SPMD variant of :func:`sharded_realize` via ``shard_map``:
     every device runs the per-shard program on its own block of PRNG keys
@@ -251,6 +292,12 @@ def shardmap_realize(
     frequency draws from the replicated key (see gwb_delays). Results are
     identical to the constraint-based path either way
     (test_shardmap_matches_constraint_path).
+
+    ``static``: precomputed :func:`static_delays` result (pulsar-major;
+    shards along 'psr' on a pulsar-sharded mesh). Chunked callers should
+    precompute it once — besides the per-call cost, the host f64 CW
+    plane precompute only happens outside the jitted engine (see
+    static_delays).
     """
     if mesh is None:
         mesh = make_mesh()
@@ -261,7 +308,11 @@ def shardmap_realize(
 
     n_psr_axis = mesh.shape.get("psr", 1)
     if n_psr_axis == 1:
-        return _shardmap_engine(mesh, fit)(keys, batch, recipe)
+        if static is None:
+            # same accuracy rationale as in sharded_realize: keep the CW
+            # plane precompute out of the traced engine
+            static = static_delays(batch, recipe, mesh=mesh)
+        return _shardmap_engine(mesh, fit)(keys, batch, recipe, static)
 
     npsr = batch.npsr
     if npsr % n_psr_axis:
@@ -289,7 +340,10 @@ def shardmap_realize(
             * jnp.eye(npsr, dtype=batch.toas_s.dtype),
         )
 
+    if static is None:
+        # after the psr-axis validity checks: accurate eager precompute
+        static = static_delays(batch, recipe, mesh=mesh)
     spec_tree = _recipe_psr_specs(recipe, npsr)
     leaves, treedef = jax.tree_util.tree_flatten(spec_tree)
     engine = _shardmap_psr_engine(mesh, fit, treedef, tuple(leaves))
-    return engine(keys, batch, recipe)
+    return engine(keys, batch, recipe, static)

@@ -196,12 +196,23 @@ def sweep(
 
     blocks = [np.load(_chunk_path(checkpoint_path, i)) for i in range(done)]
 
+    # the deterministic (CW-catalog/burst/memory) delays depend only on
+    # (batch, recipe): compute once for the whole sweep, not per chunk
+    static = None
+    if done < nchunks:
+        from ..parallel.mesh import static_delays
+
+        static = static_delays(batch, recipe, mesh=mesh)
+
     for i in range(done, nchunks):
         k = jax.random.fold_in(key, i)
         if mesh is not None:
-            res = sharded_realize(k, batch, recipe, nreal=chunk, mesh=mesh, fit=fit)
+            res = sharded_realize(
+                k, batch, recipe, nreal=chunk, mesh=mesh, fit=fit,
+                static=static,
+            )
         else:
-            res = realize(k, batch, recipe, nreal=chunk, fit=fit)
+            res = realize(k, batch, recipe, nreal=chunk, fit=fit, static=static)
         out = reduce_fn(res, batch) if reduce_fn is not None else res
         block = np.asarray(out)  # readback = the sync fence
         blocks.append(block)

@@ -228,3 +228,56 @@ def test_cw_catalog_vector_pdist_pphase_chunked():
             psr.added_signals_time[f"{psr.name}_{name}_whole"],
             rtol=1e-9,
         )
+
+
+def test_static_delays_uses_f64_host_planes():
+    """parallel.static_delays must keep the CW catalog's f64 host plane
+    precompute: computing deterministic_delays with batch/recipe as *jit
+    arguments* turns the source parameters into tracers and silently
+    demotes the epoch-folded planes to ambient f32 (~1e-1 relative error
+    on chirp phases). Guards the once-per-sweep static precompute path
+    (bench.py, utils.sweep, parallel.static_delays) against that trap.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models.batched import Recipe, deterministic_delays
+    from pta_replicator_tpu.parallel import static_delays
+
+    rng = np.random.default_rng(0)
+    n = 8
+    cat = np.stack(
+        [
+            np.arccos(rng.uniform(-1, 1, n)),
+            rng.uniform(0, 2 * np.pi, n),
+            10 ** rng.uniform(8, 9.5, n),
+            rng.uniform(50, 1000, n),
+            10 ** rng.uniform(-8.8, -7.6, n),
+            rng.uniform(0, 2 * np.pi, n),
+            rng.uniform(0, np.pi, n),
+            np.arccos(rng.uniform(-1, 1, n)),
+        ]
+    )
+
+    def build(dtype):
+        batch = synthetic_batch(npsr=4, ntoa=128, nbackend=2, seed=0, dtype=dtype)
+        recipe = Recipe(cgw_params=jnp.asarray(cat, dtype), cgw_chunk=8)
+        return batch, recipe
+
+    b64, r64 = build(jnp.float64)
+    oracle = np.asarray(deterministic_delays(b64, r64))
+    rms = np.sqrt(np.mean(oracle**2))
+
+    b32, r32 = build(jnp.float32)
+    static = np.asarray(static_delays(b32, r32))
+    rel = np.sqrt(np.mean((static - oracle) ** 2)) / rms
+    assert rel < 1e-3, rel
+
+    # the trap this test exists for: the same computation through a jit
+    # boundary loses the host precompute and lands far outside the f32
+    # floor — if this ever starts passing at 1e-3, the traced path has
+    # been fixed and static_delays may be simplified
+    traced = np.asarray(jax.jit(deterministic_delays)(b32, r32))
+    rel_traced = np.sqrt(np.mean((traced - oracle) ** 2)) / rms
+    assert rel_traced > 10 * rel, (rel_traced, rel)

@@ -250,3 +250,37 @@ def test_shardmap_psr_sharded_with_design_fit(small_setup):
     assert_shardmap_matches_realize(
         batch, recipe, jax.random.PRNGKey(31), make_mesh(4, 2)
     )
+
+
+@pytest.mark.parametrize("n_real,n_psr", [(8, 1), (4, 2)])
+def test_engines_accept_precomputed_static(small_setup, n_real, n_psr):
+    """sharded_realize/shardmap_realize with a precomputed static_delays
+    array must match their compute-internally default (the once-per-sweep
+    hoist used by utils.sweep and bench.py), including on a pulsar-sharded
+    mesh where the static delays shard along 'psr'."""
+    from pta_replicator_tpu.parallel import static_delays
+
+    batch, recipe = small_setup
+    rng = np.random.default_rng(5)
+    ncw = 6
+    cat = jnp.asarray(np.stack([
+        np.arccos(rng.uniform(-1, 1, ncw)), rng.uniform(0, 2 * np.pi, ncw),
+        10 ** rng.uniform(8, 9.3, ncw), rng.uniform(50, 900, ncw),
+        10 ** rng.uniform(-8.6, -7.8, ncw), rng.uniform(0, 2 * np.pi, ncw),
+        rng.uniform(0, np.pi, ncw), np.arccos(rng.uniform(-1, 1, ncw)),
+    ]))
+    recipe = dataclasses.replace(recipe, cgw_params=cat, cgw_chunk=4)
+    mesh = make_mesh(n_real, n_psr)
+    key = jax.random.PRNGKey(33)
+    static = static_delays(batch, recipe, mesh=mesh)
+    assert np.asarray(jnp.abs(static)).max() > 0  # CW delays are nonzero
+
+    for engine in (sharded_realize, shardmap_realize):
+        ref = engine(key, batch, recipe, nreal=8, mesh=mesh, fit=True)
+        out = engine(
+            key, batch, recipe, nreal=8, mesh=mesh, fit=True, static=static
+        )
+        rms = float(np.sqrt(np.mean(np.asarray(ref) ** 2)))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-7 * rms
+        )
