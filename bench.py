@@ -23,7 +23,7 @@ diagnosis. Timing syncs via host readback (block_until_ready returns at
 dispatch on this backend, see .claude/skills/verify).
 
 Tuning knobs via env: BENCH_CHUNK (realizations per jitted call, default
-400), BENCH_NREP (timed repetitions, default 5), BENCH_PRNG ('threefry'
+800), BENCH_NREP (timed repetitions, default 5), BENCH_PRNG ('threefry'
 default; 'rbg' uses the hardware RngBitGenerator for the per-realization
 draws), BENCH_PROBE_TRIES (default 3), BENCH_PROBE_TIMEOUT (s, default
 120), BENCH_TIMEOUT (overall child deadline, s, default 1500),
@@ -170,7 +170,6 @@ def _bench():
         deterministic_delays,
         quadratic_fit_subtract,
         realization_delays,
-        residualize,
     )
 
     ncw = 100
@@ -259,7 +258,7 @@ def _bench():
         extra["cgw_crosscheck_error"] = repr(exc)
 
 
-    chunk = int(os.environ.get("BENCH_CHUNK", "400"))  # realizations/call
+    chunk = int(os.environ.get("BENCH_CHUNK", "800"))  # realizations/call
 
     # The CW-catalog/burst/memory delays depend only on (batch, recipe):
     # compute them ONCE for the whole sweep and pass them into every
@@ -277,8 +276,9 @@ def _bench():
 
         def one(k):
             d = realization_delays(k, batch, recipe) + static
-            d = quadratic_fit_subtract(d, batch)
-            return residualize(d, batch)
+            # the quad fit projects out the weighted constant at full
+            # precision, so no separate residualize pass is needed
+            return quadratic_fit_subtract(d, batch)
 
         res = jax.vmap(one)(keys)
         # reduce on device: per-realization, per-pulsar RMS (avoids hauling

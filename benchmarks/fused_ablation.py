@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
-    chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 400
+    chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 800
     nrep = int(sys.argv[2]) if len(sys.argv) > 2 else 5
 
     import jax
@@ -59,7 +59,9 @@ def main():
             def one(k):
                 d = realization_delays(k, batch, recipe) + static
                 if with_fit:
-                    d = quadratic_fit_subtract(d, batch)
+                    # quad fit projects the weighted constant: no extra
+                    # residualize pass (matches bench.py's run_chunk)
+                    return quadratic_fit_subtract(d, batch)
                 return residualize(d, batch)
 
             res = jax.vmap(one)(keys)

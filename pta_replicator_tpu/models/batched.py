@@ -35,7 +35,8 @@ from .gwb import (
 #: op's key-consumption order or draw layout changes (e.g. the red-noise
 #: coefficient interleave), so resumable sweeps checkpointed under a
 #: different stream refuse to resume instead of silently mixing streams.
-STREAM_VERSION = 2
+#: v3: white noise draws ONE combined-variance normal per TOA (was two).
+STREAM_VERSION = 3
 
 
 def _per_toa(params, index, mask):
@@ -81,25 +82,31 @@ def white_noise_delays(
 ):
     """EFAC/EQUAD white noise. ``efac``/``log10_equad`` are scalars, (Np,)
     vectors, or (Np, n_backends) per-backend tables. ``rows``: global-row
-    window for pulsar-sharded SPMD (see :func:`_rows_draw`)."""
+    window for pulsar-sharded SPMD (see :func:`_rows_draw`).
+
+    One normal per TOA at the combined per-TOA standard deviation
+    (sum of two independent zero-mean Gaussians == one Gaussian with the
+    summed variance) — the oracle path keeps the reference's two-draw
+    layout for seed parity (models.white_noise.measurement_noise_delay,
+    reference white_noise.py:112-121); on device the draw is the dominant
+    cost of this op, and halving the RNG bits is distribution-exact.
+    The per-signal ledger decomposition is unaffected: the op reports one
+    'measurement_noise' delay vector either way."""
     dtype = batch.toas_s.dtype
-    k1, k2 = jax.random.split(key)
     shape = batch.toas_s.shape
-    eps1 = _rows_draw(jax.random.normal, k1, rows, shape, dtype)
-    eps2 = _rows_draw(jax.random.normal, k2, rows, shape, dtype)
+    eps = _rows_draw(jax.random.normal, key, rows, shape, dtype)
     ef = jnp.asarray(efac, dtype)
     ef = jnp.broadcast_to(ef, (batch.npsr,)) if ef.ndim == 0 else ef
     efac_t = _per_toa(ef, batch.backend_index, batch.mask)
-    if log10_equad is None:
-        equad_t = jnp.zeros(shape, dtype)
-    else:
+    var = (efac_t * batch.errors_s) ** 2
+    if log10_equad is not None:
         eq = 10.0 ** jnp.asarray(log10_equad, dtype)
         eq = jnp.broadcast_to(eq, (batch.npsr,)) if eq.ndim == 0 else eq
         equad_t = _per_toa(eq, batch.backend_index, batch.mask)
-    dt = efac_t * batch.errors_s * eps1 * batch.mask
-    if tnequad:
-        return dt + equad_t * eps2
-    return dt + efac_t * equad_t * eps2
+        if not tnequad:
+            equad_t = efac_t * equad_t
+        var = var + equad_t**2
+    return jnp.sqrt(var) * eps * batch.mask
 
 
 def jitter_delays(key, batch: PulsarBatch, log10_ecorr, rows=None):
@@ -813,14 +820,25 @@ def residualize(delays, batch: PulsarBatch):
 def quadratic_fit_subtract(delays, batch: PulsarBatch):
     """Project out the weighted best-fit quadratic in time per pulsar — the
     batched analog of the post-injection F0/F1 refit
-    (oracle analog SimulatedPulsar.fit, reference simulate.py:44-69)."""
+    (oracle analog SimulatedPulsar.fit, reference simulate.py:44-69).
+
+    The normal-equation einsums run at ``precision='highest'``: on TPU the
+    default matmul precision is bf16, whose ~3-digit Gram matrix leaves a
+    visible (~1e-2 relative) un-projected component in the fit columns —
+    measured directly on a v5e, where the weighted mean of the bf16-fit
+    residual was 5% of the residual RMS instead of ~f32-eps. The (Np,3,3)
+    contractions are a negligible share of the pipeline, so full precision
+    costs nothing and makes the projection exact to f32; downstream this
+    lets ``realize`` skip the redundant weighted-mean ``residualize`` pass
+    after the fit (the constant column absorbs it)."""
     t = batch.toas_s / jnp.maximum(batch.tspan_s[:, None], 1.0)
     M = jnp.stack([jnp.ones_like(t), t, t**2], axis=-1)  # (Np, Nt, 3)
     w = batch.mask / batch.errors_s**2
-    MtWM = jnp.einsum("pni,pn,pnj->pij", M, w, M)
-    MtWr = jnp.einsum("pni,pn,pn->pi", M, w, delays)
+    MtWM = jnp.einsum("pni,pn,pnj->pij", M, w, M, precision="highest")
+    MtWr = jnp.einsum("pni,pn,pn->pi", M, w, delays, precision="highest")
     coef = jnp.linalg.solve(MtWM, MtWr[..., None])[..., 0]
-    return (delays - jnp.einsum("pni,pi->pn", M, coef)) * batch.mask
+    model = jnp.einsum("pni,pi->pn", M, coef, precision="highest")
+    return (delays - model) * batch.mask
 
 
 def design_fit_subtract(delays, batch: PulsarBatch, design, ridge=1e-10):
@@ -865,12 +883,17 @@ def design_fit_subtract(delays, batch: PulsarBatch, design, ridge=1e-10):
     return (delays - model) * batch.mask
 
 
-def fit_subtract(delays, batch: PulsarBatch, recipe: Recipe):
-    """The per-realization refit step: the full-model design fit when the
-    recipe carries a design tensor, else the quadratic (F0/F1-proxy)
-    fit."""
+def finalize_residuals(delays, batch: PulsarBatch, recipe: Recipe, fit: bool):
+    """Fit (when requested) and residualize — the shared tail of every
+    realization pipeline. After the quadratic fit the weighted-mean
+    subtraction of :func:`residualize` is a no-op (the constant column is
+    projected out at full precision — see quadratic_fit_subtract), so it
+    is skipped; the design fit keeps it because an arbitrary design
+    tensor need not span a constant (test_quadratic_fit_projects_mean)."""
+    if not fit:
+        return residualize(delays, batch)
     if recipe.fit_design is not None:
-        return design_fit_subtract(delays, batch, recipe.fit_design)
+        return residualize(design_fit_subtract(delays, batch, recipe.fit_design), batch)
     return quadratic_fit_subtract(delays, batch)
 
 
@@ -942,7 +965,6 @@ def realize(
 
     def one(k):
         d = realization_delays(k, batch, recipe) + static
-        d = fit_subtract(d, batch, recipe) if fit else d
-        return residualize(d, batch)
+        return finalize_residuals(d, batch, recipe, fit)
 
     return jax.vmap(one)(keys)

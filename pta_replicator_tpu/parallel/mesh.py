@@ -29,9 +29,8 @@ from ..batch import PulsarBatch
 from ..models.batched import (
     Recipe,
     deterministic_delays,
-    fit_subtract,
+    finalize_residuals,
     realization_delays,
-    residualize,
 )
 
 
@@ -144,8 +143,7 @@ def _realize_block(
 
     def one(k):
         d = realization_delays(k, batch, recipe, rows=rows) + static
-        d = fit_subtract(d, batch, recipe) if fit else d
-        return residualize(d, batch)
+        return finalize_residuals(d, batch, recipe, fit)
 
     return jax.vmap(one)(keys)
 

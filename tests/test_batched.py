@@ -683,3 +683,44 @@ def test_realize_with_design_fit(batch):
     # bound: ridge (1e-10 relative) + the residualize weighted-mean step
     # between the two applications
     assert float(np.max(np.abs(refit - np.asarray(out)))) < 1e-5 * rms
+
+
+def test_quadratic_fit_projects_mean():
+    """The quad fit's constant column absorbs the weighted mean exactly
+    (its normal equations run at precision='highest' — on TPU the bf16
+    default left a ~5% un-projected component), which is what lets
+    finalize_residuals skip the residualize pass after the quad fit."""
+    from pta_replicator_tpu.batch import synthetic_batch
+
+    batch = synthetic_batch(npsr=6, ntoa=256, nbackend=2, seed=3)
+    recipe = B.Recipe(
+        efac=jnp.ones((6, 2)),
+        rn_log10_amplitude=jnp.full(6, -13.5),
+        rn_gamma=jnp.full(6, 3.0),
+    )
+    d = B.realization_delays(jax.random.PRNGKey(2), batch, recipe)
+    q = B.quadratic_fit_subtract(d, batch)
+    rms = float(jnp.sqrt(jnp.mean(q**2)))
+
+    # weighted mean of the fit residual vanishes...
+    w = batch.mask / batch.errors_s**2
+    mean = np.asarray(jnp.sum(w * q, axis=-1) / jnp.sum(w, axis=-1))
+    assert np.abs(mean).max() < 1e-9 * rms
+
+    # ...so the fit path of finalize_residuals equals fit-then-residualize
+    a = np.asarray(B.finalize_residuals(d, batch, recipe, fit=True))
+    b = np.asarray(B.residualize(q, batch))
+    assert np.abs(a - b).max() < 1e-9 * rms
+
+    # and the design-fit path retains the residualize pass (a design
+    # tensor need not span a constant): fit a pure-slope column and check
+    # the weighted mean is still removed
+    import dataclasses
+
+    design = jnp.stack([batch.toas_s * batch.mask], axis=-1)  # (Np, Nt, 1)
+    r2 = dataclasses.replace(recipe, fit_design=design)
+    out = B.finalize_residuals(d, batch, r2, fit=True)
+    mean2 = np.asarray(
+        jnp.sum(w * out, axis=-1) / jnp.sum(w, axis=-1)
+    )
+    assert np.abs(mean2).max() < 1e-9 * rms
