@@ -99,6 +99,12 @@ def main(argv=None):
     p.add_argument("--checkpoint", default=None,
                    help="resumable sweep checkpoint path (chunked)")
     p.add_argument("--chunk", type=int, default=256)
+    p.add_argument("--write-partim", default=None, metavar="DIR",
+                   help="also materialize realizations as par/tim datasets "
+                        "under DIR/real{r:05d}/ (pre-fit injected delays, "
+                        "same key layout as the residual cube)")
+    p.add_argument("--write-max", type=int, default=16,
+                   help="cap on datasets written by --write-partim")
     for sp in sub.choices.values():
         sp.add_argument(
             "--platform", default=None,
@@ -183,11 +189,30 @@ def main(argv=None):
 
     np.savez(args.out, residuals=out, mask=np.asarray(batch.mask),
              names=np.array(batch.names))
-    print(json.dumps({
+    summary = {
         "out": args.out,
         "shape": list(out.shape),
         "rms_s": float(np.sqrt((out**2).mean())),
-    }))
+    }
+    if args.write_partim:
+        from .utils.export import materialize_realizations, sweep_keys
+
+        # written dataset r must carry the same delays as residual-cube
+        # row r: match the engine's key layout exactly — a checkpointed
+        # sweep consumes fold_in-per-chunk keys, the direct engines
+        # consume split(key, nreal)
+        if args.checkpoint:
+            ks = sweep_keys(key, args.nreal, min(args.chunk, args.nreal))
+        else:
+            ks = jax.random.split(key, args.nreal)
+        dirs = materialize_realizations(
+            psrs, batch, recipe, key,
+            nreal=min(args.nreal, args.write_max),
+            outdir=args.write_partim,
+            keys=ks,
+        )
+        summary["partim_dirs"] = len(dirs)
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

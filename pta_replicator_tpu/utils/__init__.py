@@ -4,6 +4,7 @@ from .cosmology import (
     gw_strain_source,
     m1m2_from_mtmr,
 )
+from .export import materialize_realizations, write_realization_partim
 from .sweep import sweep
 
 __all__ = [
@@ -11,5 +12,7 @@ __all__ = [
     "comoving_distance_cm",
     "gw_strain_source",
     "m1m2_from_mtmr",
+    "materialize_realizations",
     "sweep",
+    "write_realization_partim",
 ]

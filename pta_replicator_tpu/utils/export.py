@@ -1,0 +1,147 @@
+"""Materialize device-path realizations as on-disk par/tim datasets.
+
+The reference's end product is a *mutated dataset* persisted with
+``write_partim`` (/root/reference/pta_replicator/simulate.py:71-77) that
+downstream pipelines (PINT/Tempo2/enterprise) consume. The device path
+produces realization *arrays* at thousands/s; this module closes the loop:
+take the (Np, Nt) pre-fit injected delays of any realization and write a
+complete par/tim dataset per pulsar, using the oracle layer's ledger ->
+adjust -> re-residualize contract, then restore the pulsars bitwise so the
+ingested array stays a reusable clean template.
+
+The written datasets carry the raw injected delays (no device-side fit
+subtraction): like reference datasets, consumers run their own timing fit,
+which absorbs the quadratic component exactly as PINT's would.
+"""
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["write_realization_partim", "materialize_realizations"]
+
+
+def write_realization_partim(
+    psrs,
+    delays,
+    outdir: str,
+    signal_name: str = "device_realization",
+    params: Optional[dict] = None,
+    tempo2: bool = False,
+):
+    """Write one realization's (Np, Nt_max) padded delay array [s] as a
+    par/tim dataset: ``outdir/<psr>.par`` + ``outdir/<psr>.tim``.
+
+    ``psrs`` must be the same (ordered) list the batch was frozen from.
+    Each pulsar is mutated through the standard ``inject`` contract,
+    written, then restored bitwise (TOA epochs are saved and reassigned,
+    not re-adjusted, so repeated materializations cannot accumulate
+    longdouble round-off into the template).
+    """
+    os.makedirs(outdir, exist_ok=True)
+    delays = np.asarray(delays, dtype=np.float64)
+    if delays.ndim != 2 or delays.shape[0] != len(psrs):
+        raise ValueError(
+            f"delays must be (npsr={len(psrs)}, ntoa_max), got {delays.shape}"
+        )
+    for i, psr in enumerate(psrs):
+        n = psr.toas.ntoas
+        d = delays[i, :n]
+        mjd0 = psr.toas.mjd.copy()
+        residuals0 = psr.residuals
+        psr.inject(signal_name, dict(params or {}), d)
+        try:
+            psr.write_partim(
+                os.path.join(outdir, f"{psr.name}.par"),
+                os.path.join(outdir, f"{psr.name}.tim"),
+                tempo2=tempo2,
+            )
+        finally:
+            psr.toas.mjd = mjd0
+            psr.added_signals.pop(signal_name, None)
+            psr.added_signals_time.pop(signal_name, None)
+            psr.residuals = residuals0
+
+
+def sweep_keys(key, nreal: int, chunk: int):
+    """The per-realization PRNG keys a chunked
+    :func:`~pta_replicator_tpu.utils.sweep.sweep` consumes:
+    ``split(fold_in(key, i), chunk)`` per chunk i — a *different* stream
+    than the plain ``realize`` layout ``split(key, nreal)``. Use with
+    ``materialize_realizations(keys=...)`` to write datasets matching a
+    checkpointed sweep's rows."""
+    import jax
+    import jax.numpy as jnp
+
+    if nreal % chunk:
+        raise ValueError(f"nreal={nreal} must be a multiple of chunk={chunk}")
+    return jnp.concatenate(
+        [
+            jax.random.split(jax.random.fold_in(key, i), chunk)
+            for i in range(nreal // chunk)
+        ]
+    )
+
+
+def materialize_realizations(
+    psrs,
+    batch,
+    recipe,
+    key,
+    nreal: int,
+    outdir: str,
+    chunk: int = 16,
+    tempo2: bool = False,
+    static=None,
+    keys=None,
+):
+    """Write ``nreal`` complete datasets: ``outdir/real{r:05d}/<psr>.{par,tim}``.
+
+    Realization r uses ``jax.random.split(key, nreal)[r]`` — the same key
+    layout as :func:`~pta_replicator_tpu.models.batched.realize` (stable
+    under nreal truncation: ``split(key, n)[:m] == split(key, m)`` bitwise
+    for m <= n is NOT guaranteed by jax, so the CLI passes the full-run
+    key count through ``keys`` when it writes fewer datasets than
+    realizations). A checkpointed sweep consumes a different stream —
+    build its layout with :func:`sweep_keys` and pass it via ``keys``.
+    The dataset written for r then carries exactly the injected delays
+    behind row r of the corresponding residual cube (pre-fit). Delays are
+    computed on device in ``chunk``-sized vmapped batches and streamed to
+    disk.
+
+    Returns the list of per-realization directories written.
+    """
+    import jax
+
+    from ..models.batched import realization_delays
+    from ..parallel.mesh import static_delays as _static_delays
+
+    if static is None:
+        static = _static_delays(batch, recipe)
+    if keys is None:
+        keys = jax.random.split(key, nreal)
+    else:
+        if len(keys) < nreal:
+            raise ValueError(f"need >= {nreal} keys, got {len(keys)}")
+        keys = keys[:nreal]
+
+    run = jax.jit(
+        lambda ks, st: jax.vmap(
+            lambda k: realization_delays(k, batch, recipe) + st
+        )(ks)
+    )
+    dirs = []
+    for start in range(0, nreal, chunk):
+        block = np.asarray(run(keys[start : start + chunk], static))
+        for j in range(block.shape[0]):
+            r = start + j
+            rdir = os.path.join(outdir, f"real{r:05d}")
+            write_realization_partim(
+                psrs,
+                block[j],
+                rdir,
+                params={"realization": r},
+                tempo2=tempo2,
+            )
+            dirs.append(rdir)
+    return dirs
