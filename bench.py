@@ -98,6 +98,26 @@ def _stage_breakdown(batch, recipe, nreal: int = 20) -> dict:
     return {name: round(per * 1e3, 4) for name, per in best.items()}
 
 
+def random_cw_catalog(rng, ncw):
+    """(8, ncw) CW-catalog parameter stack in cgw_catalog_delays's
+    positional order: gwtheta, gwphi, mc [Msun], dist [Mpc], fgw [Hz],
+    phase0, psi, inc — realistic SMBHB outlier ranges. The ONE sampler
+    shared by bench.py and every benchmarks/ tool (a drifted copy would
+    silently benchmark a mis-ordered catalog)."""
+    return np.stack(
+        [
+            np.arccos(rng.uniform(-1, 1, ncw)),
+            rng.uniform(0, 2 * np.pi, ncw),
+            10 ** rng.uniform(8, 9.5, ncw),
+            rng.uniform(50, 1000, ncw),
+            10 ** rng.uniform(-8.8, -7.6, ncw),
+            rng.uniform(0, 2 * np.pi, ncw),
+            rng.uniform(0, np.pi, ncw),
+            np.arccos(rng.uniform(-1, 1, ncw)),
+        ]
+    )
+
+
 def build_workload(npsr=68, ntoa=7758, nbackend=4, ncw=100):
     """The canonical bench workload: NG15-scale synthetic batch + full
     recipe (per-backend EFAC/EQUAD/ECORR, 30-mode RN, HD GWB, 100-source
@@ -117,18 +137,7 @@ def build_workload(npsr=68, ntoa=7758, nbackend=4, ncw=100):
         axis=1,
     )
     orf = hellings_downs_matrix(locs)
-    cat = np.stack(
-        [
-            np.arccos(rng.uniform(-1, 1, ncw)),
-            rng.uniform(0, 2 * np.pi, ncw),
-            10 ** rng.uniform(8, 9.5, ncw),
-            rng.uniform(50, 1000, ncw),
-            10 ** rng.uniform(-8.8, -7.6, ncw),
-            rng.uniform(0, 2 * np.pi, ncw),
-            rng.uniform(0, np.pi, ncw),
-            np.arccos(rng.uniform(-1, 1, ncw)),
-        ]
-    )
+    cat = random_cw_catalog(rng, ncw)
     recipe = Recipe(
         efac=jnp.asarray(rng.uniform(0.9, 1.3, (npsr, nbackend))),
         log10_equad=jnp.asarray(rng.uniform(-7.5, -6.0, (npsr, nbackend))),

@@ -1,0 +1,94 @@
+"""CW-catalog scaling: source-count ladder through the tiled backends.
+
+The reference handles large CW catalogs with a numba prange over sources
+plus 1e7-source python chunking (/root/reference/pta_replicator/
+deterministic.py:258-294) — its one genuine memory-tiling strategy. The
+device path tiles the (Nsrc x Ntoa) product through ``lax.scan`` source
+tiles (or the Pallas kernel) with a bounded (chunk x Ntoa) workspace.
+This tool measures the one-time catalog cost across an Nsrc ladder and
+reports per-(source x TOA) throughput, so the tiling's linear scaling is
+recorded evidence rather than a claim.
+
+Usage: python benchmarks/cw_scaling.py [max_exp] [backend]
+  max_exp: ladder goes 10^2 .. 10^max_exp sources (default 5)
+  backend: scan | pallas | both (default scan; pallas needs a real TPU)
+Prints one JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    max_exp = int(sys.argv[1]) if len(sys.argv) > 1 else 5
+    backend_arg = sys.argv[2] if len(sys.argv) > 2 else "scan"
+
+    import jax
+
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+
+    from bench import random_cw_catalog
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+
+    npsr, ntoa = 68, 7758
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=4, seed=0)
+    rng = np.random.default_rng(1)
+
+    def catalog(n):
+        return [jnp.asarray(row) for row in random_cw_catalog(rng, n)]
+
+    backends = ["scan", "pallas"] if backend_arg == "both" else [backend_arg]
+    ladder = [10**e for e in range(2, max_exp + 1)]
+    out = {
+        "device": jax.devices()[0].device_kind,
+        "npsr": npsr,
+        "ntoa": ntoa,
+        "chunk": 1024,
+        "results": {},
+    }
+    for backend in backends:
+        rows = {}
+        for n in ladder:
+            args = catalog(n)
+            try:
+                fn = jax.jit(
+                    lambda eps, args=args: B.cgw_catalog_delays(
+                        batch, *args, chunk=1024, backend=backend
+                    )
+                    + eps
+                )
+                zero = jnp.zeros((), batch.toas_s.dtype)
+                np.asarray(fn(zero))  # compile + run once
+                t0 = time.perf_counter()
+                np.asarray(fn(zero))
+                t1 = time.perf_counter() - t0
+                # target ~1s of measurement per rung, 50 reps max
+                reps = max(1, min(50, int(1.0 / max(t1, 1e-4))))
+                best = np.inf
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    for _ in range(reps):
+                        r = fn(zero)
+                    np.asarray(r)  # host readback fences the queue
+                    best = min(best, (time.perf_counter() - t0) / reps)
+                rows[str(n)] = {
+                    "seconds": round(best, 4),
+                    "gsrc_toa_per_s": round(n * ntoa * npsr / best / 1e9, 2),
+                }
+            except Exception as exc:
+                rows[str(n)] = {"error": repr(exc)[:200]}
+        out["results"][backend] = rows
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,9 +9,13 @@ Usage:  python benchmarks/profile_stages.py [--nreal 20] [--small]
 """
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -54,12 +58,9 @@ def main():
          np.arccos(np.clip(phat[:, 2], -1, 1))], axis=1,
     )
     M = jnp.asarray(np.linalg.cholesky(hellings_downs_matrix(locs)))
-    cat = jnp.asarray(np.stack([
-        np.arccos(rng.uniform(-1, 1, ncw)), rng.uniform(0, 2 * np.pi, ncw),
-        10 ** rng.uniform(8, 9.5, ncw), rng.uniform(50, 1000, ncw),
-        10 ** rng.uniform(-8.8, -7.6, ncw), rng.uniform(0, 2 * np.pi, ncw),
-        rng.uniform(0, np.pi, ncw), np.arccos(rng.uniform(-1, 1, ncw)),
-    ]))
+    from bench import random_cw_catalog
+
+    cat = jnp.asarray(random_cw_catalog(rng, ncw))
     recipe = B.Recipe(
         efac=jnp.asarray(1.1),
         log10_equad=jnp.asarray(-6.5),

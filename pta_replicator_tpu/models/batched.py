@@ -865,7 +865,13 @@ def design_fit_subtract(delays, batch: PulsarBatch, design, ridge=1e-10):
     zero_col = norms == 0.0  # padding columns
     norms = jnp.where(zero_col, 1.0, norms)
     Mn = Mw / norms[:, None, :]
-    A = jnp.einsum("pnk,pnl->pkl", Mn, Mn)
+    # precision='highest' on every contraction: the TPU bf16 matmul
+    # default puts ~1e-2 relative error on Gram entries, which the
+    # (already squared) condition number amplifies into a visibly wrong
+    # projector — same failure class measured on the quadratic fit
+    # (quadratic_fit_subtract docstring); these einsums are a small share
+    # of the realization pipeline even at full precision
+    A = jnp.einsum("pnk,pnl->pkl", Mn, Mn, precision="highest")
     # all-zero padding columns get a unit diagonal and a zero rhs, so
     # their coefficients solve to exactly 0
     K = design.shape[-1]
@@ -875,9 +881,9 @@ def design_fit_subtract(delays, batch: PulsarBatch, design, ridge=1e-10):
     # .solve would silently return NaN for the whole pulsar; the ridge
     # turns that into a deterministic even split at ~1e-10 relative cost
     A = A + ridge * jnp.eye(K, dtype=dtype)
-    b = jnp.einsum("pnk,pn->pk", Mn, delays * w)
+    b = jnp.einsum("pnk,pn->pk", Mn, delays * w, precision="highest")
     coef = jnp.linalg.solve(A, b[..., None])[..., 0]
-    model = jnp.einsum("pnk,pk->pn", Mn, coef) / jnp.where(
+    model = jnp.einsum("pnk,pk->pn", Mn, coef, precision="highest") / jnp.where(
         jnp.abs(w) > 0, w, 1.0
     )
     return (delays - model) * batch.mask
