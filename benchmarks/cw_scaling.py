@@ -52,17 +52,22 @@ def main():
         "device": jax.devices()[0].device_kind,
         "npsr": npsr,
         "ntoa": ntoa,
-        "chunk": 1024,
+        "chunk": "min(1024, n)",
         "results": {},
     }
     for backend in backends:
         rows = {}
         for n in ladder:
             args = catalog(n)
+            # sub-chunk rungs must not pad up to a full tile (the scan
+            # pads Nsrc to a chunk multiple — a 100-source rung timed at
+            # chunk=1024 measures 1024 padded sources, faking a 10x
+            # throughput jump between rungs)
+            chunk = min(1024, n)
             try:
                 fn = jax.jit(
-                    lambda eps, args=args: B.cgw_catalog_delays(
-                        batch, *args, chunk=1024, backend=backend
+                    lambda eps, args=args, chunk=chunk: B.cgw_catalog_delays(
+                        batch, *args, chunk=chunk, backend=backend
                     )
                     + eps
                 )

@@ -21,7 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from ..batch import PulsarBatch
-from ..constants import YEAR_IN_SEC
 from .cgw import principal_axes
 from .gwb import (
     characteristic_strain,

@@ -20,7 +20,6 @@ import numpy as np
 from ..constants import DAY_IN_SEC
 from ..ops.coords import pulsar_ra_dec
 from ..ops.orf import assemble_orf
-from ..simulate import SimulatedPulsar
 
 
 # ----------------------------------------------------------------- pure math

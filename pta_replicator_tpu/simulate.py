@@ -21,7 +21,7 @@ import numpy as np
 
 from .io.par import ParModel, read_par
 from .io.tim import TOAData, fabricate_toas, read_tim, write_tim
-from .timing.model import SpindownTiming, TimingModel, phase_residuals, weighted_mean
+from .timing.model import SpindownTiming, TimingModel, phase_residuals
 from .timing.fit import design_matrix, wls_fit, gls_fit
 from .constants import DAY_IN_SEC
 

@@ -60,7 +60,6 @@ def injection_stage_fns(batch, recipe) -> dict:
     ``0.0 * ks[0, 0]`` term keeps XLA from constant-folding it.
     """
     import jax
-    import jax.numpy as jnp
 
     from ..models import batched as B
 
