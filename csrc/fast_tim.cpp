@@ -142,4 +142,40 @@ int64_t fast_tim_parse(const char* path, int64_t n, int64_t* mjd_day,
     return i;
 }
 
+// Fast FORMAT-1 writer — the egress mirror of the parser above. The
+// dataset-materialization path (utils/export.py) writes thousands of
+// tim files whose per-TOA text is identical across realizations except
+// the epoch; Python-side dragon4 formatting dominated at ~45 ms per
+// 7.7k-TOA pulsar. The caller passes the realization-invariant line
+// parts as text records "prefix\x1fsuffix\n" (prefix = " label freq",
+// suffix = "err obs flags") plus the epoch split as integer MJD day and
+// 1e-15-day fraction (86 ps resolution, beyond the ~ns tim files carry).
+// Returns n, or a negative error code.
+int64_t fast_tim_write(const char* path, int64_t n, const int64_t* mjd_day,
+                       const int64_t* frac15, const char* text) {
+    FILE* f = fopen(path, "w");
+    if (!f) return ERR_OPEN;
+    // every stdio result is checked: a full disk (ENOSPC) must surface
+    // as an error, not a silently truncated tim file
+    bool ok = fputs("FORMAT 1\nMODE 1\n", f) >= 0;
+    const char* p = text;
+    for (int64_t i = 0; ok && i < n; ++i) {
+        const char* sep = strchr(p, '\x1f');
+        const char* end = strchr(p, '\n');
+        if (!sep || !end || sep > end) {
+            fclose(f);
+            return ERR_TEXT_OVERFLOW;
+        }
+        const size_t pre = static_cast<size_t>(sep - p);
+        const size_t suf = static_cast<size_t>(end - sep - 1);
+        ok = fwrite(p, 1, pre, f) == pre &&
+             fprintf(f, " %lld.%015lld ", static_cast<long long>(mjd_day[i]),
+                     static_cast<long long>(frac15[i])) > 0 &&
+             fwrite(sep + 1, 1, suf, f) == suf && fputc('\n', f) != EOF;
+        p = end + 1;
+    }
+    if (fclose(f) != 0) ok = false;  // flush of buffered data can fail too
+    return ok ? n : ERR_OPEN;
+}
+
 }  // extern "C"

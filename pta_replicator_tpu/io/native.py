@@ -20,6 +20,7 @@ import numpy as np
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+_HAS_WRITE = False
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "csrc", "fast_tim.cpp")
@@ -66,6 +67,21 @@ def load_library() -> Optional[ctypes.CDLL]:
                 ctypes.c_char_p,
                 ctypes.c_int64,
             ]
+            # the writer symbol is newer than the reader: a stale cached
+            # .so without it must not disable the working read fast path
+            global _HAS_WRITE
+            try:
+                lib.fast_tim_write.restype = ctypes.c_int64
+                lib.fast_tim_write.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_int64,
+                    np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                    np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                    ctypes.c_char_p,
+                ]
+                _HAS_WRITE = True
+            except AttributeError:
+                _HAS_WRITE = False
             _LIB = lib
         except Exception as err:  # toolchain missing, compile failure, ...
             print(f"pta_replicator_tpu: native IO unavailable ({err}); "
@@ -112,3 +128,24 @@ def fast_read_tim(path: str):
         obs.append(parts[1] if len(parts) > 1 else "")
         flag_strs.append(parts[2] if len(parts) > 2 else "")
     return mjd, err_us * 1e-6, freq, labels, obs, flag_strs
+
+
+def fast_write_tim(path: str, mjd_day, frac15, text: bytes) -> bool:
+    """Write a FORMAT-1 tim file natively from the split epoch arrays and
+    the pre-rendered static line parts (io.tim builds them). Returns
+    False when the native writer is unavailable (caller falls back to
+    the Python writer); raises OSError when the write itself fails
+    (e.g. disk full) — a failed write must never look like a success."""
+    lib = load_library()
+    if lib is None or not _HAS_WRITE:
+        return False
+    n = len(mjd_day)
+    got = lib.fast_tim_write(
+        path.encode(), n,
+        np.ascontiguousarray(mjd_day, dtype=np.int64),
+        np.ascontiguousarray(frac15, dtype=np.int64),
+        text,
+    )
+    if got != n:
+        raise OSError(f"native tim write failed for {path} (code {got})")
+    return True

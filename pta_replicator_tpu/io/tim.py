@@ -228,26 +228,79 @@ def read_tim(path: str, use_native: bool = True) -> TOAData:
     )
 
 
-def write_tim(toas: TOAData, path: str, name: Optional[str] = None) -> None:
+def _static_line_parts(
+    toas: TOAData, name: Optional[str], reuse_cache: bool = False
+) -> bytes:
+    """Pre-rendered epoch-invariant parts of every tim line, as the
+    ``"prefix\\x1fsuffix\\n"`` record stream the native writer consumes
+    (prefix = " label freq", suffix = "err obs flags").
+
+    ``reuse_cache`` is an *opt-in* contract for callers that rewrite the
+    same TOAs with only the epochs changed (the dataset-materialization
+    sweep, utils/export.py, where rendering these parts — flag joins +
+    float formatting — was ~70% of the write cost). Default off: plain
+    ``write_tim`` callers may mutate flag/error/label elements in place
+    between writes, which no cheap cache key can detect."""
+    cached = getattr(toas, "_write_parts_cache", None)
+    if reuse_cache and cached is not None and cached[0] == (name, toas.ntoas):
+        return cached[1]
+    recs = []
+    for i in range(toas.ntoas):
+        label = name or (toas.labels[i] if toas.labels else "toa")
+        flag_str = "".join(
+            f" -{k} {v}" for k, v in (toas.flags[i] if toas.flags else {}).items()
+        )
+        recs.append(
+            f" {label} {toas.freqs_mhz[i]:.8f}\x1f"
+            f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}"
+        )
+    text = ("\n".join(recs) + "\n").encode()
+    if reuse_cache:
+        toas._write_parts_cache = ((name, toas.ntoas), text)
+    return text
+
+
+def _mjd_day_frac15(mjd):
+    """Split longdouble MJD epochs into (int day, int 1e-15-day fraction)
+    — 86 ps resolution, exact to carry."""
+    day = np.floor(mjd).astype(np.int64)
+    frac = (mjd - day.astype(np.longdouble)) * np.longdouble(1e15)
+    f15 = np.rint(frac).astype(np.int64)
+    carry = f15 >= 10**15
+    return day + carry, np.where(carry, 0, f15)
+
+
+def write_tim(
+    toas: TOAData,
+    path: str,
+    name: Optional[str] = None,
+    reuse_static_parts: bool = False,
+) -> None:
     """Serialize TOAs back to a Tempo2 ``FORMAT 1`` tim file.
 
     Reference analog: ``toas.write_TOA_file(outtim, format='Tempo2')``
-    (/root/reference/pta_replicator/simulate.py:75).
+    (/root/reference/pta_replicator/simulate.py:75). Uses the native
+    (C++) writer when available — the egress mirror of the parse fast
+    path — falling back to pure Python; both emit epochs at fixed
+    15-decimal (86 ps) precision. ``reuse_static_parts``: opt-in cache of
+    the epoch-invariant line parts for callers that guarantee only the
+    epochs change between writes (see _static_line_parts).
     """
+    from .native import fast_write_tim
+
+    text = _static_line_parts(toas, name, reuse_cache=reuse_static_parts)
+    day, f15 = _mjd_day_frac15(toas.mjd)
+    if fast_write_tim(path, day, f15, text):
+        return
     with open(path, "w") as fh:
         fh.write("FORMAT 1\nMODE 1\n")
-        for i in range(toas.ntoas):
-            label = name or (toas.labels[i] if toas.labels else "toa")
-            flag_str = "".join(
-                f" -{k} {v}" for k, v in (toas.flags[i] if toas.flags else {}).items()
+        fh.writelines(
+            f"{rec[0]} {d}.{f:015d} {rec[2]}\n"
+            for rec, d, f in zip(
+                (r.partition("\x1f") for r in text.decode()[:-1].split("\n")),
+                day, f15,
             )
-            mjd_str = np.format_float_positional(
-                toas.mjd[i], precision=17, unique=False, trim="k"
-            )
-            fh.write(
-                f" {label} {toas.freqs_mhz[i]:.8f} {mjd_str} "
-                f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}\n"
-            )
+        )
 
 
 def fabricate_toas(

@@ -256,15 +256,23 @@ class SimulatedPulsar:
         else:
             self.model = new_spin
 
-    def write_partim(self, outpar: str, outtim: str, tempo2: bool = False) -> None:
+    def write_partim(
+        self,
+        outpar: str,
+        outtim: str,
+        tempo2: bool = False,
+        reuse_static_tim_parts: bool = False,
+    ) -> None:
         """Persist the mutated dataset (reference analog simulate.py:71-77).
 
         ``tempo2`` is accepted for reference API compatibility; this
         framework's tim writer always emits Tempo2 ``FORMAT 1``, which both
-        PINT and Tempo2 read.
+        PINT and Tempo2 read. ``reuse_static_tim_parts`` opts into the tim
+        writer's epoch-invariant line cache (materialization sweeps —
+        see io.tim.write_tim).
         """
         self.par.write(outpar)
-        write_tim(self.toas, outtim)
+        write_tim(self.toas, outtim, reuse_static_parts=reuse_static_tim_parts)
 
     def to_arrays(self):
         """Export (mjd_f64, residuals_s, errors_s, loc) for downstream

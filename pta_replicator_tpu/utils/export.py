@@ -5,16 +5,15 @@ The reference's end product is a *mutated dataset* persisted with
 downstream pipelines (PINT/Tempo2/enterprise) consume. The device path
 produces realization *arrays* at thousands/s; this module closes the loop:
 take the (Np, Nt) pre-fit injected delays of any realization and write a
-complete par/tim dataset per pulsar, using the oracle layer's ledger ->
-adjust -> re-residualize contract, then restore the pulsars bitwise so the
-ingested array stays a reusable clean template.
+complete par/tim dataset per pulsar via the ``adjust_seconds`` injection
+primitive, then restore the pulsars bitwise so the ingested array stays a
+reusable clean template.
 
 The written datasets carry the raw injected delays (no device-side fit
 subtraction): like reference datasets, consumers run their own timing fit,
 which absorbs the quadratic component exactly as PINT's would.
 """
 import os
-from typing import Optional
 
 import numpy as np
 
@@ -25,18 +24,20 @@ def write_realization_partim(
     psrs,
     delays,
     outdir: str,
-    signal_name: str = "device_realization",
-    params: Optional[dict] = None,
     tempo2: bool = False,
 ):
     """Write one realization's (Np, Nt_max) padded delay array [s] as a
     par/tim dataset: ``outdir/<psr>.par`` + ``outdir/<psr>.tim``.
 
     ``psrs`` must be the same (ordered) list the batch was frozen from.
-    Each pulsar is mutated through the standard ``inject`` contract,
-    written, then restored bitwise (TOA epochs are saved and reassigned,
-    not re-adjusted, so repeated materializations cannot accumulate
-    longdouble round-off into the template).
+    Each pulsar's TOA epochs are shifted by its delay row (the
+    ``adjust_seconds`` injection primitive), written, then restored
+    bitwise (epochs are saved and reassigned, not re-adjusted, so
+    repeated materializations cannot accumulate longdouble round-off
+    into the template). Residuals and the in-memory ledger are left
+    untouched — neither is serialized into par/tim, and recomputing
+    residuals per write would triple the cost of a materialization
+    sweep; callers wanting an in-memory record use ``psr.inject``.
     """
     os.makedirs(outdir, exist_ok=True)
     delays = np.asarray(delays, dtype=np.float64)
@@ -48,19 +49,18 @@ def write_realization_partim(
         n = psr.toas.ntoas
         d = delays[i, :n]
         mjd0 = psr.toas.mjd.copy()
-        residuals0 = psr.residuals
-        psr.inject(signal_name, dict(params or {}), d)
+        psr.toas.adjust_seconds(d)
         try:
             psr.write_partim(
                 os.path.join(outdir, f"{psr.name}.par"),
                 os.path.join(outdir, f"{psr.name}.tim"),
                 tempo2=tempo2,
+                # only the epochs change between realizations, which is
+                # exactly the tim writer's static-parts cache contract
+                reuse_static_tim_parts=True,
             )
         finally:
             psr.toas.mjd = mjd0
-            psr.added_signals.pop(signal_name, None)
-            psr.added_signals_time.pop(signal_name, None)
-            psr.residuals = residuals0
 
 
 def sweep_keys(key, nreal: int, chunk: int):
@@ -136,12 +136,6 @@ def materialize_realizations(
         for j in range(block.shape[0]):
             r = start + j
             rdir = os.path.join(outdir, f"real{r:05d}")
-            write_realization_partim(
-                psrs,
-                block[j],
-                rdir,
-                params={"realization": r},
-                tempo2=tempo2,
-            )
+            write_realization_partim(psrs, block[j], rdir, tempo2=tempo2)
             dirs.append(rdir)
     return dirs
