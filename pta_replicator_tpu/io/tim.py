@@ -230,10 +230,11 @@ def read_tim(path: str, use_native: bool = True) -> TOAData:
 
 def _static_line_parts(
     toas: TOAData, name: Optional[str], reuse_cache: bool = False
-) -> bytes:
-    """Pre-rendered epoch-invariant parts of every tim line, as the
-    ``"prefix\\x1fsuffix\\n"`` record stream the native writer consumes
-    (prefix = " label freq", suffix = "err obs flags").
+):
+    """Pre-rendered epoch-invariant parts of every tim line: a list of
+    ``(prefix, suffix)`` pairs (prefix = " label freq", suffix =
+    "err obs flags") plus the ``"prefix\\x1fsuffix\\n"`` byte stream the
+    native writer consumes. Returns ``(pairs, stream_bytes)``.
 
     ``reuse_cache`` is an *opt-in* contract for callers that rewrite the
     same TOAs with only the epochs changed (the dataset-materialization
@@ -244,20 +245,21 @@ def _static_line_parts(
     cached = getattr(toas, "_write_parts_cache", None)
     if reuse_cache and cached is not None and cached[0] == (name, toas.ntoas):
         return cached[1]
-    recs = []
+    pairs = []
     for i in range(toas.ntoas):
         label = name or (toas.labels[i] if toas.labels else "toa")
         flag_str = "".join(
             f" -{k} {v}" for k, v in (toas.flags[i] if toas.flags else {}).items()
         )
-        recs.append(
-            f" {label} {toas.freqs_mhz[i]:.8f}\x1f"
-            f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}"
-        )
-    text = ("\n".join(recs) + "\n").encode()
+        pairs.append((
+            f" {label} {toas.freqs_mhz[i]:.8f}",
+            f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}",
+        ))
+    text = "".join(f"{p}\x1f{s}\n" for p, s in pairs).encode()
+    parts = (pairs, text)
     if reuse_cache:
-        toas._write_parts_cache = ((name, toas.ntoas), text)
-    return text
+        toas._write_parts_cache = ((name, toas.ntoas), parts)
+    return parts
 
 
 def _mjd_day_frac15(mjd):
@@ -288,18 +290,19 @@ def write_tim(
     """
     from .native import fast_write_tim
 
-    text = _static_line_parts(toas, name, reuse_cache=reuse_static_parts)
+    if toas.ntoas == 0:  # empty set: a valid header-only file
+        with open(path, "w") as fh:
+            fh.write("FORMAT 1\nMODE 1\n")
+        return
+    pairs, text = _static_line_parts(toas, name, reuse_cache=reuse_static_parts)
     day, f15 = _mjd_day_frac15(toas.mjd)
     if fast_write_tim(path, day, f15, text):
         return
     with open(path, "w") as fh:
         fh.write("FORMAT 1\nMODE 1\n")
         fh.writelines(
-            f"{rec[0]} {d}.{f:015d} {rec[2]}\n"
-            for rec, d, f in zip(
-                (r.partition("\x1f") for r in text.decode()[:-1].split("\n")),
-                day, f15,
-            )
+            f"{pre} {d}.{f:015d} {suf}\n"
+            for (pre, suf), d, f in zip(pairs, day, f15)
         )
 
 
