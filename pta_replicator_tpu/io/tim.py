@@ -234,7 +234,8 @@ def _static_line_parts(
     """Pre-rendered epoch-invariant parts of every tim line: a list of
     ``(prefix, suffix)`` pairs (prefix = " label freq", suffix =
     "err obs flags") plus the ``"prefix\\x1fsuffix\\n"`` byte stream the
-    native writer consumes. Returns ``(pairs, stream_bytes)``.
+    native writer consumes. Returns ``(pairs, stream_bytes)``; ``pairs``
+    is None on a cache hit (only the bytes are retained).
 
     ``reuse_cache`` is an *opt-in* contract for callers that rewrite the
     same TOAs with only the epochs changed (the dataset-materialization
@@ -244,7 +245,10 @@ def _static_line_parts(
     between writes, which no cheap cache key can detect."""
     cached = getattr(toas, "_write_parts_cache", None)
     if reuse_cache and cached is not None and cached[0] == (name, toas.ntoas):
-        return cached[1]
+        # only the byte stream is cached (the common native-writer path
+        # consumes nothing else); pairs are rebuilt on the rare
+        # no-native-toolchain fallback
+        return None, cached[1]
     pairs = []
     for i in range(toas.ntoas):
         label = name or (toas.labels[i] if toas.labels else "toa")
@@ -256,10 +260,9 @@ def _static_line_parts(
             f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}",
         ))
     text = "".join(f"{p}\x1f{s}\n" for p, s in pairs).encode()
-    parts = (pairs, text)
     if reuse_cache:
-        toas._write_parts_cache = ((name, toas.ntoas), parts)
-    return parts
+        toas._write_parts_cache = ((name, toas.ntoas), text)
+    return pairs, text
 
 
 def _mjd_day_frac15(mjd):
@@ -298,6 +301,8 @@ def write_tim(
     day, f15 = _mjd_day_frac15(toas.mjd)
     if fast_write_tim(path, day, f15, text):
         return
+    if pairs is None:  # cache hit (bytes only) but no native writer
+        pairs, _ = _static_line_parts(toas, name)
     with open(path, "w") as fh:
         fh.write("FORMAT 1\nMODE 1\n")
         fh.writelines(

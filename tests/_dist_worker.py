@@ -15,12 +15,53 @@ replaced by localhost GRPC.
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-os.environ["JAX_PLATFORMS"] = "cpu"
+
+def build_workload():
+    """The SPMD workload every process (and the parent's single-process
+    reference) builds identically: the small_setup array plus a CW
+    catalog, so the psr-sharded mesh also exercises the precomputed
+    static-delay path under real multi-process execution."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.ops.orf import hellings_downs_matrix
+
+    batch = synthetic_batch(npsr=4, ntoa=64, nbackend=2, seed=1)
+    phat = np.asarray(batch.phat)
+    locs = np.stack(
+        [np.arctan2(phat[:, 1], phat[:, 0]), np.arccos(phat[:, 2])], axis=1
+    )
+    orf = hellings_downs_matrix(locs)
+    rng = np.random.default_rng(3)
+    ncw = 6
+    cat = jnp.asarray(np.stack([
+        np.arccos(rng.uniform(-1, 1, ncw)), rng.uniform(0, 2 * np.pi, ncw),
+        10 ** rng.uniform(8, 9.3, ncw), rng.uniform(50, 900, ncw),
+        10 ** rng.uniform(-8.6, -7.8, ncw), rng.uniform(0, 2 * np.pi, ncw),
+        rng.uniform(0, np.pi, ncw), np.arccos(rng.uniform(-1, 1, ncw)),
+    ]))
+    recipe = B.Recipe(
+        efac=jnp.ones((4, 2)),
+        log10_equad=jnp.full((4, 2), -6.3),
+        log10_ecorr=jnp.full((4, 2), -6.5),
+        rn_log10_amplitude=jnp.full(4, -14.0),
+        rn_gamma=jnp.full(4, 4.33),
+        gwb_log10_amplitude=jnp.asarray(-14.0),
+        gwb_gamma=jnp.asarray(4.33),
+        orf_cholesky=jnp.asarray(np.linalg.cholesky(np.asarray(orf))),
+        gwb_npts=100,
+        gwb_howml=4.0,
+        cgw_params=cat,
+        cgw_chunk=4,
+    )
+    return batch, recipe
 
 
 def main():
     port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    n_psr = int(sys.argv[4]) if len(sys.argv) > 4 else 1
 
     import jax
 
@@ -28,11 +69,7 @@ def main():
     jax.config.update("jax_enable_x64", True)
 
     import numpy as np
-    import jax.numpy as jnp
 
-    from pta_replicator_tpu.batch import synthetic_batch
-    from pta_replicator_tpu.models import batched as B
-    from pta_replicator_tpu.ops.orf import hellings_downs_matrix
     from pta_replicator_tpu.parallel import (
         distributed,
         make_mesh,
@@ -48,28 +85,10 @@ def main():
     assert topo["local_device_count"] == 4, topo
     assert topo["global_device_count"] == 8, topo
 
-    # identical workload on every process (the SPMD contract), mirroring
-    # test_sharding.small_setup
-    batch = synthetic_batch(npsr=4, ntoa=64, nbackend=2, seed=1)
-    phat = np.asarray(batch.phat)
-    locs = np.stack(
-        [np.arctan2(phat[:, 1], phat[:, 0]), np.arccos(phat[:, 2])], axis=1
-    )
-    orf = hellings_downs_matrix(locs)
-    recipe = B.Recipe(
-        efac=jnp.ones((4, 2)),
-        log10_equad=jnp.full((4, 2), -6.3),
-        log10_ecorr=jnp.full((4, 2), -6.5),
-        rn_log10_amplitude=jnp.full(4, -14.0),
-        rn_gamma=jnp.full(4, 4.33),
-        gwb_log10_amplitude=jnp.asarray(-14.0),
-        gwb_gamma=jnp.asarray(4.33),
-        orf_cholesky=jnp.asarray(np.linalg.cholesky(np.asarray(orf))),
-        gwb_npts=100,
-        gwb_howml=4.0,
-    )
+    # identical workload on every process (the SPMD contract)
+    batch, recipe = build_workload()
 
-    mesh = make_mesh(8, 1)
+    mesh = make_mesh(8 // n_psr, n_psr)
     out = shardmap_realize(
         jax.random.PRNGKey(9), batch, recipe, nreal=16, mesh=mesh, fit=True
     )
@@ -81,8 +100,14 @@ def main():
         local_device_count=topo["local_device_count"],
         global_device_count=topo["global_device_count"],
     )
-    print(f"worker {pid}: local block {local.shape} saved", flush=True)
+    print(f"worker {pid}: mesh ({8 // n_psr},{n_psr}) local block "
+          f"{local.shape} saved", flush=True)
 
 
 if __name__ == "__main__":
+    # env must be set before the first jax import IN THE WORKER ONLY:
+    # at module level these would leak into the pytest process when the
+    # parent imports build_workload, clobbering conftest's 8-device setup
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
     main()

@@ -15,7 +15,6 @@ import sys
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 import pytest
 
 from pta_replicator_tpu.models import batched as B
@@ -27,10 +26,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_shardmap_matches_single_process(small_setup, tmp_path):
+@pytest.mark.parametrize("n_psr", [1, 2])
+def test_two_process_shardmap_matches_single_process(n_psr, tmp_path):
     """2 processes x 4 virtual CPU devices run shardmap_realize over the
-    joint 8-device mesh; each host's local block must equal its slice of
-    the single-process realization array."""
+    joint 8-device mesh — realization-only (8,1) and pulsar-sharded (4,2)
+    — and each host's local block must equal its slice of the
+    single-process realization array (local_realizations stitches the
+    psr axis back together)."""
     port = _free_port()
     outs = [tmp_path / f"w{i}.npz" for i in range(2)]
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -48,6 +50,7 @@ def test_two_process_shardmap_matches_single_process(small_setup, tmp_path):
                 str(port),
                 str(i),
                 str(outs[i]),
+                str(n_psr),
             ],
             env=env,
             stdout=subprocess.PIPE,
@@ -68,8 +71,10 @@ def test_two_process_shardmap_matches_single_process(small_setup, tmp_path):
     for i, w in enumerate(workers):
         assert w.returncode == 0, f"worker {i} failed:\n{logs[i][-2000:]}"
 
-    # single-process reference: same key, same workload
-    batch, recipe = small_setup
+    # single-process reference: same key, same workload (shared builder)
+    import _dist_worker as DW
+
+    batch, recipe = DW.build_workload()
     ref = np.asarray(
         B.realize(jax.random.PRNGKey(9), batch, recipe, nreal=16, fit=True)
     )
@@ -80,7 +85,9 @@ def test_two_process_shardmap_matches_single_process(small_setup, tmp_path):
         local = data["local"]
         pid = int(data["process_index"])
         assert int(data["global_device_count"]) == 8
-        # mesh ('real'=8): keys 2 per device, devices 0-3 on process 0
+        # process 0 owns devices 0-3: the first half of the 'real' axis
+        # whether the mesh is (8,1) or (4,2); local_realizations stitches
+        # the psr columns, so each local block spans the full pulsar axis
         lo = pid * 8
         np.testing.assert_allclose(
             local,
@@ -90,29 +97,3 @@ def test_two_process_shardmap_matches_single_process(small_setup, tmp_path):
         )
         seen[lo : lo + 8] = True
     assert seen.all(), "the two hosts' blocks must tile all realizations"
-
-
-@pytest.fixture(scope="module")
-def small_setup():
-    from pta_replicator_tpu.batch import synthetic_batch
-    from pta_replicator_tpu.ops.orf import hellings_downs_matrix
-
-    batch = synthetic_batch(npsr=4, ntoa=64, nbackend=2, seed=1)
-    phat = np.asarray(batch.phat)
-    locs = np.stack(
-        [np.arctan2(phat[:, 1], phat[:, 0]), np.arccos(phat[:, 2])], axis=1
-    )
-    orf = hellings_downs_matrix(locs)
-    recipe = B.Recipe(
-        efac=jnp.ones((4, 2)),
-        log10_equad=jnp.full((4, 2), -6.3),
-        log10_ecorr=jnp.full((4, 2), -6.5),
-        rn_log10_amplitude=jnp.full(4, -14.0),
-        rn_gamma=jnp.full(4, 4.33),
-        gwb_log10_amplitude=jnp.asarray(-14.0),
-        gwb_gamma=jnp.asarray(4.33),
-        orf_cholesky=jnp.asarray(np.linalg.cholesky(np.asarray(orf))),
-        gwb_npts=100,
-        gwb_howml=4.0,
-    )
-    return batch, recipe

@@ -157,10 +157,15 @@ def test_write_tim_roundtrip_real_b1855():
         assert back.flags[-1] == psr.toas.flags[-1]
         assert back.observatories == psr.toas.observatories
 
-        # epoch-only rewrite with the opt-in static cache: bitwise-equal
-        # file to a cache-off write of the same state
+        # epoch-only rewrite through an actual cache HIT: populate the
+        # static-parts cache, shift the epochs, write again with the
+        # cache, and compare against a cache-off write of the same state
+        write_tim(psr.toas, os.path.join(d, "warm.tim"),
+                  reuse_static_parts=True)
         psr.toas.adjust_seconds(np.full(psr.toas.ntoas, 1.7e-6))
         p2, p3 = os.path.join(d, "c_on.tim"), os.path.join(d, "c_off.tim")
         write_tim(psr.toas, p2, reuse_static_parts=True)
         write_tim(psr.toas, p3)
         assert open(p2, "rb").read() == open(p3, "rb").read()
+        assert open(p2, "rb").read() != open(
+            os.path.join(d, "warm.tim"), "rb").read()  # epochs did change
