@@ -229,13 +229,18 @@ def read_tim(path: str, use_native: bool = True) -> TOAData:
 
 
 def _static_line_parts(
-    toas: TOAData, name: Optional[str], reuse_cache: bool = False
+    toas: TOAData, name: Optional[str], reuse_cache: bool = False,
+    pairs_only: bool = False,
 ):
     """Pre-rendered epoch-invariant parts of every tim line: a list of
     ``(prefix, suffix)`` pairs (prefix = " label freq", suffix =
     "err obs flags") plus the ``"prefix\\x1fsuffix\\n"`` byte stream the
     native writer consumes. Returns ``(pairs, stream_bytes)``; ``pairs``
-    is None on a cache hit (only the bytes are retained).
+    is None on a cache hit (only the bytes are retained — so the
+    static-cache speedup is a native-writer feature; the no-toolchain
+    fallback re-renders pairs per write, with ``pairs_only=True``
+    skipping the then-unused byte join), and ``stream_bytes`` is None
+    when ``pairs_only``.
 
     ``reuse_cache`` is an *opt-in* contract for callers that rewrite the
     same TOAs with only the epochs changed (the dataset-materialization
@@ -245,9 +250,6 @@ def _static_line_parts(
     between writes, which no cheap cache key can detect."""
     cached = getattr(toas, "_write_parts_cache", None)
     if reuse_cache and cached is not None and cached[0] == (name, toas.ntoas):
-        # only the byte stream is cached (the common native-writer path
-        # consumes nothing else); pairs are rebuilt on the rare
-        # no-native-toolchain fallback
         return None, cached[1]
     pairs = []
     for i in range(toas.ntoas):
@@ -259,6 +261,8 @@ def _static_line_parts(
             f" {label} {toas.freqs_mhz[i]:.8f}",
             f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}",
         ))
+    if pairs_only:
+        return pairs, None
     text = "".join(f"{p}\x1f{s}\n" for p, s in pairs).encode()
     if reuse_cache:
         toas._write_parts_cache = ((name, toas.ntoas), text)
@@ -302,7 +306,7 @@ def write_tim(
     if fast_write_tim(path, day, f15, text):
         return
     if pairs is None:  # cache hit (bytes only) but no native writer
-        pairs, _ = _static_line_parts(toas, name)
+        pairs, _ = _static_line_parts(toas, name, pairs_only=True)
     with open(path, "w") as fh:
         fh.write("FORMAT 1\nMODE 1\n")
         fh.writelines(
