@@ -417,7 +417,10 @@ def main():
     for chunk in chunks:
         env = dict(os.environ, BENCH_CHILD="1", BENCH_CHUNK=chunk)
         budget = deadline - (time.monotonic() - t_start)
-        if budget <= 60:
+        # always make the first attempt with whatever budget remains (a
+        # short BENCH_TIMEOUT is a legitimate harness smoke run); only
+        # retries need a meaningful slice of time to be worth spawning
+        if budget <= 0 or (tried and budget <= 60):
             break
         tried.append(chunk)
         try:
@@ -430,7 +433,9 @@ def main():
             )
         except subprocess.TimeoutExpired:
             _fail(
-                f"bench child exceeded {deadline:.0f}s deadline (hung backend?)"
+                f"bench child (chunk {chunk}) killed at its {budget:.0f}s "
+                f"slice of the {deadline:.0f}s deadline"
+                + (f" after earlier attempts {tried[:-1]}" if tried[:-1] else "")
             )
             return
         lines = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
