@@ -1,0 +1,168 @@
+"""On-hardware statistical validation of the f32 device path.
+
+The test suite validates the device ops on CPU in f64 (tests/conftest.py
+enables x64); the real chip runs f32 with its own matmul precisions and
+RNG lowering. This tool reruns the core statistical acceptance checks ON
+THE DEVICE at the bench's dtype and prints one JSON line of evidence —
+so "the TPU path is statistically faithful" is a measured per-round
+claim, not an extrapolation from CPU tests:
+
+- white+ECORR+RN variance budget: realization variance per pulsar vs the
+  exact analytic sum (the test_pipeline_variance_matches_analytic check,
+  f32, on device);
+- Hellings-Downs recovery: realization-averaged cross-pulsar correlation
+  matrix of a GWB-only workload vs the ORF (test_gwb_hellings_downs
+  pattern);
+- red-noise spectral slope: per-mode average power of an RN-only
+  workload, log-log slope vs -gamma.
+
+Usage: python benchmarks/validate_device.py [nreal]
+(BENCH_PLATFORM=cpu forces the CPU backend for smoke runs.)
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    nreal = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+
+    import jax
+
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.ops.fourier import fourier_frequencies, powerlaw_prior
+    from pta_replicator_tpu.ops.orf import hellings_downs_matrix
+
+    npsr, ntoa, nbackend = 32, 2048, 2
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=nbackend, seed=11)
+    phat = np.asarray(batch.phat, np.float64)
+    locs = np.stack(
+        [np.arctan2(phat[:, 1], phat[:, 0]), np.arccos(np.clip(phat[:, 2], -1, 1))],
+        axis=1,
+    )
+    orf = np.asarray(hellings_downs_matrix(locs))
+    M = jnp.asarray(np.linalg.cholesky(orf), batch.toas_s.dtype)
+    checks = {}
+
+    def fence(x):
+        return np.asarray(x)
+
+    # ---- 1. variance budget (white + ECORR + RN), exact analytic sum
+    efac, log_eq, log_ec = 1.2, -6.3, -6.4
+    gamma_rn, log_a_rn = 3.0, -13.6
+    recipe = B.Recipe(
+        efac=jnp.full((npsr, nbackend), efac),
+        log10_equad=jnp.full((npsr, nbackend), log_eq),
+        log10_ecorr=jnp.full((npsr, nbackend), log_ec),
+        rn_log10_amplitude=jnp.full(npsr, log_a_rn),
+        rn_gamma=jnp.full(npsr, gamma_rn),
+    )
+    keys = jax.random.split(jax.random.PRNGKey(1), nreal)
+    d = fence(
+        jax.jit(jax.vmap(lambda k: B.realization_delays(k, batch, recipe)))(keys)
+    )
+    meas = d.var(axis=0).mean(axis=-1)
+    white = (efac * np.asarray(batch.errors_s)) ** 2 + (efac * 10.0**log_eq) ** 2
+    freqs = np.asarray(fourier_frequencies(batch.tspan_s, nmodes=30))
+    prior = np.asarray(
+        powerlaw_prior(
+            np.repeat(freqs, 2, axis=-1), np.full(npsr, log_a_rn),
+            np.full(npsr, gamma_rn), np.asarray(batch.tspan_s),
+        )
+    )
+    want = white.mean(axis=-1) + (10.0**log_ec) ** 2 + prior.sum(axis=-1) / 2
+    dev = float(np.abs(meas / want - 1.0).max())
+    # variance-estimator noise ~ sqrt(2/nreal) per pulsar; 0.15 was the
+    # margin chosen at nreal=2000 — scale it like the HD check so short
+    # smoke runs don't report sampling noise as failure
+    tol = 0.15 * max(1.0, (2000.0 / nreal) ** 0.5)
+    checks["variance_budget"] = {
+        "max_rel_dev": round(dev, 4),
+        "tolerance": round(tol, 4),
+        "pass": dev < tol,
+    }
+
+    # ---- 2. Hellings-Downs correlation recovery (GWB only)
+    r_gwb = B.Recipe(
+        gwb_log10_amplitude=jnp.asarray(-14.0),
+        gwb_gamma=jnp.asarray(4.33),
+        orf_cholesky=M,
+        gwb_npts=200,
+        gwb_howml=4.0,
+    )
+    d = fence(
+        jax.jit(jax.vmap(lambda k: B.realization_delays(k, batch, r_gwb)))(keys)
+    )
+    cov = np.einsum("ran,rbn->ab", d, d) / d.shape[0] / d.shape[2]
+    corr = cov / np.sqrt(np.outer(np.diag(cov), np.diag(cov)))
+    dev = float(np.abs(corr - orf / 2.0).max())
+    # pure sampling noise: the max-abs deviation of an estimated
+    # correlation scales ~1/sqrt(nreal) (0.08 measured at 1500)
+    tol = 0.08 * (1500.0 / nreal) ** 0.5
+    checks["hellings_downs"] = {
+        "max_abs_dev": round(dev, 4),
+        "tolerance": round(tol, 4),
+        "pass": dev < tol,
+    }
+
+    # ---- 3. red-noise spectral slope: project per-mode power, fit slope
+    r_rn = B.Recipe(
+        rn_log10_amplitude=jnp.full(npsr, -13.8),
+        rn_gamma=jnp.full(npsr, 4.33),
+    )
+    d = jax.jit(jax.vmap(lambda k: B.realization_delays(k, batch, r_rn)))(keys)
+    # least-squares projection onto the Fourier basis recovers the drawn
+    # coefficients; their realization-averaged power per mode follows the
+    # power-law prior
+    F, _ = B.red_noise_basis_prior(
+        batch, jnp.full(npsr, -13.8), jnp.full(npsr, 4.33)
+    )
+    FtF = jnp.einsum("pnk,pnl->pkl", F, F, precision="highest")
+    Ftd = jnp.einsum("pnk,rpn->rpk", F, d, precision="highest")
+    coef = fence(
+        jnp.linalg.solve(FtF[None], Ftd[..., None])[..., 0]
+    )  # (R, Np, 2K)
+    power = (coef**2).mean(axis=0)  # (Np, 2K)
+    per_mode = power.reshape(npsr, -1, 2).sum(axis=-1)  # (Np, K)
+    logf = np.log(np.asarray(fourier_frequencies(batch.tspan_s, nmodes=30)))
+    slope = np.array([
+        np.polyfit(logf[p], np.log(per_mode[p]), 1)[0] for p in range(npsr)
+    ])
+    # E[power_k] ~ f^-gamma; the fitted log-log slope estimates -gamma
+    dev = float(np.abs(slope.mean() + 4.33))
+    checks["rn_spectral_slope"] = {
+        "mean_slope": round(float(slope.mean()), 3),
+        "expected": -4.33,
+        "tolerance": 0.15,
+        "pass": dev < 0.15,
+    }
+
+    print(
+        json.dumps(
+            {
+                "device": jax.devices()[0].device_kind,
+                "dtype": str(batch.toas_s.dtype),
+                "nreal": nreal,
+                "npsr": npsr,
+                "ntoa": ntoa,
+                "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "all_pass": all(c["pass"] for c in checks.values()),
+                "checks": checks,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -89,13 +89,21 @@ def powerlaw_prior(freqs_doubled, log10_amplitude, gamma, tspan_s, xp=np):
     from ..constants import YEAR_IN_SEC
 
     f = xp.asarray(freqs_doubled)
-    amp = 10.0 ** xp.asarray(log10_amplitude)
+    log10_amplitude = xp.asarray(log10_amplitude)
     gamma = xp.asarray(gamma)
     T = xp.asarray(tspan_s)
     fyr = 1.0 / YEAR_IN_SEC
-    return (
-        amp[..., None] ** 2
-        * (f / fyr) ** (-gamma[..., None])
-        / (12.0 * xp.pi**2 * T[..., None])
-        * YEAR_IN_SEC**3
+    # evaluated in log space: the naive product's intermediate
+    # amp^2 (f yr)^-gamma / (12 pi^2 T) sits at ~1e-38 for typical PTA
+    # amplitudes (A~1e-14, T~5e8 s) and mode numbers >~12, where f32
+    # flushes subnormals to zero — truncating the injected spectrum at
+    # 12 of 30 modes on device. The final prior (~1e-16) is comfortably
+    # representable; only the evaluation order was unsafe.
+    # (benchmarks/validate_device.py caught this on its first f32 run.)
+    log_prior = (
+        2.0 * xp.log(xp.asarray(10.0, f.dtype)) * log10_amplitude[..., None]
+        - gamma[..., None] * xp.log(f / fyr)
+        + 3.0 * xp.log(xp.asarray(YEAR_IN_SEC, f.dtype))
+        - xp.log(12.0 * xp.pi**2 * T[..., None])
     )
+    return xp.exp(log_prior)

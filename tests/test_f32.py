@@ -224,3 +224,34 @@ def test_full_recipe_f32_realize(batches):
     rms32 = float(jnp.sqrt(jnp.mean(r32**2)))
     rms64 = float(jnp.sqrt(jnp.mean(r64**2)))
     assert abs(rms32 / rms64 - 1.0) < 0.1
+
+
+def test_powerlaw_prior_no_f32_underflow():
+    """The power-law prior must not flush to zero at high mode numbers in
+    f32: the naive evaluation order's intermediate (amp^2 (f yr)^-gamma
+    / (12 pi^2 T)) sits in the subnormal range for typical PTA
+    amplitudes, truncating the injected red-noise spectrum at ~12 of 30
+    modes on device (caught by benchmarks/validate_device.py). The
+    log-space form keeps every mode finite and positive down to
+    amplitudes far below physical."""
+    import numpy as np
+
+    from pta_replicator_tpu.ops.fourier import fourier_frequencies, powerlaw_prior
+
+    T = np.float32(16 * 365.25 * 86400.0)
+    freqs = np.asarray(
+        fourier_frequencies(T, nmodes=30), np.float32
+    )
+    for log10_A in (-13.8, -16.0, -18.0):
+        prior = powerlaw_prior(
+            np.repeat(freqs, 2, axis=-1).astype(np.float32),
+            np.float32(log10_A), np.float32(4.33), T, xp=np,
+        )
+        assert prior.dtype == np.float32
+        assert np.all(prior > 0), (log10_A, prior)
+        # and the values match the f64 evaluation to f32 roundoff
+        prior64 = powerlaw_prior(
+            np.repeat(freqs, 2, axis=-1).astype(np.float64),
+            log10_A, 4.33, float(T), xp=np,
+        )
+        np.testing.assert_allclose(prior, prior64, rtol=2e-5)
