@@ -255,3 +255,52 @@ def test_powerlaw_prior_no_f32_underflow():
             log10_A, 4.33, float(T), xp=np,
         )
         np.testing.assert_allclose(prior, prior64, rtol=2e-5)
+
+
+def test_f32_pipeline_variance_budget():
+    """The f32 device pipeline's variance budget matches the analytic sum
+    — the dtype-sensitive sibling of test_pipeline_variance_matches_
+    analytic (which runs x64). A broad guard against f32 scale/underflow
+    defects in any op's draw chain; note that subnormal flushing is
+    backend/compilation dependent (the round-3 prior flush reproduced
+    under compiled pipelines, not reliably in eager CPU ops), so the
+    *deterministic* guard for that bug is
+    test_powerlaw_prior_no_f32_underflow."""
+    import jax
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.ops.fourier import fourier_frequencies, powerlaw_prior
+
+    npsr, ntoa, nreal = 4, 1024, 512
+    b = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=2, seed=5,
+                        dtype=jnp.float32)
+    f32 = jnp.float32
+    recipe = B.Recipe(
+        efac=jnp.full((npsr, 2), 1.2, f32),
+        log10_equad=jnp.full((npsr, 2), -6.3, f32),
+        log10_ecorr=jnp.full((npsr, 2), -6.4, f32),
+        # gamma ~ 0.8: a flat spectrum spreads power across all modes, so
+        # a high-mode flush moves the total budget far more than a steep
+        # gamma would
+        rn_log10_amplitude=jnp.full(npsr, -13.9, f32),
+        rn_gamma=jnp.full(npsr, 0.8, f32),
+    )
+    res = np.asarray(B.realize(jax.random.PRNGKey(3), b, recipe, nreal=nreal))
+    assert res.dtype == np.float32
+    meas = res.var(axis=0).mean(axis=-1)
+
+    efac, equad, ecorr = 1.2, 10.0**-6.3, 10.0**-6.4
+    white = (efac * np.asarray(b.errors_s, np.float64)) ** 2 + (efac * equad) ** 2
+    freqs = np.asarray(fourier_frequencies(np.asarray(b.tspan_s, np.float64),
+                                           nmodes=30))
+    prior = np.asarray(
+        powerlaw_prior(
+            np.repeat(freqs, 2, axis=-1),
+            np.full(npsr, -13.9), np.full(npsr, 0.8),
+            np.asarray(b.tspan_s, np.float64),
+        )
+    )
+    want = white.mean(axis=-1) + ecorr**2 + prior.sum(axis=-1) / 2.0
+    np.testing.assert_allclose(meas, want, rtol=0.12)
