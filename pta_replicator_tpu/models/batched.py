@@ -490,6 +490,100 @@ def _cw_scan_response(
     return total
 
 
+def cw_catalog_planes_for(
+    batch: PulsarBatch,
+    gwtheta,
+    gwphi,
+    mc,
+    dist,
+    fgw,
+    phase0,
+    psi,
+    inc,
+    pdist=1.0,
+    pphase=None,
+    evolve: bool = True,
+    phase_approx: bool = False,
+    tref_s: float = 0.0,
+):
+    """Accurate (f64 host) epoch-folded CW coefficient planes for this
+    batch: ``(src (NC_SRC, Ns), psr (NC_PSR, Np, Ns), evolve)``, fold
+    epoch matched to the batch's time reference. The returned ``evolve``
+    flag is the one the response kernels must branch on — it travels
+    with the planes so the two stages cannot silently disagree:
+
+        src, psr, evolve = cw_catalog_planes_for(batch, *params)
+        d = cgw_catalog_delays_from_planes(batch, src, psr, evolve=evolve)
+
+    Requires concrete (non-tracer) parameters — this is the precompute
+    that makes the f32 device path accurate. For catalog *sweeps*, call
+    this per catalog on host, stack the planes, and vmap
+    :func:`cgw_catalog_delays_from_planes` over the stacks; planes are
+    plain data, so passing them through jit boundaries loses nothing
+    (unlike raw source parameters — docs/DESIGN.md section 3).
+    """
+    from ..ops.pallas_cw import cw_catalog_planes
+
+    params = (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
+    tracer = jax.core.Tracer
+    if any(
+        isinstance(x, tracer)
+        for x in (batch.phat, pdist, pphase, *params)
+        if x is not None
+    ):
+        raise TypeError(
+            "cw_catalog_planes_for requires concrete parameters (the f64 "
+            "host precompute cannot run on tracers); precompute planes "
+            "outside jit and pass them through as data"
+        )
+    t_fold = batch.tref_mjd * 86400.0 - tref_s + batch.start_s
+    src_c, psr_c = cw_catalog_planes(
+        np.asarray(batch.phat, np.float64),
+        *[np.atleast_1d(np.asarray(x, np.float64)) for x in params],
+        pdist=np.asarray(pdist, np.float64),
+        pphase=None if pphase is None else np.asarray(pphase, np.float64),
+        t_fold=t_fold, evolve=evolve, phase_approx=phase_approx,
+        xp=np, dtype=batch.toas_s.dtype,
+    )
+    return src_c, psr_c, evolve
+
+
+def cgw_catalog_delays_from_planes(
+    batch: PulsarBatch,
+    src_c,
+    psr_c,
+    evolve: bool,
+    psr_term: bool = True,
+    chunk: int = 512,
+    backend: str = "auto",
+):
+    """Summed CW-catalog response from precomputed coefficient planes
+    (:func:`cw_catalog_planes_for`): the jit/vmap-safe form for catalog
+    sweeps — planes are data, so accuracy survives the jit boundary that
+    demotes raw traced parameters. ``evolve`` is required and must be
+    the flag the planes were built with (cw_catalog_planes_for returns
+    it alongside them; the kernels branch on it, and a mismatch would
+    apply chirp factors to linear-mode coefficients without any error).
+    Backend semantics as in :func:`cgw_catalog_delays`.
+    """
+    from ..ops.pallas_cw import cw_catalog_response
+
+    dtype = batch.toas_s.dtype
+    u = batch.toas_s - jnp.asarray(batch.start_s, dtype)
+    if backend == "auto":
+        backend = "scan"  # docs/DESIGN.md section 4
+    if backend not in ("pallas", "pallas_interpret", "scan"):
+        raise ValueError(f"unknown CW-catalog backend {backend!r}")
+    if backend in ("pallas", "pallas_interpret"):
+        out = cw_catalog_response(
+            u, src_c, psr_c, psr_term=psr_term, evolve=evolve,
+            interpret=backend == "pallas_interpret",
+        )
+    else:
+        out = _cw_scan_response(u, src_c, psr_c, psr_term, evolve, chunk)
+    return out * batch.mask
+
+
 def cgw_catalog_delays(
     batch: PulsarBatch,
     gwtheta,
@@ -531,16 +625,16 @@ def cgw_catalog_delays(
     with the kernel on a real v5e, and scan has no Mosaic failure modes —
     docs/DESIGN.md section 4); pass ``"pallas"`` explicitly to use the
     kernel. Deterministic (no key): source parameters are data.
+
+    For catalog sweeps under jit/vmap, precompute planes per catalog
+    with :func:`cw_catalog_planes_for` and run
+    :func:`cgw_catalog_delays_from_planes` — traced source parameters
+    here fall back to ambient-precision planes (docs/DESIGN.md
+    section 3).
     """
-    from ..ops.pallas_cw import cw_catalog_planes, cw_catalog_response
+    from ..ops.pallas_cw import cw_catalog_planes
 
     dtype = batch.toas_s.dtype
-    # fold epoch: batch start, in absolute source-frame seconds. start_s
-    # is static metadata, so it stays concrete even when the arrays are
-    # traced; kernel times are fold-relative (|u| <~ observation span).
-    t_fold = batch.tref_mjd * 86400.0 - tref_s + batch.start_s
-    u = batch.toas_s - jnp.asarray(batch.start_s, dtype)
-
     params = (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
     tracer = jax.core.Tracer
     host_ok = not any(
@@ -550,41 +644,24 @@ def cgw_catalog_delays(
     )
     if host_ok:
         # float64 host precompute: the supported accurate path for f32
-        src_c, psr_c = cw_catalog_planes(
-            np.asarray(batch.phat, np.float64),
-            *[np.atleast_1d(np.asarray(x, np.float64)) for x in params],
-            pdist=np.asarray(pdist, np.float64),
-            pphase=None if pphase is None else np.asarray(pphase, np.float64),
-            t_fold=t_fold, evolve=evolve, phase_approx=phase_approx,
-            xp=np, dtype=dtype,
+        src_c, psr_c, evolve = cw_catalog_planes_for(
+            batch, *params, pdist=pdist, pphase=pphase,
+            evolve=evolve, phase_approx=phase_approx, tref_s=tref_s,
         )
-    else:  # traced parameters: same formulas at ambient precision
+    else:  # traced parameters: same formulas at ambient precision.
+        # fold epoch: batch start, in absolute source-frame seconds —
+        # start_s is static metadata, so it stays concrete even when the
+        # arrays are traced
+        t_fold = batch.tref_mjd * 86400.0 - tref_s + batch.start_s
         src_c, psr_c = cw_catalog_planes(
             batch.phat, *params, pdist=pdist, pphase=pphase,
             t_fold=t_fold, evolve=evolve, phase_approx=phase_approx,
             xp=jnp, dtype=dtype,
         )
-
-    nsrc = src_c.shape[1]
-    if backend == "auto":
-        # scan everywhere: on a real v5e the (working, bit-identical)
-        # Pallas kernel and XLA's fused scan measure statistically tied
-        # at the flagship shape, so the portable path with no
-        # Mosaic-compile or vmem-budget failure modes wins by default —
-        # docs/DESIGN.md section 4 records the full diagnosis. 'pallas'
-        # remains available explicitly, and bench.py re-measures both
-        # backends every round.
-        backend = "scan"
-    if backend not in ("pallas", "pallas_interpret", "scan"):
-        raise ValueError(f"unknown CW-catalog backend {backend!r}")
-    if backend in ("pallas", "pallas_interpret"):
-        out = cw_catalog_response(
-            u, src_c, psr_c, psr_term=psr_term, evolve=evolve,
-            interpret=backend == "pallas_interpret",
-        )
-    else:
-        out = _cw_scan_response(u, src_c, psr_c, psr_term, evolve, chunk)
-    return out * batch.mask
+    return cgw_catalog_delays_from_planes(
+        batch, src_c, psr_c, evolve=evolve, psr_term=psr_term,
+        chunk=chunk, backend=backend,
+    )
 
 
 def _batch_antenna(gwtheta, gwphi, phat):

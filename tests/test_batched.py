@@ -796,3 +796,60 @@ def test_pipeline_variance_matches_analytic():
     want = white.mean(axis=-1) + ecorr**2 + prior.sum(axis=-1) / 2.0
     # nreal=512 with TOA-correlated RN: ~5-10% sampling scatter
     np.testing.assert_allclose(meas, want, rtol=0.12)
+
+
+def test_cw_planes_api_sweep_keeps_accuracy():
+    """Catalog sweeps via precomputed planes keep the f64 host accuracy
+    through jit boundaries: planes are data. Pins (a) from_planes ==
+    direct concrete call bitwise, (b) a jitted/vmapped sweep over
+    stacked per-catalog planes == per-catalog direct calls, and (c) the
+    planes precompute refuses tracers loudly."""
+    from pta_replicator_tpu.batch import synthetic_batch
+
+    batch = synthetic_batch(npsr=3, ntoa=128, nbackend=2, seed=4,
+                            dtype=jnp.float32)
+    ncat, ncw = 3, 5
+
+    def catalog(i):
+        r = np.random.default_rng(100 + i)
+        return [
+            np.arccos(r.uniform(-1, 1, ncw)), r.uniform(0, 2 * np.pi, ncw),
+            10 ** r.uniform(8, 9.3, ncw), r.uniform(50, 900, ncw),
+            10 ** r.uniform(-8.6, -7.8, ncw), r.uniform(0, 2 * np.pi, ncw),
+            r.uniform(0, np.pi, ncw), np.arccos(r.uniform(-1, 1, ncw)),
+        ]
+
+    direct = [
+        np.asarray(B.cgw_catalog_delays(batch, *catalog(i), chunk=8))
+        for i in range(ncat)
+    ]
+
+    planes = [B.cw_catalog_planes_for(batch, *catalog(i)) for i in range(ncat)]
+    src0, psr0, evolve0 = planes[0]
+    a = np.asarray(
+        B.cgw_catalog_delays_from_planes(
+            batch, src0, psr0, evolve=evolve0, chunk=8
+        )
+    )
+    assert np.array_equal(a, direct[0])  # same planes, same math
+
+    src_stack = jnp.stack([p[0] for p in planes])
+    psr_stack = jnp.stack([p[1] for p in planes])
+    swept = np.asarray(
+        jax.jit(
+            jax.vmap(
+                lambda s, p: B.cgw_catalog_delays_from_planes(
+                    batch, s, p, evolve=True, chunk=8
+                )
+            )
+        )(src_stack, psr_stack)
+    )
+    rms = np.sqrt(np.mean(np.stack(direct) ** 2))
+    dev = np.abs(swept - np.stack(direct)).max()
+    # planes pass through jit as data: only f32 re-association remains
+    assert dev <= 1e-5 * rms, (dev, rms)
+
+    with pytest.raises(TypeError, match="concrete"):
+        jax.jit(lambda c: B.cw_catalog_planes_for(batch, *c))(
+            [jnp.asarray(x) for x in catalog(0)]
+        )
