@@ -107,12 +107,28 @@ def injection_stage_fns(batch, recipe) -> dict:
                 user_spectrum=recipe.gwb_user_spectrum,
             )
         )
-    stages["quad_fit"] = vm(
-        lambda k: B.quadratic_fit_subtract(
-            jax.random.normal(k, batch.toas_s.shape, batch.toas_s.dtype),
-            batch,
+    # mirror finalize_residuals: the pipeline runs EITHER the quadratic
+    # fit (no trailing residualize) OR the design fit + residualize
+    if recipe.fit_design is None:
+        stages["quad_fit"] = vm(
+            lambda k: B.quadratic_fit_subtract(
+                jax.random.normal(k, batch.toas_s.shape, batch.toas_s.dtype),
+                batch,
+            )
         )
-    )
+    else:
+        stages["design_fit"] = vm(
+            lambda k: B.residualize(
+                B.design_fit_subtract(
+                    jax.random.normal(
+                        k, batch.toas_s.shape, batch.toas_s.dtype
+                    ),
+                    batch,
+                    recipe.fit_design,
+                ),
+                batch,
+            )
+        )
     if recipe.cgw_params is not None:
         stages["cgw_catalog_once"] = jax.jit(
             lambda ks: B.cgw_catalog_delays(
