@@ -31,6 +31,7 @@ from .simulate import (
 from .models import (
     add_measurement_noise,
     add_jitter,
+    add_chromatic_noise,
     add_red_noise,
     add_gwb,
     add_cgw,
@@ -52,6 +53,7 @@ __all__ = [
     "make_ideal",
     "add_measurement_noise",
     "add_jitter",
+    "add_chromatic_noise",
     "add_red_noise",
     "add_gwb",
     "add_cgw",

@@ -48,7 +48,8 @@ def _build_recipe(spec: dict, psrs):
         )
     static_names = {
         "tnequad", "gwb_turnover", "rn_nmodes", "rn_logf", "rn_pshift",
-        "rn_libstempo", "gwb_npts", "gwb_howml",
+        "rn_libstempo", "chrom_nmodes", "chrom_ref_freq_mhz",
+        "gwb_npts", "gwb_howml",
         "cgw_tref_s", "cgw_chunk", "cgw_backend", "cgw_psr_term",
         "cgw_evolve", "cgw_phase_approx", "transient_psr",
         "gwb_f0", "gwb_beta", "gwb_power",

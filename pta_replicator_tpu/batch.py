@@ -58,6 +58,10 @@ class PulsarBatch:
     tspan_s: jax.Array
     #: (Np,) number of valid TOAs
     ntoas: jax.Array
+    #: (Np, Nt) observing radio frequency [MHz] (1400.0 in padding);
+    #: None on batches frozen before chromatic ops existed — the
+    #: chromatic-noise op requires it and raises otherwise
+    freqs_mhz: Optional[jax.Array] = None
 
     # -- static metadata (not traced)
     tref_mjd: float = field(metadata=dict(static=True), default=0.0)
@@ -80,7 +84,11 @@ class PulsarBatch:
 
     def astype(self, dtype) -> "PulsarBatch":
         """Cast floating leaves (times stay in their relative frame)."""
-        cast = lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        cast = lambda x: (
+            x.astype(dtype)
+            if x is not None and jnp.issubdtype(x.dtype, jnp.floating)
+            else x
+        )
         return jax.tree_util.tree_map(cast, self)
 
 
@@ -125,6 +133,13 @@ def synthetic_batch(
         [sintheta * np.cos(phi), sintheta * np.sin(phi), costheta], axis=1
     )
 
+    # per-backend observing bands (realistic NANOGrav-ish spread) with a
+    # little per-TOA bandwidth scatter
+    band_centers = np.linspace(430.0, 2300.0, nbackend)
+    freqs = band_centers[backend_idx] * rng.uniform(
+        0.9, 1.1, size=backend_idx.shape
+    )
+
     return PulsarBatch(
         toas_s=jnp.asarray(toas_s, dtype),
         errors_s=jnp.full((npsr, ntoa), toaerr_s, dtype),
@@ -136,6 +151,7 @@ def synthetic_batch(
         backend_index=jnp.asarray(backend_idx, jnp.int32),
         tspan_s=jnp.asarray(toas_s.max(axis=1) - toas_s.min(axis=1), dtype),
         ntoas=jnp.full(npsr, ntoa, jnp.int32),
+        freqs_mhz=jnp.asarray(freqs, dtype),
         tref_mjd=55000.0,
         names=tuple(f"SYN{i:04d}" for i in range(npsr)),
         backend_names=tuple(f"backend{i}" for i in range(nbackend)),
@@ -175,6 +191,13 @@ def freeze(
     toas = np.zeros((npsr, nt))
     errors = np.ones((npsr, nt))
     mask = np.zeros((npsr, nt))
+    # observing frequencies feed chromatic noise; if ANY pulsar lacks
+    # them the whole field stays None so the chromatic op raises loudly
+    # instead of silently treating a 1400 MHz fill as real physics
+    have_freqs = all(
+        getattr(p.toas, "freqs_mhz", None) is not None for p in psrs
+    )
+    freqs = np.full((npsr, nt), 1400.0)  # benign padding (no div-by-zero)
     backend_idx = np.zeros((npsr, nt), dtype=np.int32)
     epoch_idx = np.zeros((npsr, nt), dtype=np.int32)
     phat = np.zeros((npsr, 3))
@@ -190,6 +213,8 @@ def freeze(
         toas[i, :n] = rel
         toas[i, n:] = rel[-1] if n else 0.0  # benign padding values
         errors[i, :n] = p.toas.errors_s
+        if have_freqs:
+            freqs[i, :n] = p.toas.freqs_mhz
         mask[i, :n] = 1.0
         tspan[i] = rel[:n].max() - rel[:n].min() if n else 0.0
         theta, phi = pulsar_theta_phi(p.loc, p.name)
@@ -242,6 +267,7 @@ def freeze(
         backend_index=jnp.asarray(backend_idx),
         tspan_s=jnp.asarray(tspan, dtype=dtype),
         ntoas=jnp.asarray(ntoas),
+        freqs_mhz=jnp.asarray(freqs, dtype=dtype) if have_freqs else None,
         tref_mjd=tref_mjd,
         names=tuple(p.name for p in psrs),
         backend_names=tuple(backend_names),

@@ -1,5 +1,5 @@
 from .white_noise import add_measurement_noise, add_jitter
-from .red_noise import add_red_noise
+from .red_noise import add_chromatic_noise, add_red_noise
 from .gwb import add_gwb
 from .cgw import add_cgw, add_catalog_of_cws
 from .bursts import add_burst, add_noise_transient, add_gw_memory
@@ -8,6 +8,7 @@ from .population import add_gwb_plus_outlier_cws, population_recipe, split_popul
 __all__ = [
     "add_measurement_noise",
     "add_jitter",
+    "add_chromatic_noise",
     "add_red_noise",
     "add_gwb",
     "add_cgw",

@@ -35,7 +35,9 @@ from .gwb import (
 #: coefficient interleave), so resumable sweeps checkpointed under a
 #: different stream refuse to resume instead of silently mixing streams.
 #: v3: white noise draws ONE combined-variance normal per TOA (was two).
-STREAM_VERSION = 3
+#: v4: realization_delays splits 5 subkeys (chromatic-noise stage added
+#: between red noise and GWB).
+STREAM_VERSION = 4
 
 
 def _per_toa(params, index, mask):
@@ -237,6 +239,48 @@ def red_noise_delays(
         eps = _rows_draw(jax.random.normal, k_eps, rows, prior2.shape, dtype)
     coeff = jnp.sqrt(prior2) * jnp.asarray(eps, dtype)
     return jnp.einsum("pnk,pk->pn", F, coeff) * batch.mask
+
+
+def chromatic_noise_delays(
+    key,
+    batch: PulsarBatch,
+    log10_amplitude,
+    gamma,
+    chromatic_index=2.0,
+    nmodes: int = 30,
+    ref_freq_mhz: float = 1400.0,
+    tspan_s=None,
+    eps=None,
+    rows=None,
+):
+    """Chromatic (radio-frequency-dependent) power-law red noise: the
+    achromatic Fourier-basis process scaled per TOA by
+    ``(ref_freq/freq)^chromatic_index`` — index 2 is dispersion-measure
+    noise, 4 scattering. Amplitude is defined at ``ref_freq_mhz``.
+
+    Beyond-reference signal family (the reference injects only
+    achromatic red noise, red_noise.py:106-135); the oracle twin is
+    models.red_noise.add_chromatic_noise. Requires the batch to carry
+    observing frequencies (``freeze`` populates them from the tim files).
+    """
+    if batch.freqs_mhz is None:
+        raise ValueError(
+            "chromatic noise needs batch.freqs_mhz — re-freeze a dataset "
+            "whose TOAs carry observing frequencies (batches frozen "
+            "before chromatic support, or from frequency-less TOAs, "
+            "lack them)"
+        )
+    dtype = batch.toas_s.dtype
+    idx = jnp.asarray(chromatic_index, dtype)
+    if idx.ndim >= 1:  # per-pulsar exponent broadcasts over the TOA axis
+        idx = idx[..., None]
+    scale = (jnp.asarray(ref_freq_mhz, dtype) / batch.freqs_mhz) ** idx
+    # the achromatic process IS red_noise_delays (same stream, same
+    # basis/prior); chromaticity is a per-TOA elementwise scale on top
+    return scale * red_noise_delays(
+        key, batch, log10_amplitude, gamma, nmodes=nmodes,
+        tspan_s=tspan_s, eps=eps, rows=rows,
+    )
 
 
 def uniform_grid_interp(t, start, stop, series):
@@ -758,6 +802,12 @@ class Recipe:
     rn_fmax: Optional[jax.Array] = None
     #: common red-noise Tspan override [s] (scalar or (Np,))
     rn_tspan_s: Optional[jax.Array] = None
+    #: chromatic (DM-like) red noise: power-law amplitude at
+    #: chrom_ref_freq_mhz, scaled per TOA by (ref/freq)^chrom_index
+    #: (index 2 = DM noise, 4 = scattering); beyond-reference family
+    chrom_log10_amplitude: Optional[jax.Array] = None
+    chrom_gamma: Optional[jax.Array] = None
+    chrom_index: Optional[jax.Array] = None  # defaults to 2.0 when enabled
     gwb_log10_amplitude: Optional[jax.Array] = None
     gwb_gamma: Optional[jax.Array] = None
     orf_cholesky: Optional[jax.Array] = None
@@ -798,6 +848,8 @@ class Recipe:
     rn_logf: bool = field(metadata=dict(static=True), default=False)
     rn_pshift: bool = field(metadata=dict(static=True), default=False)
     rn_libstempo: bool = field(metadata=dict(static=True), default=False)
+    chrom_nmodes: int = field(metadata=dict(static=True), default=30)
+    chrom_ref_freq_mhz: float = field(metadata=dict(static=True), default=1400.0)
     gwb_npts: int = field(metadata=dict(static=True), default=600)
     gwb_howml: float = field(metadata=dict(static=True), default=10.0)
     cgw_tref_s: float = field(metadata=dict(static=True), default=0.0)
@@ -828,7 +880,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
     row windows of the global streams (pulsar-sharded SPMD — see
     :func:`_rows_draw`; the GWB handles its own globality through the
     sharded ORF rows)."""
-    k_wn, k_ec, k_rn, k_gwb = jax.random.split(key, 4)
+    k_wn, k_ec, k_rn, k_chrom, k_gwb = jax.random.split(key, 5)
     total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
     if recipe.efac is not None or recipe.log10_equad is not None:
         total = total + white_noise_delays(
@@ -855,6 +907,19 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
             pshift=recipe.rn_pshift,
             libstempo_convention=recipe.rn_libstempo,
             tspan_s=recipe.rn_tspan_s,
+            rows=rows,
+        )
+    if recipe.chrom_log10_amplitude is not None:
+        total = total + chromatic_noise_delays(
+            k_chrom,
+            batch,
+            recipe.chrom_log10_amplitude,
+            recipe.chrom_gamma,
+            chromatic_index=(
+                recipe.chrom_index if recipe.chrom_index is not None else 2.0
+            ),
+            nmodes=recipe.chrom_nmodes,
+            ref_freq_mhz=recipe.chrom_ref_freq_mhz,
             rows=rows,
         )
     if recipe.gwb_log10_amplitude is not None or recipe.gwb_user_spectrum is not None:

@@ -87,3 +87,57 @@ def add_red_noise(
     )
     psr.toas.adjust_seconds(dt)
     psr.update_residuals()
+
+
+def add_chromatic_noise(
+    psr: SimulatedPulsar,
+    log10_amplitude: float,
+    spectral_index: float,
+    components: int = 30,
+    chromatic_index: float = 2.0,
+    ref_freq_mhz: float = 1400.0,
+    seed: int = None,
+    Tspan: float = None,
+    signal_name: str = "chromatic_noise",
+):
+    """Inject chromatic (radio-frequency-dependent) power-law red noise:
+    the achromatic Fourier-basis process scaled per TOA by
+    ``(ref_freq_mhz / freq)^chromatic_index`` — index 2 is
+    dispersion-measure noise, 4 scattering; the amplitude is defined at
+    ``ref_freq_mhz``.
+
+    Beyond-reference signal family (the reference injects only achromatic
+    red noise, red_noise.py:106-135): real PTA datasets carry DM noise,
+    and multi-band TOAs make it separable from achromatic red noise.
+    Same draw layout as :func:`add_red_noise` (one N(0,1)^(2K) stream
+    after optional seeding); device twin
+    models.batched.chromatic_noise_delays.
+    """
+    if seed is not None:
+        np.random.seed(seed)
+
+    toas_s = psr.toas.get_mjds() * DAY_IN_SEC
+    tspan = float(Tspan) if Tspan is not None else float(toas_s.max() - toas_s.min())
+    eps = np.random.randn(2 * components)
+    dt = red_noise_delay(
+        toas_s,
+        log10_amplitude,
+        spectral_index,
+        eps,
+        nmodes=components,
+        tspan_s=tspan,
+    )
+    freqs = np.asarray(psr.toas.freqs_mhz, dtype=np.float64)
+    dt = dt * (ref_freq_mhz / freqs) ** chromatic_index
+    psr.update_added_signals(
+        f"{psr.name}_{signal_name}",
+        {
+            "amplitude": log10_amplitude,
+            "spectral_index": spectral_index,
+            "chromatic_index": chromatic_index,
+            "ref_freq_mhz": ref_freq_mhz,
+        },
+        dt,
+    )
+    psr.toas.adjust_seconds(dt)
+    psr.update_residuals()

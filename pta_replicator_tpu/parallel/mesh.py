@@ -241,6 +241,9 @@ _PSR_MAJOR_RECIPE_FIELDS = frozenset(
         "rn_fmin",
         "rn_fmax",
         "rn_tspan_s",
+        "chrom_log10_amplitude",
+        "chrom_gamma",
+        "chrom_index",
         "orf_cholesky",
         "fit_design",
     }

@@ -130,7 +130,7 @@ def save_batch(batch: PulsarBatch, path: str) -> None:
         val = getattr(batch, f.name)
         if f.metadata.get("static"):
             static[f.name] = list(val) if isinstance(val, tuple) else val
-        else:
+        elif val is not None:  # optional leaves (e.g. freqs_mhz) may be absent
             arrays[f.name] = np.asarray(val)
     np.savez_compressed(path, static=json.dumps(static), **arrays)
 
@@ -145,9 +145,10 @@ def load_batch(path: str, dtype=None) -> PulsarBatch:
         if f.metadata.get("static"):
             val = static[f.name]
             kwargs[f.name] = tuple(val) if isinstance(val, list) else val
-        else:
+        elif f.name in data:
             arr = data[f.name]
             if dtype is not None and np.issubdtype(arr.dtype, np.floating):
                 arr = arr.astype(dtype)
             kwargs[f.name] = jnp.asarray(arr)
+        # optional leaves missing from older checkpoints keep their default
     return PulsarBatch(**kwargs)

@@ -727,9 +727,10 @@ def test_quadratic_fit_projects_mean():
 
 
 def test_realization_delays_stream_layout():
-    """realization_delays consumes split(key, 4) in (wn, ecorr, rn, gwb)
-    order — the STREAM_VERSION contract checkpointed sweeps rely on.
-    Bitwise: the summed per-op delays under that split reproduce it."""
+    """realization_delays consumes split(key, 5) in (wn, ecorr, rn,
+    chrom, gwb) order — the STREAM_VERSION contract checkpointed sweeps
+    rely on. Bitwise: the summed per-op delays under that split
+    reproduce it."""
     from pta_replicator_tpu.batch import synthetic_batch
 
     b = synthetic_batch(npsr=4, ntoa=256, nbackend=2, seed=2)
@@ -739,6 +740,8 @@ def test_realization_delays_stream_layout():
         log10_ecorr=jnp.full((4, 2), -6.6),
         rn_log10_amplitude=jnp.full(4, -13.8),
         rn_gamma=jnp.full(4, 3.5),
+        chrom_log10_amplitude=jnp.full(4, -13.9),
+        chrom_gamma=jnp.full(4, 2.5),
         gwb_log10_amplitude=jnp.asarray(-14.0),
         gwb_gamma=jnp.asarray(4.33),
         gwb_npts=64,
@@ -746,13 +749,16 @@ def test_realization_delays_stream_layout():
     )
     key = jax.random.PRNGKey(7)
     total = B.realization_delays(key, b, recipe)
-    k_wn, k_ec, k_rn, k_gwb = jax.random.split(key, 4)
+    k_wn, k_ec, k_rn, k_chrom, k_gwb = jax.random.split(key, 5)
     parts = (
         B.white_noise_delays(k_wn, b, efac=recipe.efac,
                              log10_equad=recipe.log10_equad)
         + B.jitter_delays(k_ec, b, recipe.log10_ecorr)
         + B.red_noise_delays(k_rn, b, recipe.rn_log10_amplitude,
                              recipe.rn_gamma)
+        + B.chromatic_noise_delays(k_chrom, b,
+                                   recipe.chrom_log10_amplitude,
+                                   recipe.chrom_gamma)
         + B.gwb_delays(k_gwb, b,
                        recipe.gwb_log10_amplitude, recipe.gwb_gamma,
                        jnp.sqrt(2.0) * jnp.eye(4, dtype=b.toas_s.dtype),
@@ -853,3 +859,55 @@ def test_cw_planes_api_sweep_keeps_accuracy():
         jax.jit(lambda c: B.cw_catalog_planes_for(batch, *c))(
             [jnp.asarray(x) for x in catalog(0)]
         )
+
+
+def test_chromatic_noise_scaling_and_oracle_parity():
+    """Chromatic noise scales per TOA as (ref/freq)^index and the device
+    op reproduces the oracle exactly under a shared coefficient stream."""
+    from pta_replicator_tpu import add_chromatic_noise, load_pulsar, make_ideal
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models.red_noise import red_noise_delay
+
+    # device: explicit eps, scaling law exact
+    b = synthetic_batch(npsr=3, ntoa=256, nbackend=2, seed=6)
+    eps = np.random.default_rng(0).normal(size=(3, 60))
+    d2 = np.asarray(
+        B.chromatic_noise_delays(
+            None, b, jnp.full(3, -13.5), jnp.full(3, 3.0),
+            chromatic_index=2.0, eps=jnp.asarray(eps),
+        )
+    )
+    d4 = np.asarray(
+        B.chromatic_noise_delays(
+            None, b, jnp.full(3, -13.5), jnp.full(3, 3.0),
+            chromatic_index=4.0, eps=jnp.asarray(eps),
+        )
+    )
+    scale2 = (1400.0 / np.asarray(b.freqs_mhz)) ** 2
+    np.testing.assert_allclose(d4, d2 * scale2, rtol=1e-10)
+    # achromatic part recovered by dividing the scaling out
+    achrom = np.asarray(
+        B.red_noise_delays(
+            None, b, jnp.full(3, -13.5), jnp.full(3, 3.0),
+            eps=jnp.asarray(eps),
+        )
+    )
+    np.testing.assert_allclose(d2, achrom * scale2, rtol=1e-10)
+
+    # oracle: ledger + seeded draw layout; matches a hand-built delay
+    psr = load_pulsar(
+        "/root/reference/test_partim_small/par/JPSR00.par",
+        "/root/reference/test_partim_small/tim/fake_JPSR00_noiseonly.tim",
+    )
+    make_ideal(psr)
+    mjd0 = psr.toas.get_mjds().copy()
+    add_chromatic_noise(psr, -13.5, 3.0, chromatic_index=2.0, seed=42)
+    dt = psr.added_signals_time[f"{psr.name}_chromatic_noise"]
+    np.random.seed(42)
+    eps_o = np.random.randn(60)
+    toas_s = mjd0 * 86400.0
+    want = red_noise_delay(
+        toas_s, -13.5, 3.0, eps_o, nmodes=30,
+        tspan_s=float(toas_s.max() - toas_s.min()),
+    ) * (1400.0 / np.asarray(psr.toas.freqs_mhz)) ** 2
+    np.testing.assert_allclose(dt, want, rtol=1e-12)
