@@ -58,15 +58,19 @@ def main():
     def fence(x):
         return np.asarray(x)
 
-    # ---- 1. variance budget (white + ECORR + RN), exact analytic sum
+    # ---- 1. variance budget (white + ECORR + RN + chromatic), exact
+    # analytic sum
     efac, log_eq, log_ec = 1.2, -6.3, -6.4
     gamma_rn, log_a_rn = 3.0, -13.6
+    gamma_ch, log_a_ch = 2.5, -13.8
     recipe = B.Recipe(
         efac=jnp.full((npsr, nbackend), efac),
         log10_equad=jnp.full((npsr, nbackend), log_eq),
         log10_ecorr=jnp.full((npsr, nbackend), log_ec),
         rn_log10_amplitude=jnp.full(npsr, log_a_rn),
         rn_gamma=jnp.full(npsr, gamma_rn),
+        chrom_log10_amplitude=jnp.full(npsr, log_a_ch),
+        chrom_gamma=jnp.full(npsr, gamma_ch),
     )
     keys = jax.random.split(jax.random.PRNGKey(1), nreal)
     d = fence(
@@ -75,13 +79,24 @@ def main():
     meas = d.var(axis=0).mean(axis=-1)
     white = (efac * np.asarray(batch.errors_s)) ** 2 + (efac * 10.0**log_eq) ** 2
     freqs = np.asarray(fourier_frequencies(batch.tspan_s, nmodes=30))
-    prior = np.asarray(
-        powerlaw_prior(
-            np.repeat(freqs, 2, axis=-1), np.full(npsr, log_a_rn),
-            np.full(npsr, gamma_rn), np.asarray(batch.tspan_s),
+
+    def rn_var(log_a, gamma):
+        prior = np.asarray(
+            powerlaw_prior(
+                np.repeat(freqs, 2, axis=-1), np.full(npsr, log_a),
+                np.full(npsr, gamma), np.asarray(batch.tspan_s),
+            )
         )
+        return prior.sum(axis=-1) / 2
+
+    # variance scale: ((ref/f)^index)^2 with the default index 2
+    chrom_scale2 = ((1400.0 / np.asarray(batch.freqs_mhz)) ** 4).mean(axis=-1)
+    want = (
+        white.mean(axis=-1)
+        + (10.0**log_ec) ** 2
+        + rn_var(log_a_rn, gamma_rn)
+        + rn_var(log_a_ch, gamma_ch) * chrom_scale2
     )
-    want = white.mean(axis=-1) + (10.0**log_ec) ** 2 + prior.sum(axis=-1) / 2
     dev = float(np.abs(meas / want - 1.0).max())
     # variance-estimator noise ~ sqrt(2/nreal) per pulsar; 0.15 was the
     # margin chosen at nreal=2000 — scale it like the HD check so short

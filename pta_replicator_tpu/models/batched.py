@@ -274,7 +274,15 @@ def chromatic_noise_delays(
     idx = jnp.asarray(chromatic_index, dtype)
     if idx.ndim >= 1:  # per-pulsar exponent broadcasts over the TOA axis
         idx = idx[..., None]
-    scale = (jnp.asarray(ref_freq_mhz, dtype) / batch.freqs_mhz) ** idx
+    # freq <= 0 is the TEMPO convention for infinite-frequency
+    # (barycentric) TOAs: the chromatic delay there is exactly zero, not
+    # the inf a naive (ref/0)^idx would inject
+    safe = jnp.maximum(batch.freqs_mhz, jnp.asarray(1e-30, dtype))
+    scale = jnp.where(
+        batch.freqs_mhz > 0.0,
+        (jnp.asarray(ref_freq_mhz, dtype) / safe) ** idx,
+        0.0,
+    )
     # the achromatic process IS red_noise_delays (same stream, same
     # basis/prior); chromaticity is a per-TOA elementwise scale on top
     return scale * red_noise_delays(

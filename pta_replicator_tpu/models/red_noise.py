@@ -127,8 +127,20 @@ def add_chromatic_noise(
         nmodes=components,
         tspan_s=tspan,
     )
+    if psr.toas.freqs_mhz is None:
+        raise ValueError(
+            f"{psr.name}: chromatic noise needs TOA observing frequencies "
+            "(the tim data carries none)"
+        )
     freqs = np.asarray(psr.toas.freqs_mhz, dtype=np.float64)
-    dt = dt * (ref_freq_mhz / freqs) ** chromatic_index
+    # freq <= 0 is the TEMPO convention for infinite-frequency
+    # (barycentric) TOAs: zero chromatic delay there
+    scale = np.where(
+        freqs > 0.0,
+        (ref_freq_mhz / np.where(freqs > 0.0, freqs, 1.0)) ** chromatic_index,
+        0.0,
+    )
+    dt = dt * scale
     psr.update_added_signals(
         f"{psr.name}_{signal_name}",
         {
