@@ -91,17 +91,43 @@ def injection_stage_fns(batch, recipe) -> dict:
                 nmodes=recipe.rn_nmodes,
             )
         )
-    if recipe.orf_cholesky is not None and (
+    if recipe.chrom_log10_amplitude is not None:
+        stages["chromatic_noise"] = vm(
+            lambda k: B.chromatic_noise_delays(
+                k,
+                batch,
+                recipe.chrom_log10_amplitude,
+                recipe.chrom_gamma,
+                chromatic_index=(
+                    recipe.chrom_index
+                    if recipe.chrom_index is not None else 2.0
+                ),
+                nmodes=recipe.chrom_nmodes,
+                ref_freq_mhz=recipe.chrom_ref_freq_mhz,
+            )
+        )
+    if (
         recipe.gwb_log10_amplitude is not None
         or recipe.gwb_user_spectrum is not None
     ):
+        # mirror realization_delays' enabling condition exactly: with no
+        # ORF the pipeline still injects the uncorrelated sqrt(2)*I
+        # common process (reference no_correlations mode)
+        import jax.numpy as jnp
+
+        orf_chol = (
+            recipe.orf_cholesky
+            if recipe.orf_cholesky is not None
+            else jnp.sqrt(2.0)
+            * jnp.eye(batch.npsr, dtype=batch.toas_s.dtype)
+        )
         stages["gwb"] = vm(
             lambda k: B.gwb_delays(
                 k,
                 batch,
                 recipe.gwb_log10_amplitude,
                 recipe.gwb_gamma,
-                recipe.orf_cholesky,
+                orf_chol,
                 npts=recipe.gwb_npts,
                 howml=recipe.gwb_howml,
                 user_spectrum=recipe.gwb_user_spectrum,
