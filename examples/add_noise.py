@@ -38,6 +38,9 @@ def part_a_oracle(plot: bool = False):
         ptr.add_measurement_noise(psr, efac=1.1, log10_equad=np.log10(2e-7), seed=100 + i)
         ptr.add_jitter(psr, log10_ecorr=np.log10(3e-7), coarsegrain=0.1, seed=200 + i)
         ptr.add_red_noise(psr, log10_amplitude=-14.5, spectral_index=3.5, seed=300 + i)
+        # beyond-reference: chromatic (DM-like) noise, amplitude at 1400 MHz
+        ptr.add_chromatic_noise(psr, log10_amplitude=-14.8, spectral_index=2.5,
+                                chromatic_index=2.0, seed=400 + i)
 
     # --- one resolvable SMBHB continuous wave
     ptr.add_cgw(
@@ -103,6 +106,8 @@ def part_b_device(psrs):
         log10_ecorr=jnp.full(batch.npsr, np.log10(3e-7)),
         rn_log10_amplitude=jnp.full(batch.npsr, -14.5),
         rn_gamma=jnp.full(batch.npsr, 3.5),
+        chrom_log10_amplitude=jnp.full(batch.npsr, -14.8),
+        chrom_gamma=jnp.full(batch.npsr, 2.5),
         gwb_log10_amplitude=jnp.asarray(-14.0),
         gwb_gamma=jnp.asarray(13.0 / 3.0),
         orf_cholesky=jnp.asarray(np.linalg.cholesky(hellings_downs_matrix(locs))),
