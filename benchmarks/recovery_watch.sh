@@ -1,0 +1,21 @@
+#!/bin/bash
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp, numpy as np
+float(np.asarray(jnp.ones((128,128)) @ jnp.ones((128,128))).sum())
+" >/dev/null 2>&1; then
+    date -u +"%H:%M:%SZ tunnel up, starting battery" >> /tmp/recovery_log.txt
+    timeout 1600 python bench.py > /root/repo/BENCH_RECOVERY_r03.json 2>/tmp/bench_recovery.err
+    date -u +"%H:%M:%SZ bench done rc=$?" >> /tmp/recovery_log.txt
+    timeout 900 python benchmarks/validate_device.py 2000 > /root/repo/VALIDATE_DEVICE_r03.json 2>/tmp/validate_recovery.err
+    date -u +"%H:%M:%SZ validate done rc=$?" >> /tmp/recovery_log.txt
+    timeout 900 python benchmarks/fused_ablation.py 800 5 > /root/repo/ABLATION_r03.json 2>/tmp/ablation_recovery.err
+    date -u +"%H:%M:%SZ ablation done rc=$?" >> /tmp/recovery_log.txt
+    timeout 1200 python benchmarks/cw_scaling.py 5 both > /root/repo/CW_SCALING_r03.json 2>/tmp/cwscale_recovery.err
+    date -u +"%H:%M:%SZ cw_scaling done rc=$?" >> /tmp/recovery_log.txt
+    exit 0
+  fi
+  sleep 180
+done
+date -u +"%H:%M:%SZ gave up waiting" >> /tmp/recovery_log.txt

@@ -189,3 +189,26 @@ def test_materialize_realizations_roundtrip(tmp_path, psrs_small):
             np.testing.assert_allclose(
                 shift_s, want[i, :n], atol=2e-9, rtol=0
             )
+
+
+def test_batch_checkpoint_pre_frequency_format(tmp_path):
+    """Batch checkpoints written before PulsarBatch carried observing
+    frequencies load with freqs_mhz=None (and the chromatic op then
+    raises its actionable error) instead of crashing on the missing key."""
+    import jax
+
+    from pta_replicator_tpu.models import batched as B
+
+    b = synthetic_batch(npsr=2, ntoa=32, nbackend=2, seed=0)
+    p = tmp_path / "b.npz"
+    save_batch(b, str(p))
+    # rewrite the npz without the freqs_mhz array = the old format
+    data = dict(np.load(str(p), allow_pickle=False))
+    data.pop("freqs_mhz")
+    np.savez(str(p), **data)
+
+    back = load_batch(str(p))
+    assert back.freqs_mhz is None
+    np.testing.assert_array_equal(np.asarray(back.toas_s), np.asarray(b.toas_s))
+    with pytest.raises(ValueError, match="freqs_mhz"):
+        B.chromatic_noise_delays(jax.random.PRNGKey(0), back, -13.5, 3.0)
