@@ -24,6 +24,7 @@ namespace {
 constexpr int64_t ERR_OPEN = -1;
 constexpr int64_t DIRECTIVE_FOUND = -2;
 constexpr int64_t ERR_TEXT_OVERFLOW = -3;
+constexpr int64_t ERR_WRITE = -4;  // fwrite/fprintf/fclose failed (e.g. ENOSPC)
 
 struct Reader {
     FILE* f;
@@ -175,7 +176,7 @@ int64_t fast_tim_write(const char* path, int64_t n, const int64_t* mjd_day,
         p = end + 1;
     }
     if (fclose(f) != 0) ok = false;  // flush of buffered data can fail too
-    return ok ? n : ERR_OPEN;
+    return ok ? n : ERR_WRITE;  // distinct from ERR_OPEN: names the failure
 }
 
 }  // extern "C"

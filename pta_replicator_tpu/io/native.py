@@ -28,6 +28,7 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
 ERR_OPEN = -1
 DIRECTIVE_FOUND = -2
 ERR_TEXT_OVERFLOW = -3
+ERR_WRITE = -4
 
 
 def _build_dir() -> str:
@@ -147,5 +148,12 @@ def fast_write_tim(path: str, mjd_day, frac15, text: bytes) -> bool:
         text,
     )
     if got != n:
-        raise OSError(f"native tim write failed for {path} (code {got})")
+        reason = {
+            ERR_OPEN: "could not open for writing",
+            ERR_WRITE: "write or close failed mid-file (disk full?)",
+            ERR_TEXT_OVERFLOW: "malformed pre-rendered line stream",
+        }.get(got, "unknown failure")
+        raise OSError(
+            f"native tim write failed for {path}: {reason} (code {got})"
+        )
     return True

@@ -257,10 +257,22 @@ def _static_line_parts(
         flag_str = "".join(
             f" -{k} {v}" for k, v in (toas.flags[i] if toas.flags else {}).items()
         )
-        pairs.append((
-            f" {label} {toas.freqs_mhz[i]:.8f}",
-            f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}",
-        ))
+        pre = f" {label} {toas.freqs_mhz[i]:.8f}"
+        suf = f"{toas.errors_s[i]*1e6:.10g} {toas.observatories[i]}{flag_str}"
+        # Control characters in metadata would corrupt FORMAT-1 output:
+        # '\n' injects bogus records (the Python fallback would silently
+        # write a malformed line), '\x1f' is the native writer's field
+        # separator (it would abort mid-file, leaving a truncated tim),
+        # '\r' splits lines on round-trip. Fail loudly before any file
+        # byte is written.
+        bad = pre + suf
+        if "\n" in bad or "\x1f" in bad or "\r" in bad:
+            raise ValueError(
+                f"TOA {i}: label/observatory/flag metadata contains a "
+                "control character (\\n, \\r, or \\x1f) that would corrupt "
+                f"the tim file: {bad!r}"
+            )
+        pairs.append((pre, suf))
     if pairs_only:
         return pairs, None
     text = "".join(f"{p}\x1f{s}\n" for p, s in pairs).encode()

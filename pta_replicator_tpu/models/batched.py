@@ -276,8 +276,14 @@ def chromatic_noise_delays(
         idx = idx[..., None]
     # freq <= 0 is the TEMPO convention for infinite-frequency
     # (barycentric) TOAs: the chromatic delay there is exactly zero, not
-    # the inf a naive (ref/0)^idx would inject
-    safe = jnp.maximum(batch.freqs_mhz, jnp.asarray(1e-30, dtype))
+    # the inf a naive (ref/0)^idx would inject. Substitute 1.0 (not a tiny
+    # epsilon) for the untaken branch: (ref/eps)^idx overflows to inf at
+    # f32, and an inf in the untaken where-branch poisons gradients if
+    # this op is ever differentiated (the oracle uses the same 1.0
+    # substitution)
+    safe = jnp.where(
+        batch.freqs_mhz > 0.0, batch.freqs_mhz, jnp.asarray(1.0, dtype)
+    )
     scale = jnp.where(
         batch.freqs_mhz > 0.0,
         (jnp.asarray(ref_freq_mhz, dtype) / safe) ** idx,

@@ -14,6 +14,7 @@ cross-pulsar mix as a single einsum.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import numpy as np
 
@@ -65,9 +66,28 @@ def characteristic_strain(
     f = xp.asarray(f)
     if user_spectrum is not None:
         uf = xp.asarray(user_spectrum[:, 0])
-        # clamp so zero/underflowed strain entries cannot put -inf nodes
-        # into the log-log interpolation (f32 device path)
-        uh = xp.maximum(xp.asarray(user_spectrum[:, 1]), 1e-30)
+        raw = xp.asarray(user_spectrum[:, 1])
+        # Clamp so zero/underflowed strain entries cannot put -inf nodes
+        # into the log-log interpolation (f32 device path). The reference
+        # log-log-extrapolates whatever it is given (red_noise.py:255-263),
+        # so flooring a legitimate ultra-low spectrum is a behavioral
+        # divergence — warn when the floor actually engages. Inside jit
+        # the spectrum is a tracer and cannot be inspected; the warning
+        # fires on the host/oracle path and whenever concrete values
+        # reach this function.
+        try:
+            n_floored = int(np.count_nonzero(np.asarray(raw) < 1e-30))
+        except Exception:  # traced under jit — values not inspectable
+            n_floored = 0
+        if n_floored:
+            warnings.warn(
+                f"user GWB spectrum: {n_floored} strain value(s) below "
+                "1e-30 were floored to 1e-30 for log-log interpolation "
+                "(the reference extrapolates the raw values); rescale "
+                "the spectrum if the ultra-low entries are intentional",
+                stacklevel=2,
+            )
+        uh = xp.maximum(raw, 1e-30)
         logh = xp.interp(xp.log10(f), xp.log10(uf), xp.log10(uh))
         return 10.0**logh
     amp = 10.0**log10_amplitude

@@ -169,3 +169,34 @@ def test_write_tim_roundtrip_real_b1855():
         assert open(p2, "rb").read() == open(p3, "rb").read()
         assert open(p2, "rb").read() != open(
             os.path.join(d, "warm.tim"), "rb").read()  # epochs did change
+
+
+def test_write_tim_rejects_control_characters(tmp_path):
+    """Metadata containing \\n, \\r, or \\x1f must fail loudly before any
+    byte is written — '\\n' forges records in the Python fallback, '\\x1f'
+    is the native writer's field separator (would truncate mid-file)."""
+    toas = fabricate_toas(np.array([53000.0, 53001.0]), error_us=0.5)
+    toas.flags[0]["be"] = "GUP\nPI"
+    out = tmp_path / "bad.tim"
+    with pytest.raises(ValueError, match="control character"):
+        write_tim(toas, str(out))
+    assert not out.exists()
+
+    toas.flags[0]["be"] = "GUP\x1fPI"
+    with pytest.raises(ValueError, match="control character"):
+        write_tim(toas, str(out))
+    assert not out.exists()
+
+
+def test_native_write_error_names_failure(tmp_path):
+    """The native writer distinguishes open failures from mid-write
+    failures (ERR_WRITE=-4) so the surfaced OSError names the cause."""
+    from pta_replicator_tpu.io import native
+
+    if native.load_library() is None:
+        pytest.skip("native toolchain unavailable")
+    assert native.ERR_WRITE == -4
+    day = np.array([53000], dtype=np.int64)
+    f15 = np.array([0], dtype=np.int64)
+    with pytest.raises(OSError, match="could not open"):
+        native.fast_write_tim(str(tmp_path), day, f15, b" a 1\x1fb\n")

@@ -281,3 +281,50 @@ def test_static_delays_uses_f64_host_planes():
     traced = np.asarray(jax.jit(deterministic_delays)(b32, r32))
     rel_traced = np.sqrt(np.mean((traced - oracle) ** 2)) / rms
     assert rel_traced > 10 * rel, (rel_traced, rel)
+
+
+def test_user_spectrum_floor_warns():
+    """Strain entries below the 1e-30 interpolation floor must warn (the
+    reference extrapolates raw values, red_noise.py:255-263 — silent
+    flooring was a behavioral divergence)."""
+    import warnings as _w
+    from pta_replicator_tpu.models.gwb import characteristic_strain
+
+    f = np.logspace(-9, -8, 10)
+    spec_low = np.column_stack([f, np.full(10, 1e-40)])
+    with pytest.warns(UserWarning, match="floored to 1e-30"):
+        hcf = characteristic_strain(f, user_spectrum=spec_low)
+    assert np.all(hcf == pytest.approx(1e-30))
+
+    spec_ok = np.column_stack([f, np.full(10, 1e-15)])
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        characteristic_strain(f, user_spectrum=spec_ok)  # no warning
+
+
+def test_chromatic_noise_gradient_finite():
+    """The freq<=0 where-branch must not poison gradients: an epsilon
+    substitution makes the untaken (ref/eps)^idx branch inf at f32, and
+    inf * 0 = NaN through the where in reverse mode."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+
+    b = synthetic_batch(npsr=2, ntoa=32, seed=7)
+    # force one barycentric (freq <= 0) TOA per pulsar
+    freqs = np.asarray(b.freqs_mhz).copy()
+    freqs[:, 0] = 0.0
+    b = dataclasses.replace(b, freqs_mhz=jnp.asarray(freqs, b.toas_s.dtype))
+    key = jax.random.PRNGKey(3)
+
+    def total(log10_a):
+        d = B.chromatic_noise_delays(
+            key, b, log10_amplitude=log10_a, gamma=3.1, chromatic_index=2.0
+        )
+        return jnp.sum(d**2)
+
+    g = jax.grad(total)(jnp.asarray(-13.5, b.toas_s.dtype))
+    assert bool(jnp.isfinite(g))
