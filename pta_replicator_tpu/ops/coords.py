@@ -59,6 +59,54 @@ def _precession_matrix_j2000_to_b1950() -> np.ndarray:
     return rz(-z) @ ry(theta) @ rz(-zeta)
 
 
+def equatorial_to_ecliptic(ra_rad: float, dec_rad: float, epoch: str = "2000"):
+    """Inverse of :func:`ecliptic_to_equatorial`: equatorial (ra, dec)
+    [rad] to ecliptic (lon, lat) [deg], with the same B-name epoch
+    convention (round-trips exactly)."""
+    v_eq = np.array([
+        np.cos(dec_rad) * np.cos(ra_rad),
+        np.cos(dec_rad) * np.sin(ra_rad),
+        np.sin(dec_rad),
+    ])
+    if str(epoch) == "1950":
+        v_eq = _precession_matrix_j2000_to_b1950().T @ v_eq
+    ce, se = np.cos(OBLIQUITY_J2000), np.sin(OBLIQUITY_J2000)
+    rot = np.array([[1.0, 0.0, 0.0], [0.0, ce, -se], [0.0, se, ce]])
+    v_ecl = rot.T @ v_eq
+    lam = np.arctan2(v_ecl[1], v_ecl[0]) % (2 * np.pi)
+    beta = np.arcsin(np.clip(v_ecl[2], -1.0, 1.0))
+    return float(np.rad2deg(lam)), float(np.rad2deg(beta))
+
+
+def equatorial_to_ecliptic_tangent(ra_rad: float, dec_rad: float):
+    """2x2 rotation taking local tangent-plane components from the
+    equatorial basis (e_ra, e_dec) to the ecliptic basis (e_lon, e_lat)
+    at the given position: ``(u_lon*, u_lat) = R @ (u_ra*, u_dec)``
+    where starred components carry the cos(lat) factor (proper-motion
+    convention). Used to write equatorial-basis fit updates back to
+    ELONG/ELAT/PMELONG/PMELAT pars."""
+    p = np.array([
+        np.cos(dec_rad) * np.cos(ra_rad),
+        np.cos(dec_rad) * np.sin(ra_rad),
+        np.sin(dec_rad),
+    ])
+    zhat = np.array([0.0, 0.0, 1.0])
+    ce, se = np.cos(OBLIQUITY_J2000), np.sin(OBLIQUITY_J2000)
+    n_ecl = np.array([0.0, -se, ce])  # ecliptic north pole, equatorial frame
+
+    def basis(nhat):
+        e1 = np.cross(nhat, p)
+        e1 = e1 / np.linalg.norm(e1)
+        return e1, np.cross(p, e1)
+
+    e_ra, e_dec = basis(zhat)
+    e_lon, e_lat = basis(n_ecl)
+    return np.array([
+        [e_ra @ e_lon, e_dec @ e_lon],
+        [e_ra @ e_lat, e_dec @ e_lat],
+    ])
+
+
 def pulsar_ra_dec(loc: dict, name: str = ""):
     """Equatorial (ra, dec) [rad] from a reference-convention ``loc`` dict.
 

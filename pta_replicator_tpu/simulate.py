@@ -37,7 +37,7 @@ class Residuals:
     def __init__(self, toas: TOAData, model):
         self.time_resids = phase_residuals(
             model, toas.mjd, toas.errors_s, freqs_mhz=toas.freqs_mhz,
-            flags=toas.flags,
+            flags=toas.flags, observatories=toas.observatories,
         )
 
     @property
@@ -102,6 +102,8 @@ class SimulatedPulsar:
         recipe=None,
         psr_index: int = None,
         backend_names=None,
+        niter: int = 1,
+        max_step_halvings: int = 8,
     ) -> None:
         """Refit the timing model post-injection (WLS or GLS).
 
@@ -131,42 +133,100 @@ class SimulatedPulsar:
         """
         if fitter not in ("wls", "gls", "downhill", "auto"):
             raise ValueError(f"fitter={fitter!r} must be one of 'wls', 'gls', 'downhill' or 'auto'")
+        import copy
+
         from .timing.components import full_design_matrix
 
-        self.update_residuals()
-        res = self.residuals.time_resids
-        mjds = self.toas.get_mjds()
-        if params == "spin" or self.par is None:
-            toas_s = ((mjds - self.model.pepoch_mjd) * DAY_IN_SEC).astype(np.float64)
-            M = design_matrix(toas_s, self.model.f0, nspin=nspin)
-            names = ["OFFSET"] + [f"F{k}" for k in range(nspin)]
-        else:
-            include = "auto" if params == "full" else params
-            M, names = full_design_matrix(
-                self.par, mjds, freqs_mhz=self.toas.freqs_mhz,
-                f0=self.model.f0, nspin=nspin, include=include,
-                flags=self.toas.flags,
-            )
-        if fitter in ("wls", "auto"):
-            if recipe is not None or cov is not None:
-                raise ValueError(
-                    "recipe/cov describe a GLS noise covariance; pass "
-                    "fitter='gls' (a WLS fit would silently ignore them)"
-                )
-            p, post = wls_fit(res, self.toas.errors_s, M)
-        else:
-            if cov is None and recipe is not None:
-                from .timing.fit import covariance_from_recipe
+        if cov is None and recipe is not None and fitter not in ("wls", "auto"):
+            from .timing.fit import covariance_from_recipe
 
-                cov = covariance_from_recipe(
-                    self, recipe, psr_index=psr_index,
-                    backend_names=backend_names,
+            cov = covariance_from_recipe(
+                self, recipe, psr_index=psr_index,
+                backend_names=backend_names,
+            )
+
+        # step-acceptance objective: white chi^2 for WLS; the GLS
+        # quadratic form r^T C^-1 r when a covariance is in play (gating
+        # a GLS step on the white chi^2 can reject legitimate steps that
+        # absorb correlated power — PINT's downhill GLS gates on the GLS
+        # objective). The Cholesky factor is computed once per fit call.
+        _gls_factor = None
+        if cov is not None:
+            from scipy.linalg import cho_factor
+
+            _gls_factor = cho_factor(cov)
+
+        def _chi2() -> float:
+            r = self.residuals.time_resids
+            if _gls_factor is not None:
+                from scipy.linalg import cho_solve
+
+                return float(r @ cho_solve(_gls_factor, r))
+            return float(np.sum((r / self.toas.errors_s) ** 2))
+
+        for _ in range(max(1, niter)):
+            self.update_residuals()
+            res = self.residuals.time_resids
+            mjds = self.toas.get_mjds()
+            if params == "spin" or self.par is None:
+                toas_s = ((mjds - self.model.pepoch_mjd) * DAY_IN_SEC).astype(np.float64)
+                M = design_matrix(toas_s, self.model.f0, nspin=nspin)
+                names = ["OFFSET"] + [f"F{k}" for k in range(nspin)]
+            else:
+                include = "auto" if params == "full" else params
+                M, names = full_design_matrix(
+                    self.par, mjds, freqs_mhz=self.toas.freqs_mhz,
+                    f0=self.model.f0, nspin=nspin, include=include,
+                    flags=self.toas.flags,
                 )
-            C = cov if cov is not None else np.diag(self.toas.errors_s**2)
-            p, post = gls_fit(res, C, M)
-        p = np.asarray(p, dtype=np.float64)
-        self.fit_results = dict(zip(names, p))
-        self._apply_fit(dict(zip(names, p)))
+            if fitter in ("wls", "auto"):
+                if recipe is not None or cov is not None:
+                    raise ValueError(
+                        "recipe/cov describe a GLS noise covariance; pass "
+                        "fitter='gls' (a WLS fit would silently ignore them)"
+                    )
+                p, post = wls_fit(res, self.toas.errors_s, M)
+            else:
+                C = cov if cov is not None else np.diag(self.toas.errors_s**2)
+                p, post = gls_fit(res, C, M)
+            p = np.asarray(p, dtype=np.float64)
+            updates = dict(zip(names, p))
+
+            # Damped Newton: the solve is exact for the *linearized*
+            # model, but one full step from a large pre-fit offset can
+            # overshoot on nonlinear parameters (binary, astrometry) and
+            # *increase* chi^2 — PINT's downhill fitters guard the same
+            # way. Halve the step until chi^2 does not get worse; the
+            # last allowed halving is applied unconditionally, so a step
+            # (at SOME scale) is always applied and fit_results always
+            # reflects what was actually written to par/model.
+            chi2_before = _chi2()
+            saved = (
+                copy.deepcopy(self.par),
+                copy.deepcopy(self.model),
+                copy.deepcopy(self.loc),
+            )
+            scale = 1.0
+            for halving in range(max(0, max_step_halvings) + 1):
+                scale = 0.5 ** halving
+                self._apply_fit(
+                    {k: v * scale for k, v in updates.items()}
+                )
+                self.update_residuals()
+                if _chi2() <= chi2_before or halving == max(
+                    0, max_step_halvings
+                ):
+                    break
+                # full rollback: _apply_fit mutates par, model AND (for
+                # ecliptic pars) self.loc — restoring only par/model
+                # would make the next scaled attempt start from the
+                # rejected step's sky position
+                self.par, self.model, self.loc = (
+                    copy.deepcopy(saved[0]),
+                    copy.deepcopy(saved[1]),
+                    copy.deepcopy(saved[2]),
+                )
+            self.fit_results = {k: v * scale for k, v in updates.items()}
         self.update_residuals()
 
     def _apply_fit(self, updates: dict) -> None:
@@ -195,25 +255,86 @@ class SimulatedPulsar:
                 par.set_param("F2", new_spin.f2)
 
             rad2mas = np.degrees(1.0) * 3.6e6
-            if "RAJ" in updates and par.raj_hours is not None:
-                par.set_param("RAJ", par.raj_hours + updates["RAJ"] * 12.0 / np.pi)
-            if "DECJ" in updates and par.decj_deg is not None:
-                par.set_param("DECJ", par.decj_deg + np.degrees(updates["DECJ"]))
-            cosd = np.cos(np.deg2rad(par.decj_deg)) if par.decj_deg is not None else 1.0
-            if "PMRA" in updates:
+            ecliptic_par = (
+                par.raj_hours is None
+                and getattr(par, "elong_deg", None) is not None
+            )
+            if not ecliptic_par:
+                if "RAJ" in updates and par.raj_hours is not None:
+                    par.set_param(
+                        "RAJ", par.raj_hours + updates["RAJ"] * 12.0 / np.pi
+                    )
+                if "DECJ" in updates and par.decj_deg is not None:
+                    par.set_param(
+                        "DECJ", par.decj_deg + np.degrees(updates["DECJ"])
+                    )
+                cosd = (
+                    np.cos(np.deg2rad(par.decj_deg))
+                    if par.decj_deg is not None else 1.0
+                )
+                if "PMRA" in updates:
+                    from .timing.components import _parf
+
+                    par.set_param(
+                        "PMRA", (_parf(par, "PMRA", 0.0) or 0.0)
+                        + updates["PMRA"] * cosd * rad2mas
+                    )
+                if "PMDEC" in updates:
+                    from .timing.components import _parf
+
+                    par.set_param(
+                        "PMDEC", (_parf(par, "PMDEC", 0.0) or 0.0)
+                        + updates["PMDEC"] * rad2mas
+                    )
+            elif any(k in updates for k in ("RAJ", "DECJ", "PMRA", "PMDEC")):
+                # Ecliptic par (every real NANOGrav fixture): the design
+                # matrix reports tangent-plane columns under equatorial
+                # names (timing/components.py full_design_matrix); write
+                # the updates back in the frame the par actually uses —
+                # position via the exact inverse conversion, proper
+                # motion via the local tangent-plane rotation. Silently
+                # dropping them (the pre-round-4 behavior) made fit() a
+                # no-op on sky position for ecliptic pulsars.
+                from .ops.coords import (
+                    equatorial_to_ecliptic,
+                    equatorial_to_ecliptic_tangent,
+                    pulsar_ra_dec,
+                )
                 from .timing.components import _parf
 
-                par.set_param(
-                    "PMRA", (_parf(par, "PMRA", 0.0) or 0.0)
-                    + updates["PMRA"] * cosd * rad2mas
-                )
-            if "PMDEC" in updates:
-                from .timing.components import _parf
-
-                par.set_param(
-                    "PMDEC", (_parf(par, "PMDEC", 0.0) or 0.0)
-                    + updates["PMDEC"] * rad2mas
-                )
+                epoch = "1950" if "B" in (self.name or "") else "2000"
+                ra, dec = pulsar_ra_dec(self.loc, self.name or "")
+                if "RAJ" in updates or "DECJ" in updates:
+                    lon, lat = equatorial_to_ecliptic(
+                        ra + updates.get("RAJ", 0.0),
+                        dec + updates.get("DECJ", 0.0),
+                        epoch=epoch,
+                    )
+                    par.set_param("ELONG", lon)
+                    par.set_param("ELAT", lat)
+                    self.loc = {"ELONG": lon, "ELAT": lat}
+                if "PMRA" in updates or "PMDEC" in updates:
+                    R = equatorial_to_ecliptic_tangent(ra, dec)
+                    cosd = np.cos(dec)
+                    dstar = np.array([
+                        updates.get("PMRA", 0.0) * cosd,
+                        updates.get("PMDEC", 0.0),
+                    ]) * rad2mas
+                    dlon, dlat = R @ dstar
+                    pm_lon_key = (
+                        "PMELONG" if "PMELONG" in par.params else "PMLAMBDA"
+                    )
+                    pm_lat_key = (
+                        "PMELAT" if "PMELAT" in par.params else "PMBETA"
+                    )
+                    par.set_param(
+                        pm_lon_key,
+                        (_parf(par, pm_lon_key, 0.0) or 0.0) + dlon,
+                    )
+                    par.set_param(
+                        pm_lat_key,
+                        (_parf(par, pm_lat_key, 0.0) or 0.0) + dlat,
+                    )
             if "PX" in updates:
                 from .timing.components import _parf
 
@@ -246,9 +367,20 @@ class SimulatedPulsar:
 
             binary = BinaryModel.from_par(par)
             if binary is not None:
+                # physical-domain clamps: one linear Newton step from a
+                # large pre-fit offset can overshoot (e.g. SINI past 1,
+                # which NaNs the Shapiro log on the next evaluation);
+                # later iterations re-solve from the clamped point
+                bounds = {
+                    "SINI": (-1.0 + 1e-9, 1.0 - 1e-9),
+                    "ECC": (0.0, 1.0 - 1e-9),
+                    "M2": (0.0, np.inf),
+                }
                 for nm in binary.fit_param_names():
                     if nm in updates:
-                        par.set_param(nm, binary.get(nm) + updates[nm])
+                        new = binary.get(nm) + updates[nm]
+                        lo, hi = bounds.get(nm, (-np.inf, np.inf))
+                        par.set_param(nm, min(max(new, lo), hi))
             # rebuild the full model from the updated par (keeps binary/
             # DM/astrometry in sync with what write_partim persists)
             self.model = TimingModel.from_par(par)
@@ -429,6 +561,7 @@ def make_ideal(psr: SimulatedPulsar, iterations: int = 2) -> None:
         res = phase_residuals(
             psr.model, psr.toas.mjd, psr.toas.errors_s,
             freqs_mhz=psr.toas.freqs_mhz, flags=psr.toas.flags,
+            observatories=psr.toas.observatories,
         )
         psr.toas.adjust_seconds(-res)
     psr.added_signals = {}

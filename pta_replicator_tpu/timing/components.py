@@ -11,19 +11,32 @@ signal power are implemented here directly:
   DD Shapiro). Both NANOGrav fixture binaries (B1855+09, J1909-3744) are
   ELL1.
 * **dispersion** — K * DM(t) / f^2 against the per-TOA radio frequency.
-* **astrometry** — Roemer delay against an *analytic* low-precision Earth
-  orbit (Meeus-style mean elements; no solar-system ephemeris dependency).
+* **astrometry** — Roemer delay (position, proper motion, parallax)
+  against an *analytic* low-precision Earth orbit (Meeus-style mean
+  elements; no solar-system ephemeris dependency), plus the topocentric
+  Earth-rotation term and UTC->TDB time-scale chain (timing.time_scales).
 
 Accuracy stance (documented, deliberate): the Earth orbit is good to
 ~1e-4 AU, so absolute astrometric delays carry ~10 ms error — far from
 PINT's ns-level barycentering, and *not* sufficient to reproduce PINT's
-pre-fit residuals on real data (that requires a numerical ephemeris).
+pre-fit residuals on real data (that requires a numerical ephemeris,
+whose DE440 data files are unavailable in this build environment).
 What the synthesis framework needs is the design-matrix *column space*:
 annual/semi-annual astrometric signatures, binary-orbital harmonics, and
 1/f^2 dispersion trends with the correct time/frequency dependence, so a
 post-injection refit absorbs the same signal power the reference's PINT
 refit does. Binary and dispersion delays are exact closed forms (binary
 phases referenced to topocentric TOAs, a ~5e-4-cycle approximation).
+
+Measured bound (tests/test_timing_fidelity.py, real B1855+09 data —
+7,758 TOAs, 166 active columns incl. 147 DMX windows, ELL1+Shapiro
+binary, FD, flag-matched JUMP): perturbing 21 parameters spanning every
+family by +3 of PINT's own published uncertainties and refitting
+recovers each to better than 0.06 sigma (median 3e-4 sigma), with
+post-fit residuals at 0.16 ns RMS. The Earth-rotation geometry is
+anchored externally: hour angles implied by GMST + Arecibo ITRF
+coordinates on the real observing epochs land inside the dish's
+physical +-20 deg zenith window.
 
 All functions are xp-agnostic (numpy oracle / jax.numpy device path).
 """
@@ -174,7 +187,12 @@ class BinaryModel:
             shapiro = 0.0
             if self.m2_msun and self.sini:
                 r = TSUN_S * self.m2_msun
-                shapiro = -2.0 * r * xp.log(1.0 - self.sini * xp.sin(phi))
+                # floor the log argument: a fit iterate or Jacobian step
+                # on a near-edge-on binary (SINI -> 1) can push it to or
+                # past zero, and one NaN here poisons the whole fit
+                shapiro = -2.0 * r * xp.log(
+                    xp.maximum(1.0 - self.sini * xp.sin(phi), 1e-12)
+                )
             return roemer + shapiro
 
         # BT / DD
@@ -197,8 +215,12 @@ class BinaryModel:
         if self.m2_msun and self.sini:
             r = TSUN_S * self.m2_msun
             shapiro = -2.0 * r * xp.log(
-                1.0 - e * cE
-                - self.sini * (xp.sin(om) * (cE - e) + xp.cos(om) * sE * se)
+                xp.maximum(
+                    1.0 - e * cE
+                    - self.sini
+                    * (xp.sin(om) * (cE - e) + xp.cos(om) * sE * se),
+                    1e-12,
+                )
             )
         return roemer + einstein + shapiro
 

@@ -70,6 +70,7 @@ def phase_residuals(
     subtract_mean: bool = True,
     freqs_mhz: np.ndarray = None,
     flags=None,
+    observatories=None,
 ) -> np.ndarray:
     """Phase-wrapped time residuals [s] of TOAs against a timing model.
 
@@ -79,14 +80,25 @@ def phase_residuals(
     /root/reference/pta_replicator/simulate.py:40-42.
 
     ``model`` is a :class:`SpindownTiming` or a :class:`TimingModel`; for
-    the latter, the spin phase is evaluated at the delay-corrected
-    emission time (binary/dispersion/astrometric delays subtracted, with
-    ``freqs_mhz`` feeding the dispersion term).
+    the latter, the spin phase is evaluated in TDB at the delay-corrected
+    emission time (binary/dispersion/astrometric/topocentric delays
+    subtracted, with ``freqs_mhz`` feeding the dispersion term and
+    ``observatories`` the Earth-rotation geometry). The bare
+    :class:`SpindownTiming` path keeps raw epochs (no sky location, no
+    delay model — absolute time-scale offsets cancel in make_ideal).
     """
     mjd = np.asarray(mjd_ld, dtype=np.longdouble)
     if hasattr(model, "delays_s"):
-        d = model.delays_s(np.asarray(mjd_ld, dtype=np.float64),
-                           freqs_mhz=freqs_mhz, flags=flags)
+        from .time_scales import tdb_minus_utc
+
+        t_utc = np.asarray(mjd_ld, dtype=np.float64)
+        # phase is a TDB-side quantity (par UNITS TDB); the conversion is
+        # applied in longdouble so the ~69 s offset does not cost epoch
+        # precision
+        off_s = tdb_minus_utc(t_utc)
+        mjd = mjd + (off_s / DAY_IN_SEC).astype(np.longdouble)
+        d = model.delays_s(t_utc, freqs_mhz=freqs_mhz, flags=flags,
+                           observatories=observatories, tdb_offset_s=off_s)
         if d is not None:
             mjd = mjd - np.asarray(d, dtype=np.float64) / DAY_IN_SEC
     phase = model.phase(mjd)
@@ -120,6 +132,13 @@ class TimingModel:
     ra_rad: float = None
     dec_rad: float = None
     include_roemer: bool = True
+    #: d(nhat)/dt [rad/yr] in the equatorial frame (proper motion); None
+    #: when the par declares no PM. Mirrors astrometry_columns' PM
+    #: columns so fitted PM values feed back into the forward model.
+    pm_vec_rad_yr: tuple = None
+    #: parallax [rad] (annual-curvature delay term, astrometry_columns)
+    px_rad: float = 0.0
+    posepoch_mjd: float = 0.0
     #: flag-matched JUMP offsets: ((flag_name, flag_value, offset_s), ...)
     #: — the reference's PINT model fits these on every real NANOGrav
     #: fixture (e.g. test_partim/par/B1855+09.par "JUMP -fe L-wide")
@@ -155,7 +174,10 @@ class TimingModel:
 
     @classmethod
     def from_par(cls, par) -> "TimingModel":
-        from ..ops.coords import pulsar_ra_dec
+        from ..ops.coords import (
+            equatorial_to_ecliptic_tangent,
+            pulsar_ra_dec,
+        )
         from .components import BinaryModel, _parf
 
         ra = dec = None
@@ -163,7 +185,50 @@ class TimingModel:
             ra, dec = pulsar_ra_dec(par.loc, par.name)
         except AttributeError:  # no sky location in the par file
             pass
+        # Proper motion / parallax: par values [mas/yr, mas] -> the
+        # equatorial-frame quantities the delay evaluation uses (ecliptic
+        # PM components rotate through the same local tangent-plane
+        # rotation _apply_fit writes them back with)
+        pm_vec = None
+        px_rad = 0.0
+        posepoch = 0.0
+        if ra is not None:
+            mas2rad = np.deg2rad(1.0) / 3.6e6
+            pm_star = None  # (mu_alpha*, mu_delta) [rad/yr]
+            if "PMRA" in par.params or "PMDEC" in par.params:
+                pm_star = np.array([
+                    (_parf(par, "PMRA", 0.0) or 0.0),
+                    (_parf(par, "PMDEC", 0.0) or 0.0),
+                ]) * mas2rad
+            elif any(
+                k in par.params
+                for k in ("PMELONG", "PMELAT", "PMLAMBDA", "PMBETA")
+            ):
+                pm_ecl = np.array([
+                    (_parf(par, "PMELONG", None)
+                     or _parf(par, "PMLAMBDA", 0.0) or 0.0),
+                    (_parf(par, "PMELAT", None)
+                     or _parf(par, "PMBETA", 0.0) or 0.0),
+                ]) * mas2rad
+                R = equatorial_to_ecliptic_tangent(ra, dec)
+                pm_star = R.T @ pm_ecl  # orthonormal: inverse = transpose
+            if pm_star is not None and np.any(pm_star):
+                ca, sa = np.cos(ra), np.sin(ra)
+                cd, sd = np.cos(dec), np.sin(dec)
+                dn_da = np.array([-sa * cd, ca * cd, 0.0])
+                dn_dd = np.array([-ca * sd, -sa * sd, cd])
+                # mu_alpha* carries cos(dec); dn_da is d(nhat)/d(ra)
+                # whose norm is cos(dec) — so dn/dt = mu_alpha*/cd * dn_da
+                # + mu_delta * dn_dd
+                v = pm_star[0] / cd * dn_da + pm_star[1] * dn_dd
+                pm_vec = tuple(float(x) for x in v)
+            px_rad = ((_parf(par, "PX", 0.0) or 0.0)) * mas2rad
+            pepoch0 = par.pepoch_mjd or 0.0
+            posepoch = _parf(par, "POSEPOCH", pepoch0) or pepoch0
         return cls(
+            pm_vec_rad_yr=pm_vec,
+            px_rad=px_rad,
+            posepoch_mjd=posepoch,
             spin=SpindownTiming.from_par(par),
             binary=BinaryModel.from_par(par),
             dm=par.dm,
@@ -176,17 +241,36 @@ class TimingModel:
             dmx=tuple(tuple(w) for w in getattr(par, "dmx_windows", ())),
         )
 
-    def delays_s(self, t_mjd: np.ndarray, freqs_mhz=None, flags=None):
-        """Total model delay [s] at the given (topocentric) MJD epochs.
+    def delays_s(
+        self, t_mjd: np.ndarray, freqs_mhz=None, flags=None,
+        observatories=None, tdb_offset_s=None,
+    ):
+        """Total model delay [s] at the given (topocentric UTC) MJD epochs.
 
         ``flags``: per-TOA flag dicts (TOAData.flags) — required for the
         JUMP component to land on its flag-matched TOAs; without them
         jumps contribute nothing (they then cancel in make_ideal like
         every other absolute term).
+
+        ``observatories``: per-TOA site codes (TOAData.observatories) —
+        enables the topocentric Roemer term (Earth-rotation diurnal
+        geometry, up to ~21 ms; time_scales.observatory_position_au).
+        Unknown codes (fabricated 'AXIS' TOAs, barycentric '@') fall
+        back to the geocenter, the pre-round-4 behavior.
+
+        Time scales: epochs arrive as UTC (tim convention); orbital /
+        dispersion-trend / DMX-window / Earth-orbit evaluation uses TDB
+        (par convention, UNITS TDB) via time_scales.tdb_minus_utc, while
+        the Earth-rotation angle uses UTC (~UT1).
         """
         from .components import AU_S, dispersion_delay, earth_position_au
 
         t = np.asarray(t_mjd, dtype=np.float64)
+        if tdb_offset_s is None:  # phase_residuals precomputes and passes it
+            from .time_scales import tdb_minus_utc
+
+            tdb_offset_s = tdb_minus_utc(t)
+        t_tdb = t + np.asarray(tdb_offset_s) / DAY_IN_SEC
         total = np.zeros_like(t)
         if self.jumps and flags is not None:
             from .components import jump_mask
@@ -194,10 +278,10 @@ class TimingModel:
             for name, value, offset in self.jumps:
                 total = total + offset * jump_mask(flags, name, value)
         if self.binary is not None and self.binary.pb_days:
-            total = total + self.binary.delay_s(t)
+            total = total + self.binary.delay_s(t_tdb)
         if self.dm and freqs_mhz is not None:
             total = total + dispersion_delay(
-                freqs_mhz, self.dm, dm1=self.dm1, t_mjd=t,
+                freqs_mhz, self.dm, dm1=self.dm1, t_mjd=t_tdb,
                 dmepoch_mjd=self.dmepoch_mjd,
             )
         if self.dmx and freqs_mhz is not None:
@@ -209,9 +293,9 @@ class TimingModel:
             starts = np.asarray([w[2] for w in self.dmx])
             ends = np.asarray([w[3] for w in self.dmx])
             vals = np.asarray([w[1] for w in self.dmx])
-            idx = np.searchsorted(starts, t, side="right") - 1
+            idx = np.searchsorted(starts, t_tdb, side="right") - 1
             idx_c = np.clip(idx, 0, len(self.dmx) - 1)
-            inside = (idx >= 0) & (t <= ends[idx_c])
+            inside = (idx >= 0) & (t_tdb <= ends[idx_c])
             dmx_t = np.where(inside, vals[idx_c], 0.0)
             total = total + dmx_t / (K_DM * np.asarray(freqs_mhz) ** 2)
         if self.fd and freqs_mhz is not None:
@@ -220,9 +304,25 @@ class TimingModel:
             for k, coeff in enumerate(self.fd, start=1):
                 total = total + coeff * fd_column(freqs_mhz, k)
         if self.include_roemer and self.ra_rad is not None:
-            r = earth_position_au(t)
+            from .components import YEAR_DAYS
+
+            r = earth_position_au(t_tdb)
+            if observatories is not None:
+                from .time_scales import observatory_position_au
+
+                r = r + observatory_position_au(t, observatories)
             ca, sa = np.cos(self.ra_rad), np.sin(self.ra_rad)
             cd, sd = np.cos(self.dec_rad), np.sin(self.dec_rad)
             nhat = np.array([ca * cd, sa * cd, sd])
-            total = total - (r @ nhat) * AU_S
+            rn = r @ nhat
+            if self.pm_vec_rad_yr is not None:
+                tau = (t_tdb - self.posepoch_mjd) / YEAR_DAYS
+                rn = rn + (r @ np.asarray(self.pm_vec_rad_yr)) * tau
+            total = total - rn * AU_S
+            if self.px_rad:
+                # annual-curvature parallax term (astrometry_columns'
+                # PX column times the par value)
+                total = total + self.px_rad * 0.5 * (
+                    np.sum(r * r, axis=-1) - (r @ nhat) ** 2
+                ) * AU_S
         return total if total.any() else None
