@@ -1,0 +1,16 @@
+#!/bin/bash
+# Stage-2 watcher: once the main r04 battery has produced its last
+# artifact (CW_SCALING_r04.json), run the large kill/resume sweep
+# rehearsal on the chip (VERDICT r3 item 6). Separate from
+# recovery_watch_r04.sh so editing this never perturbs the running
+# stage-1 script.
+cd /root/repo
+for i in $(seq 1 400); do
+  if [ -s /root/repo/CW_SCALING_r04.json ]; then
+    date -u +"%H:%M:%SZ battery artifacts present, starting sweep rehearsal" >> /tmp/recovery_log_r04.txt
+    timeout 3000 python benchmarks/sweep_kill_resume.py 1000000 800 > /root/repo/SWEEP_RESUME_r04.json 2>/tmp/sweep_r04.err
+    date -u +"%H:%M:%SZ sweep rehearsal done rc=$?" >> /tmp/recovery_log_r04.txt
+    exit 0
+  fi
+  sleep 120
+done

@@ -62,6 +62,7 @@ def build_workload():
 def main():
     port, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
     n_psr = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+    n_proc = int(sys.argv[5]) if len(sys.argv) > 5 else 2
 
     import jax
 
@@ -78,11 +79,11 @@ def main():
 
     topo = distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
-        num_processes=2,
+        num_processes=n_proc,
         process_id=pid,
     )
-    assert topo["process_count"] == 2, topo
-    assert topo["local_device_count"] == 4, topo
+    assert topo["process_count"] == n_proc, topo
+    assert topo["local_device_count"] == 8 // n_proc, topo
     assert topo["global_device_count"] == 8, topo
 
     # identical workload on every process (the SPMD contract)
@@ -108,6 +109,10 @@ if __name__ == "__main__":
     # env must be set before the first jax import IN THE WORKER ONLY:
     # at module level these would leak into the pytest process when the
     # parent imports build_workload, clobbering conftest's 8-device setup
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # (8 global devices split evenly across however many processes)
+    _n_proc = int(sys.argv[5]) if len(sys.argv) > 5 else 2
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={8 // _n_proc}"
+    )
     os.environ["JAX_PLATFORMS"] = "cpu"
     main()
