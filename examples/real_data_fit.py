@@ -1,0 +1,75 @@
+"""Real-data walkthrough: load a NANOGrav pulsar, inject, refit, persist.
+
+Exercises the standalone timing engine on the real 7,758-TOA B1855+09
+fixture (ecliptic astrometry, ELL1+Shapiro binary, 147 DMX windows, FD
+terms, a flag-matched JUMP): make_ideal to sub-ns, inject signals,
+refit the FULL model with the damped iterated WLS solver, optionally arm
+a WAVE harmonic-whitening basis, and write the fitted par/tim pair back
+out (loadable by PINT/tempo2/enterprise downstream).
+
+Run:  python examples/real_data_fit.py [outdir]
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+import pta_replicator_tpu as ptr
+
+PAR = "/root/reference/test_partim/par/B1855+09.par"
+TIM = "/root/reference/test_partim/tim/B1855+09.tim"
+
+
+def main(outdir=None):
+    psr = ptr.load_pulsar(PAR, TIM)
+    print(f"{psr.name}: {psr.toas.ntoas} TOAs, loc keys {sorted(psr.loc)}")
+
+    ptr.make_ideal(psr)
+    rms = float(np.std(psr.residuals.resids_value))
+    print(f"after make_ideal: residual RMS {rms*1e9:.3f} ns")
+
+    # inject a realistic noise stack (per-backend values would come from
+    # a noise dict; scalars keep the walkthrough readable)
+    ptr.add_measurement_noise(psr, efac=1.1, seed=11)
+    ptr.add_red_noise(psr, -13.8, 3.2, components=30, seed=12)
+    print(f"after injection: residual RMS "
+          f"{np.std(psr.residuals.resids_value)*1e6:.3f} us")
+
+    # full-model damped refit: spin + ecliptic astrometry (incl. PM/PX)
+    # + DMX + FD + JUMP + binary, iterated to convergence
+    psr.fit(fitter="wls", niter=3)
+    print(f"after full-model refit: residual RMS "
+          f"{np.std(psr.residuals.resids_value)*1e6:.3f} us")
+    moved = {
+        k: v for k, v in sorted(
+            psr.fit_results.items(), key=lambda kv: -abs(kv[1])
+        )[:5]
+    }
+    print(f"largest fitted corrections: { {k: f'{v:.3e}' for k, v in moved.items()} }")
+
+    # optional: arm a WAVE harmonic-whitening basis (tempo2/PINT WAVE
+    # model) so a further fit can absorb smooth unmodeled structure
+    mjds = psr.toas.get_mjds().astype(np.float64)
+    span = float(mjds.max() - mjds.min())
+    psr.par.ensure_waves(10, om=2 * np.pi / (1.05 * span),
+                         epoch=float(mjds.min()))
+    psr.model = type(psr.model).from_par(psr.par)
+    psr.fit(fitter="wls", niter=2)
+    print(f"after WAVE-whitened refit: residual RMS "
+          f"{np.std(psr.residuals.resids_value)*1e6:.3f} us; "
+          f"wave3 amplitudes {psr.par.waves[2]}")
+
+    # persist the fitted dataset — the par keeps every original line
+    # (DMX windows, JUMP, binary) plus the fitted values and WAVE terms
+    d = outdir or tempfile.mkdtemp(prefix="b1855_fit_")
+    psr.write_partim(os.path.join(d, "B1855+09_fit.par"),
+                     os.path.join(d, "B1855+09_fit.tim"))
+    back = ptr.load_pulsar(os.path.join(d, "B1855+09_fit.par"),
+                           os.path.join(d, "B1855+09_fit.tim"))
+    print(f"round-trip: {back.toas.ntoas} TOAs, "
+          f"{len(back.par.waves)} WAVE terms, wrote to {d}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -23,3 +23,5 @@ MPC2S = _sc.parsec / _sc.c * 1e6
 C_MS = _sc.c
 PC_M = _sc.parsec
 MSUN_KG = 1.98855e30
+#: astronomical unit in parsec (solar-wind dispersion geometry)
+AU_PC = _sc.au / _sc.parsec

@@ -155,6 +155,93 @@ class ParModel:
                 return
         raise IndexError(f"par file has no flag-matched JUMP #{index}")
 
+    # ----------------------------------------------------------- WAVE model
+    @property
+    def wave_om(self):
+        """WAVE fundamental frequency [rad/day], or None when the par
+        declares no waves (tempo2/PINT harmonic-whitening model)."""
+        if "WAVE_OM" in self.params:
+            try:
+                return _parse_float(self.params["WAVE_OM"][0])
+            except ValueError:
+                return None
+        return None
+
+    @property
+    def wave_epoch(self):
+        """WAVEEPOCH [MJD] (PEPOCH when absent, the tempo2 default)."""
+        for key in ("WAVEEPOCH", "WAVE_EPOCH"):
+            if key in self.params:
+                try:
+                    return _parse_float(self.params[key][0])
+                except ValueError:
+                    pass
+        return self.pepoch_mjd
+
+    @property
+    def waves(self):
+        """[(A_sin, B_cos), ...] for WAVE1..WAVEn [s]: harmonic k of
+        WAVE_OM contributes A sin(k om (t - epoch)) + B cos(...). Two
+        values share one ``WAVEk`` line, so (like JUMPs) these parse from
+        the verbatim line store, not ``params``."""
+        by_k = {}
+        for line in self.lines:
+            tokens = line.split()
+            if len(tokens) >= 3 and tokens[0].upper().startswith("WAVE"):
+                tail = tokens[0][4:]
+                if tail.isdigit():
+                    try:
+                        by_k[int(tail)] = (
+                            _parse_float(tokens[1]), _parse_float(tokens[2])
+                        )
+                    except ValueError:
+                        pass
+        if not by_k:
+            return []
+        # a numbering gap (hand-edited par) becomes a zero-amplitude
+        # placeholder rather than silently truncating every higher
+        # harmonic out of the model/fit/write-back
+        return [by_k.get(k, (0.0, 0.0)) for k in range(1, max(by_k) + 1)]
+
+    def set_wave(self, index: int, a_sin: float, b_cos: float) -> None:
+        """Update (or append) the ``WAVE{index+1}`` harmonic amplitudes."""
+        key = f"WAVE{index + 1}"
+        text = f"{format(a_sin, '.20g')} {format(b_cos, '.20g')}"
+        for i, line in enumerate(self.lines):
+            tokens = line.split()
+            if tokens and tokens[0].upper() == key:
+                self.lines[i] = f"{key}\t\t{text}"
+                return
+        self.lines.append(f"{key}\t\t{text}")
+
+    def ensure_waves(self, n: int, om: float = None, epoch: float = None):
+        """Declare ``n`` zero-amplitude WAVE harmonics (adding WAVE_OM /
+        WAVEEPOCH when absent) so a fit can use the harmonic-whitening
+        columns as a nuisance basis on models that had none.
+
+        ``om`` [rad/day] is required when the par has no WAVE_OM
+        (2*pi/(1.05*span_days) is the usual choice); when the par
+        already declares WAVE_OM, a conflicting ``om`` raises instead of
+        silently keeping the old basis under the caller's nose."""
+        existing = self.wave_om
+        if existing is None:
+            if om is None:
+                raise ValueError(
+                    "par has no WAVE_OM; pass om=2*pi/span_days explicitly"
+                )
+            self.set_param("WAVE_OM", om)
+        elif om is not None and abs(om - existing) > 1e-12 * abs(existing):
+            raise ValueError(
+                f"par already declares WAVE_OM={existing!r}; refusing to "
+                f"rebase the existing harmonics onto om={om!r} (drop the "
+                "om argument to extend the existing basis)"
+            )
+        if epoch is not None:
+            self.set_param("WAVEEPOCH", epoch)
+        have = len(self.waves)
+        for k in range(have, n):
+            self.set_wave(k, 0.0, 0.0)
+
     @property
     def fd_terms(self):
         """[FD1, FD2, ...] profile-evolution coefficients [s], in order.

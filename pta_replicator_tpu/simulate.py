@@ -362,6 +362,13 @@ class SimulatedPulsar:
                 nm = f"JUMP{k + 1}"
                 if nm in updates:
                     par.set_jump(k, offset + updates[nm])
+            # WAVE harmonic amplitudes: two values per par line
+            waves = par.waves
+            for k, (a, b) in enumerate(waves):
+                da = updates.get(f"WAVE{k + 1}_SIN", 0.0)
+                db = updates.get(f"WAVE{k + 1}_COS", 0.0)
+                if da or db:
+                    par.set_wave(k, a + da, b + db)
             # binary parameters: numerical-derivative columns, += convention
             from .timing.components import BinaryModel
 

@@ -246,12 +246,20 @@ def dispersion_delay(
 # -------------------------------------------------------------- astrometry
 
 def earth_position_au(t_mjd, xp=np):
-    """Analytic geocentric->SSB Earth position [AU], equatorial frame.
+    """Analytic HELIOCENTRIC (Sun->Earth) position [AU], equatorial frame.
 
     Low-precision mean-element series (Meeus, Astronomical Algorithms
     ch. 25 truncation): good to ~1e-4 AU — sufficient for design-matrix
     columns (annual/semiannual signatures), NOT for ns-level
-    barycentering (see module docstring).
+    barycentering (see module docstring). NOTE the frame origin: this is
+    the SUN, not the SSB (they differ by the ~0.008 AU solar wobble).
+    The Roemer/parallax terms only pick up that wobble as part of the
+    documented ~1e-4-AU-class error, but the solar Shapiro and
+    solar-wind terms in TimingModel.delays_s REQUIRE the heliocentric
+    origin (their geometry degenerates near solar conjunction, where the
+    Sun-vs-SSB distinction is larger than the impact parameter) — do
+    not "upgrade" this function to true barycentric without giving
+    those terms their own solar vector.
     """
     n = xp.asarray(t_mjd) - 51544.5
     L = xp.deg2rad(280.460 + 0.9856474 * n)
@@ -452,6 +460,20 @@ def full_design_matrix(
         for k in range(1, len(getattr(par, "fd_terms", ())) + 1):
             cols.append(fd_column(freqs_mhz, k, xp=xp))
             names.append(f"FD{k}")
+
+    # WAVE harmonic-whitening columns (tempo2/PINT model; also the
+    # nuisance basis par.ensure_waves arms for absorbing smooth
+    # unmodeled structure): d(delay)/d(WAVEk) = sin/cos(k om (t-epoch))
+    wave_om = getattr(par, "wave_om", None)
+    nwave = len(getattr(par, "waves", ()))
+    if wave_om and nwave:
+        wave_epoch = getattr(par, "wave_epoch", pepoch) or pepoch
+        ph = wave_om * (xp.asarray(t) - wave_epoch)
+        for k in range(1, nwave + 1):
+            cols.append(xp.sin(k * ph))
+            names.append(f"WAVE{k}_SIN")
+            cols.append(xp.cos(k * ph))
+            names.append(f"WAVE{k}_COS")
 
     binary = BinaryModel.from_par(par)
     if binary is not None and binary.pb_days:

@@ -148,6 +148,16 @@ class TimingModel:
     fd: tuple = ()
     #: NANOGrav DMX dispersion windows: ((label, dmx, r1_mjd, r2_mjd), ...)
     dmx: tuple = ()
+    #: tempo2/PINT WAVE harmonic-whitening model: fundamental [rad/day],
+    #: reference epoch [MJD], ((A_sin, B_cos), ...) per harmonic [s]
+    wave_om: float = 0.0
+    wave_epoch_mjd: float = 0.0
+    waves: tuple = ()
+    #: solar-wind electron density at 1 AU [cm^-3] (par NE_SW; 0 = off)
+    ne_sw: float = 0.0
+    #: solar Shapiro delay (always on in tempo/PINT when a sky location
+    #: exists; µs-scale, peaks at solar conjunction)
+    include_solar_shapiro: bool = True
 
     # -- SpindownTiming-compatible surface (existing call sites)
     @property
@@ -239,6 +249,10 @@ class TimingModel:
             jumps=tuple(tuple(j) for j in getattr(par, "jumps", ())),
             fd=tuple(getattr(par, "fd_terms", ())),
             dmx=tuple(tuple(w) for w in getattr(par, "dmx_windows", ())),
+            wave_om=getattr(par, "wave_om", None) or 0.0,
+            wave_epoch_mjd=getattr(par, "wave_epoch", 0.0) or 0.0,
+            waves=tuple(tuple(w) for w in getattr(par, "waves", ())),
+            ne_sw=_parf(par, "NE_SW", 0.0) or 0.0,
         )
 
     def delays_s(
@@ -303,6 +317,13 @@ class TimingModel:
 
             for k, coeff in enumerate(self.fd, start=1):
                 total = total + coeff * fd_column(freqs_mhz, k)
+        if self.waves and self.wave_om:
+            # tempo2/PINT WAVE harmonic-whitening: sum_k A_k sin(k om
+            # (t - epoch)) + B_k cos(...) [s]
+            ph = self.wave_om * (t_tdb - self.wave_epoch_mjd)
+            for k, (a, b) in enumerate(self.waves, start=1):
+                if a or b:
+                    total = total + a * np.sin(k * ph) + b * np.cos(k * ph)
         if self.include_roemer and self.ra_rad is not None:
             from .components import YEAR_DAYS
 
@@ -314,15 +335,49 @@ class TimingModel:
             ca, sa = np.cos(self.ra_rad), np.sin(self.ra_rad)
             cd, sd = np.cos(self.dec_rad), np.sin(self.dec_rad)
             nhat = np.array([ca * cd, sa * cd, sd])
-            rn = r @ nhat
+            rsq = np.sum(r * r, axis=-1)
+            rn0 = r @ nhat  # shared by Roemer, parallax, and solar terms
+            rn = rn0
             if self.pm_vec_rad_yr is not None:
                 tau = (t_tdb - self.posepoch_mjd) / YEAR_DAYS
-                rn = rn + (r @ np.asarray(self.pm_vec_rad_yr)) * tau
+                rn = rn0 + (r @ np.asarray(self.pm_vec_rad_yr)) * tau
             total = total - rn * AU_S
             if self.px_rad:
                 # annual-curvature parallax term (astrometry_columns'
                 # PX column times the par value)
                 total = total + self.px_rad * 0.5 * (
-                    np.sum(r * r, axis=-1) - (r @ nhat) ** 2
+                    rsq - rn0**2
                 ) * AU_S
+            rmag = np.sqrt(rsq)
+            # both solar terms need the heliocentric geometry: r from
+            # earth_position_au is Sun->Earth (see its docstring — NOT
+            # the SSB; the distinction is load-bearing near conjunction)
+            if self.include_solar_shapiro:
+                from .components import TSUN_S
+
+                # solar Shapiro: -2 Tsun ln(|r| + r.nhat) [r in AU; the
+                # log's unit constant is an absolute offset, absorbed].
+                # Diverges toward solar conjunction (rn -> -|r|); the
+                # floor caps it at the Sun's limb scale (~5e-3 AU)
+                total = total - 2.0 * TSUN_S * np.log(
+                    np.maximum(rmag + rn0, 5e-3)
+                )
+            if self.ne_sw and freqs_mhz is not None:
+                from ..constants import AU_PC
+                from .components import K_DM
+
+                # solar-wind dispersion, n_e(r) = NE_SW (AU/r)^2:
+                # DM = NE_SW * AU_pc * (pi - psi)/(|r| sin psi), psi the
+                # Sun-Earth-pulsar elongation (tempo2/PINT closed form).
+                # The divergence floor is the same solar-limb impact
+                # parameter (~5e-3 AU) the Shapiro term uses — a smaller
+                # floor would let a LOS through the Sun's disk inject an
+                # unphysical ~0.3 s spike
+                cpsi = np.clip(-rn0 / np.maximum(rmag, 1e-9), -1.0, 1.0)
+                psi = np.arccos(cpsi)
+                dm_sw = (
+                    self.ne_sw * AU_PC * (np.pi - psi)
+                    / (np.maximum(rmag * np.sin(psi), 5e-3))
+                )
+                total = total + dm_sw / (K_DM * np.asarray(freqs_mhz) ** 2)
         return total if total.any() else None
