@@ -78,18 +78,28 @@ def noise_covariance(
     toas_s=None,
     rn_nmodes: int = 30,
     tspan_s=None,
+    chrom_log10_amplitude=None,
+    chrom_gamma=None,
+    chrom_index: float = 2.0,
+    chrom_nmodes: int = 30,
+    chrom_ref_freq_mhz: float = 1400.0,
+    freqs_mhz=None,
     xp=np,
 ):
     """Assemble the dense GLS noise covariance the reference gets from
     PINT's GLSFitter (simulate.py:57-61):
 
         C = diag((EFAC sigma)^2 + EQUAD^2) + U diag(ECORR^2) U^T
-            + F Phi(A, gamma) F^T
+            + F Phi(A, gamma) F^T  [+ S F Phi_chrom F^T S, chromatic]
 
     ``efac``/``equad_s`` are scalars or per-TOA vectors; ``ecorr_s`` is a
     scalar or per-epoch vector with ``epoch_index`` mapping TOAs to
     epochs (ops.quantize / PulsarBatch.epoch_index); the red-noise term
-    uses the rank-reduced Fourier basis on ``toas_s``.
+    uses the rank-reduced Fourier basis on ``toas_s``. The chromatic
+    term (the beyond-reference DM-noise family, add_chromatic_noise) is
+    the same basis left/right-scaled by the per-TOA
+    ``(ref/freq)^chrom_index`` diagonal S — GLS weighting must include
+    it for recipes that inject it.
     """
     sigma = xp.asarray(errors_s)
     n = sigma.shape[-1]
@@ -123,6 +133,36 @@ def noise_covariance(
             xp.repeat(f, 2), rn_log10_amplitude, rn_gamma, T, xp=xp
         )
         C = C + (F * phi[None, :]) @ F.T
+
+    if chrom_log10_amplitude is not None:
+        if toas_s is None or freqs_mhz is None:
+            raise ValueError(
+                "chromatic covariance needs toas_s and freqs_mhz"
+            )
+        from ..ops.fourier import (
+            fourier_basis,
+            fourier_frequencies,
+            powerlaw_prior,
+        )
+
+        t = xp.asarray(toas_s)
+        T = tspan_s if tspan_s is not None else float(t.max() - t.min())
+        f = fourier_frequencies(T, nmodes=chrom_nmodes, xp=xp)
+        F = fourier_basis(t, f, xp=xp)
+        phi = powerlaw_prior(
+            xp.repeat(f, 2), chrom_log10_amplitude, chrom_gamma, T, xp=xp
+        )
+        fr = xp.asarray(freqs_mhz)
+        # freq <= 0 = infinite-frequency TOA: zero chromatic delay (the
+        # same TEMPO convention the injection op applies)
+        s = xp.where(
+            fr > 0.0,
+            (chrom_ref_freq_mhz / xp.where(fr > 0.0, fr, 1.0))
+            ** chrom_index,
+            0.0,
+        )
+        Fs = F * s[:, None]
+        C = C + (Fs * phi[None, :]) @ Fs.T
     return C
 
 
@@ -254,6 +294,20 @@ def covariance_from_recipe(
         else None
     )
     rn_gamma = row(recipe.rn_gamma) if recipe.rn_gamma is not None else None
+    chrom_amp = chrom_gamma = None
+    chrom_kwargs = {}
+    if getattr(recipe, "chrom_log10_amplitude", None) is not None:
+        chrom_amp = row(recipe.chrom_log10_amplitude)
+        chrom_gamma = row(recipe.chrom_gamma)
+        cidx = (
+            recipe.chrom_index if recipe.chrom_index is not None else 2.0
+        )
+        chrom_kwargs = dict(
+            chrom_index=float(np.asarray(row(np.asarray(cidx)))),
+            chrom_nmodes=recipe.chrom_nmodes,
+            chrom_ref_freq_mhz=recipe.chrom_ref_freq_mhz,
+            freqs_mhz=psr.toas.freqs_mhz,
+        )
     return noise_covariance(
         psr.toas.errors_s,
         efac=efac,
@@ -264,5 +318,8 @@ def covariance_from_recipe(
         rn_gamma=rn_gamma,
         toas_s=mjds * DAY_IN_SEC,
         rn_nmodes=recipe.rn_nmodes,
+        chrom_log10_amplitude=chrom_amp,
+        chrom_gamma=chrom_gamma,
+        **chrom_kwargs,
         xp=xp,
     )
