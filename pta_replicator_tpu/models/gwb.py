@@ -60,9 +60,9 @@ def characteristic_strain(
     xp=np,
 ):
     """hc(f): power law A (f/f_1yr)^alpha with optional turnover, or a
-    user-supplied spectrum interpolated (and flat-extrapolated) in log-log
-    space (reference red_noise.py:243-263; f_1yr = 1/3.16e7 Hz as in the
-    reference)."""
+    user-supplied spectrum interpolated — and linearly EXTRAPOLATED, the
+    reference's ``extrap1d`` behavior (red_noise.py:11-33, 255-263) — in
+    log-log space (f_1yr = 1/3.16e7 Hz as in the reference)."""
     f = xp.asarray(f)
     if user_spectrum is not None:
         uf = xp.asarray(user_spectrum[:, 0])
@@ -88,7 +88,20 @@ def characteristic_strain(
                 stacklevel=2,
             )
         uh = xp.maximum(raw, 1e-30)
-        logh = xp.interp(xp.log10(f), xp.log10(uf), xp.log10(uh))
+        lf, luf, luh = xp.log10(f), xp.log10(uf), xp.log10(uh)
+        logh = xp.interp(lf, luf, luh)
+        # xp.interp clamps outside the node range; the reference instead
+        # continues the endpoint slopes (extrap1d) — frequencies below
+        # the first node follow the first segment's power law, above the
+        # last node the last segment's
+        slope_lo = (luh[1] - luh[0]) / (luf[1] - luf[0])
+        slope_hi = (luh[-1] - luh[-2]) / (luf[-1] - luf[-2])
+        logh = xp.where(
+            lf < luf[0], luh[0] + slope_lo * (lf - luf[0]), logh
+        )
+        logh = xp.where(
+            lf > luf[-1], luh[-1] + slope_hi * (lf - luf[-1]), logh
+        )
         return 10.0**logh
     amp = 10.0**log10_amplitude
     alpha = -0.5 * (spectral_index - 3.0)

@@ -328,3 +328,19 @@ def test_chromatic_noise_gradient_finite():
 
     g = jax.grad(total)(jnp.asarray(-13.5, b.toas_s.dtype))
     assert bool(jnp.isfinite(g))
+
+
+def test_user_spectrum_loglog_extrapolation():
+    """Frequencies outside the user grid follow the endpoint power-law
+    slopes (the reference's extrap1d, red_noise.py:11-33) — not a flat
+    clamp."""
+    from pta_replicator_tpu.models.gwb import characteristic_strain
+
+    # hc ~ f^-2/3 power law sampled on an interior grid
+    uf = np.logspace(-8.5, -7.5, 8)
+    uh = 1e-15 * (uf / 1e-8) ** (-2.0 / 3.0)
+    spec = np.column_stack([uf, uh])
+    f = np.logspace(-9.5, -6.5, 40)  # extends a decade past both ends
+    got = characteristic_strain(f, user_spectrum=spec)
+    want = 1e-15 * (f / 1e-8) ** (-2.0 / 3.0)
+    np.testing.assert_allclose(got, want, rtol=1e-10)
