@@ -1,0 +1,14 @@
+#!/bin/bash
+# Stage-3 watcher: after the sweep rehearsal artifact exists, push the
+# CW source ladder to the reference's full operating regime (1e7
+# sources, deterministic.py:258-264) — single rung, both backends.
+cd /root/repo
+for i in $(seq 1 500); do
+  if [ -s /root/repo/SWEEP_RESUME_r04.json ]; then
+    date -u +"%H:%M:%SZ starting 1e7-source CW rung" >> /tmp/recovery_log_r04.txt
+    timeout 3000 python benchmarks/cw_scaling.py 7 both > /root/repo/CW_SCALING_1E7_r04.json 2>/tmp/cw7_r04.err
+    date -u +"%H:%M:%SZ 1e7 rung done rc=$?" >> /tmp/recovery_log_r04.txt
+    exit 0
+  fi
+  sleep 120
+done
