@@ -272,6 +272,12 @@ def covariance_from_recipe(
         if recipe.log10_equad is not None
         else 0.0
     )
+    # convention parity with the injection op (white_noise_delays /
+    # reference white_noise.py:64-76): t2equad (the default) scales
+    # EQUAD by EFAC; tnequad adds it unscaled. The covariance must
+    # weight what was actually injected.
+    if not getattr(recipe, "tnequad", False):
+        equad = equad * efac
 
     ecorr = epoch_index = None
     if recipe.log10_ecorr is not None:

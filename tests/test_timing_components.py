@@ -316,13 +316,17 @@ def test_covariance_from_recipe_per_backend():
     epoch_backend = np.zeros(bins.nepochs, dtype=np.int64)
     epoch_backend[uniq_e] = idx[order[first_pos]]
 
-    white = (efac[idx] * sigma) ** 2 + (10.0 ** log10_eq[idx]) ** 2
+    # t2equad (Recipe default): EFAC scales EQUAD too — the same
+    # variance white_noise_delays injects
+    white = (efac[idx] * sigma) ** 2 + (
+        efac[idx] * 10.0 ** log10_eq[idx]
+    ) ** 2
     ecorr2 = (10.0 ** log10_ec[epoch_backend[ep]]) ** 2
     np.testing.assert_allclose(np.diag(C), white + ecorr2, rtol=1e-10)
 
     # the scalarized (mean) weighting must NOT reproduce this diagonal
     mean_white = (efac.mean() * sigma) ** 2 + (
-        10.0 ** np.mean(log10_eq)
+        efac.mean() * 10.0 ** np.mean(log10_eq)
     ) ** 2 + (10.0 ** np.mean(log10_ec)) ** 2
     assert not np.allclose(np.diag(C), mean_white, rtol=1e-3, atol=0.0)
 
@@ -579,3 +583,25 @@ def test_fit_damping_rolls_back_loc():
     # loc stays consistent with the par's ELONG/ELAT after the fit
     assert psr.loc["ELONG"] == _parse_float(psr.par.params["ELONG"][0])
     assert psr.loc["ELAT"] == _parse_float(psr.par.params["ELAT"][0])
+
+
+def test_covariance_equad_convention_matches_injection():
+    """t2equad (Recipe default) scales EQUAD by EFAC in the injected
+    variance (white_noise.py:64-76); the GLS covariance must weight the
+    same variance, and tnequad=True must weight the unscaled form."""
+    from pta_replicator_tpu.models.batched import Recipe
+    from pta_replicator_tpu.timing.fit import covariance_from_recipe
+
+    psr = load_pulsar(JPSR_PAR, JPSR_TIM)
+    make_ideal(psr)
+    ef, lq = 2.0, -6.0
+    t2 = Recipe(efac=np.asarray(ef), log10_equad=np.asarray(lq))
+    tn = Recipe(efac=np.asarray(ef), log10_equad=np.asarray(lq),
+                tnequad=True)
+    d_t2 = np.diag(covariance_from_recipe(psr, t2))
+    d_tn = np.diag(covariance_from_recipe(psr, tn))
+    sig2 = psr.toas.errors_s**2
+    np.testing.assert_allclose(
+        d_t2, ef**2 * (sig2 + 10.0 ** (2 * lq)), rtol=1e-12)
+    np.testing.assert_allclose(
+        d_tn, ef**2 * sig2 + 10.0 ** (2 * lq), rtol=1e-12)
