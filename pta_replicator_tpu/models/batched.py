@@ -961,6 +961,188 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
     return total
 
 
+def gls_noise_model(batch: PulsarBatch, recipe: "Recipe"):
+    """Rank-reduced per-pulsar noise model for the batched GLS refit.
+
+    Returns ``(sigma2, ecorr2, U, phi)``:
+
+    - ``sigma2`` (Np, Nt): white per-TOA variance, (EFAC sigma)^2 +
+      EQUAD^2 with the recipe's t2equad/tnequad convention — exactly
+      what white_noise_delays injects;
+    - ``ecorr2`` (Np, E) or None: per-epoch ECORR variance (the epoch
+      indicator block is applied analytically in gls_fit_subtract via a
+      segment-sum Woodbury — epochs are disjoint, so U_ec^T N^-1 U_ec
+      is diagonal and no dense (Nt, E) one-hot is ever materialized);
+    - ``U`` (Np, Nt, R) / ``phi`` (Np, R) or (None, None): the low-rank
+      red-noise block(s) — the achromatic Fourier basis and, when the
+      recipe injects chromatic noise, the same basis row-scaled by
+      (ref/f)^idx — with their power-law prior variances.
+
+    Oracle twin: timing.fit.covariance_from_recipe builds the same
+    C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T densely.
+    """
+    dtype = batch.toas_s.dtype
+    err = batch.errors_s
+    if recipe.efac is not None:
+        ef = jnp.asarray(recipe.efac, dtype)
+        ef = jnp.broadcast_to(ef, (batch.npsr,)) if ef.ndim == 0 else ef
+        efac_t = _per_toa(ef, batch.backend_index, batch.mask)
+    else:
+        efac_t = batch.mask
+    sigma2 = (efac_t * err) ** 2
+    if recipe.log10_equad is not None:
+        eq = 10.0 ** jnp.asarray(recipe.log10_equad, dtype)
+        eq = jnp.broadcast_to(eq, (batch.npsr,)) if eq.ndim == 0 else eq
+        equad_t = _per_toa(eq, batch.backend_index, batch.mask)
+        if not recipe.tnequad:
+            equad_t = efac_t * equad_t
+        sigma2 = sigma2 + equad_t**2
+
+    ecorr2 = None
+    if recipe.log10_ecorr is not None:
+        ec = 10.0 ** jnp.asarray(recipe.log10_ecorr, dtype)
+        if ec.ndim == 0:
+            ecorr2 = ec**2 * batch.epoch_mask
+        elif ec.ndim == 1:
+            ecorr2 = ec[:, None] ** 2 * batch.epoch_mask
+        else:
+            ecorr2 = (
+                jnp.take_along_axis(ec, batch.epoch_backend_index, axis=1)
+                ** 2
+                * batch.epoch_mask
+            )
+
+    blocks = []
+    priors = []
+    if recipe.rn_log10_amplitude is not None:
+        F, phi = red_noise_basis_prior(
+            batch, recipe.rn_log10_amplitude, recipe.rn_gamma,
+            nmodes=recipe.rn_nmodes, modes=recipe.rn_modes,
+            logf=recipe.rn_logf, fmin=recipe.rn_fmin, fmax=recipe.rn_fmax,
+            libstempo_convention=recipe.rn_libstempo,
+            tspan_s=recipe.rn_tspan_s,
+        )
+        blocks.append(F * batch.mask[..., None])
+        priors.append(phi)
+    if recipe.chrom_log10_amplitude is not None:
+        Fc, phic = red_noise_basis_prior(
+            batch, recipe.chrom_log10_amplitude, recipe.chrom_gamma,
+            nmodes=recipe.chrom_nmodes,
+        )
+        idx = jnp.asarray(
+            recipe.chrom_index if recipe.chrom_index is not None else 2.0,
+            dtype,
+        )
+        if idx.ndim >= 1:
+            idx = idx[..., None]
+        scale = jnp.where(
+            batch.freqs_mhz > 0.0,
+            (recipe.chrom_ref_freq_mhz
+             / jnp.where(batch.freqs_mhz > 0.0, batch.freqs_mhz, 1.0))
+            ** idx,
+            0.0,
+        )
+        blocks.append(Fc * (scale * batch.mask)[..., None])
+        priors.append(phic)
+    U = jnp.concatenate(blocks, axis=-1) if blocks else None
+    phi = jnp.concatenate(priors, axis=-1) if blocks else None
+    return sigma2, ecorr2, U, phi
+
+
+def gls_fit_subtract(
+    delays, batch: PulsarBatch, design, recipe: "Recipe", ridge=1e-10
+):
+    """Batched full-model GLS refit on device: subtract the
+    C^-1-weighted best fit of the design columns, with
+    C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T from the recipe's
+    own noise model (gls_noise_model) — the device analog of the
+    oracle's ``fit(fitter='gls', recipe=...)`` and of the reference's
+    PINT GLSFitter path (simulate.py:57-61).
+
+    C is never materialized: the ECORR block inverts analytically
+    per-epoch (disjoint indicators -> diagonal inner system, segment
+    sums), and the red-noise block goes through a Woodbury solve of an
+    (R, R) system, so the cost is batched (Nt x K/R) matmuls — MXU
+    work — instead of an (Nt, Nt) dense factorization per pulsar.
+    f32 caveat as design_fit_subtract: validate against the oracle GLS
+    when exact parameter recovery matters (test_batched does, in f64).
+    """
+    dtype = delays.dtype
+    sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+    winv = jnp.where(batch.mask > 0, 1.0 / sigma2, 0.0)  # N^-1 diagonal
+    psr_rows = jnp.arange(batch.npsr)[:, None]
+
+    def seg_sum(x):
+        """Per-pulsar epoch segment sum over TOAs: (Np, Nt, Q) ->
+        (Np, E, Q) (scatter-add; no dense one-hot)."""
+        z = jnp.zeros(
+            (batch.npsr, batch.max_epochs) + x.shape[2:], dtype
+        )
+        return z.at[psr_rows, batch.epoch_index].add(
+            x * batch.mask[..., None]
+        )
+
+    if ecorr2 is not None:
+        s_e = seg_sum(winv[..., None])[..., 0]  # U_ec^T N^-1 U_ec diag
+        gain = ecorr2 / (1.0 + ecorr2 * s_e)  # k/(1 + k s), 0 at k=0
+
+    def c0inv_mat(X):
+        """(N + ECORR)^-1 X for (Np, Nt, Q) X, per-epoch Woodbury."""
+        y = winv[..., None] * X
+        if ecorr2 is None:
+            return y
+        corr = gain[..., None] * seg_sum(y)
+        picked = jnp.take_along_axis(
+            corr, batch.epoch_index[..., None], axis=1
+        )
+        return y - winv[..., None] * picked
+
+    design = jnp.asarray(design, dtype) * batch.mask[..., None]
+    K = design.shape[-1]
+
+    if U is not None:
+        G = c0inv_mat(U)  # C0^-1 U, (Np, Nt, R)
+        S = jnp.einsum("pnr,pns->prs", U, G, precision="highest")
+        # phi=0 rows (masked pulsars/modes) get a unit diagonal so the
+        # solve stays finite and contributes nothing
+        phi_safe = jnp.where(phi > 0, phi, 1.0)
+        S = S + jnp.eye(U.shape[-1], dtype=dtype) / phi_safe[:, None, :]
+
+        def cinv_mat(X):
+            X0 = c0inv_mat(X)
+            inner = jnp.einsum("pnr,pnq->prq", U, X0, precision="highest")
+            corr = jnp.linalg.solve(S, inner)
+            return X0 - jnp.einsum(
+                "pnr,prq->pnq", G, corr, precision="highest"
+            )
+    else:
+        cinv_mat = c0inv_mat
+
+    CiM = cinv_mat(design)  # (Np, Nt, K)
+    Cir = cinv_mat(delays[..., None])[..., 0]  # (Np, Nt)
+    # column normalization + zero-column neutralization, as in
+    # design_fit_subtract (padded columns solve to exactly 0)
+    norms = jnp.sqrt(
+        jnp.maximum(
+            jnp.einsum("pnk,pnk->pk", design, CiM, precision="highest"),
+            0.0,
+        )
+    )
+    zero_col = norms == 0.0
+    norms = jnp.where(zero_col, 1.0, norms)
+    A = (
+        jnp.einsum("pnk,pnl->pkl", design, CiM, precision="highest")
+        / norms[:, :, None]
+        / norms[:, None, :]
+    )
+    A = A + jnp.eye(K, dtype=dtype) * zero_col[:, None, :].astype(dtype)
+    A = A + ridge * jnp.eye(K, dtype=dtype)
+    b = jnp.einsum("pnk,pn->pk", design, Cir, precision="highest") / norms
+    coef = jnp.linalg.solve(A, b[..., None])[..., 0] / norms
+    model = jnp.einsum("pnk,pk->pn", design, coef, precision="highest")
+    return (delays - model) * batch.mask
+
+
 def residualize(delays, batch: PulsarBatch):
     """Delays -> timing residuals: subtract the per-pulsar error-weighted
     mean over valid TOAs (what a timing-model phase fit absorbs first;
