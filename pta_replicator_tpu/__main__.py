@@ -95,6 +95,11 @@ def main(argv=None):
                         "astrometry, DMX/DM, FD, binary, JUMP columns "
                         "from the loaded par files) instead of the "
                         "quadratic proxy; implies --fit")
+    p.add_argument("--gls-fit", action="store_true",
+                   help="weight the full-model refit by the recipe's "
+                        "own noise model (nested-Woodbury GLS: white + "
+                        "ECORR + achromatic/chromatic red noise) "
+                        "instead of plain WLS; implies --full-fit")
     p.add_argument("--sharded", action="store_true",
                    help="shard realizations over all visible devices")
     p.add_argument("--checkpoint", default=None,
@@ -145,6 +150,8 @@ def main(argv=None):
 
     with open(args.recipe) as fh:
         recipe = _build_recipe(json.load(fh), psrs)
+    if args.gls_fit:
+        args.full_fit = True
     if args.full_fit:
         import dataclasses
 
@@ -154,7 +161,9 @@ def main(argv=None):
 
         args.fit = True
         D, _names = design_tensor(psrs, ntoa_max=batch.ntoa_max)
-        recipe = dataclasses.replace(recipe, fit_design=jnp.asarray(D))
+        recipe = dataclasses.replace(
+            recipe, fit_design=jnp.asarray(D), fit_gls=bool(args.gls_fit)
+        )
     key = jax.random.PRNGKey(args.seed)
 
     if args.checkpoint:

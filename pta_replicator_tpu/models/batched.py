@@ -874,6 +874,9 @@ class Recipe:
     #: (Np, Nt, K) full-model design tensor for the per-realization
     #: refit (timing.fit.design_tensor); None = quadratic F0/F1 proxy
     fit_design: Optional[jax.Array] = None
+    #: weight the full-model design fit by the recipe's own noise model
+    #: (GLS via gls_fit_subtract) instead of plain WLS
+    fit_gls: bool = field(metadata=dict(static=True), default=False)
     #: GWB DFT-synthesis matmul precision (None = backend default;
     #: 'highest' forces full-f32 MXU passes; see gwb_delays)
     gwb_synthesis_precision: object = field(
@@ -1232,11 +1235,19 @@ def finalize_residuals(delays, batch: PulsarBatch, recipe: Recipe, fit: bool):
     subtraction of :func:`residualize` is a no-op (the constant column is
     projected out at full precision — see quadratic_fit_subtract), so it
     is skipped; the design fit keeps it because an arbitrary design
-    tensor need not span a constant (test_quadratic_fit_projects_mean)."""
+    tensor need not span a constant (test_quadratic_fit_projects_mean).
+    ``recipe.fit_gls`` upgrades the design fit from WLS to the
+    nested-Woodbury GLS weighted by the recipe's own noise model
+    (gls_fit_subtract) — the device analog of the reference's PINT
+    GLSFitter path."""
     if not fit:
         return residualize(delays, batch)
     if recipe.fit_design is not None:
-        return residualize(design_fit_subtract(delays, batch, recipe.fit_design), batch)
+        if recipe.fit_gls:
+            sub = gls_fit_subtract(delays, batch, recipe.fit_design, recipe)
+        else:
+            sub = design_fit_subtract(delays, batch, recipe.fit_design)
+        return residualize(sub, batch)
     return quadratic_fit_subtract(delays, batch)
 
 

@@ -138,3 +138,26 @@ def test_cli_write_partim(tmp_path, partim_small, capsys):
     w = 1.0 / template.toas.errors_s**2
     shift_res = shift_s - np.sum(w * shift_s) / np.sum(w)
     np.testing.assert_allclose(shift_res, cube[r, 0], atol=5e-9, rtol=0)
+
+
+def test_cli_gls_fit(tmp_path, partim_small, capsys):
+    """--gls-fit runs the full-model refit weighted by the recipe noise
+    model end-to-end through the CLI."""
+    pardir, timdir = partim_small
+    recipe = tmp_path / "r.json"
+    recipe.write_text(json.dumps({
+        "efac": 1.1, "log10_equad": -6.5, "log10_ecorr": -6.7,
+        "rn_log10_amplitude": -13.5, "rn_gamma": 3.5,
+    }))
+    out = tmp_path / "o.npz"
+    main([
+        "realize", "--pardir", pardir, "--timdir", timdir,
+        "--recipe", str(recipe), "--nreal", "4", "--out", str(out),
+        "--gls-fit", "--seed", "3",
+    ])
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["shape"][0] == 4
+    with np.load(out) as z:
+        res = z["residuals"]
+    assert np.isfinite(res).all()
+    assert res.std() > 0
