@@ -142,6 +142,20 @@ def injection_stage_fns(batch, recipe) -> dict:
                 batch,
             )
         )
+    elif recipe.fit_gls:
+        stages["gls_fit"] = vm(
+            lambda k: B.residualize(
+                B.gls_fit_subtract(
+                    jax.random.normal(
+                        k, batch.toas_s.shape, batch.toas_s.dtype
+                    ),
+                    batch,
+                    recipe.fit_design,
+                    recipe,
+                ),
+                batch,
+            )
+        )
     else:
         stages["design_fit"] = vm(
             lambda k: B.residualize(
