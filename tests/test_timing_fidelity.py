@@ -351,3 +351,22 @@ def test_dd_binary_parameter_recovery(tmp_path):
         assert abs(vf - truth[k]) < tol * abs(dv), (
             f"{k}: injected {dv}, residual offset {vf - truth[k]}"
         )
+
+
+def test_solar_wind_closed_form_vs_numerical_integration():
+    """The (pi - psi)/(|r| sin psi) elongation factor must equal the
+    brute-force line-of-sight integral of n_e(r) = n0 (AU/r)^2 —
+    an independent check of the closed form (tempo2/PINT convention)."""
+    rng = np.random.default_rng(8)
+    for _ in range(12):
+        r_e = rng.uniform(0.98, 1.02)        # Earth-Sun distance [AU]
+        psi = rng.uniform(0.05, np.pi - 0.05)  # elongation
+        closed = (np.pi - psi) / (r_e * np.sin(psi))
+        # numeric: Earth at origin, Sun at distance r_e, LOS at angle
+        # psi from the Sun direction; r(l)^2 = r_e^2 + l^2 - 2 r_e l cos
+        lmax = 2000.0
+        l = np.linspace(0.0, lmax, 2_000_001)
+        r2 = r_e**2 + l**2 - 2.0 * r_e * l * np.cos(psi)
+        numeric = np.trapezoid(1.0 / r2, l)
+        # the finite upper limit truncates ~1/lmax of the integral
+        assert closed == pytest.approx(numeric, rel=2e-3), (r_e, psi)
