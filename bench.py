@@ -364,12 +364,37 @@ def _bench():
     static = deterministic_delays(batch, recipe)
     np.asarray(static)
 
+    # BENCH_FIT: 'quad' (default, the headline config — comparable
+    # across rounds), 'full' (166-column WLS design fit), or 'gls'
+    # (same columns, nested-Woodbury GLS weighted by the recipe noise
+    # model). The non-default modes measure the full-model refit cost
+    # at bench scale; BENCH_FIT_K overrides the column count.
+    fit_mode = os.environ.get("BENCH_FIT", "quad")
+    if fit_mode not in ("quad", "full", "gls"):
+        raise SystemExit(f"BENCH_FIT must be quad|full|gls, got {fit_mode!r}")
+    extra["fit_mode"] = fit_mode
+    if fit_mode != "quad":
+        import dataclasses
+
+        kcols = int(os.environ.get("BENCH_FIT_K", "166"))
+        drng = np.random.default_rng(3)
+        fitD = jnp.asarray(
+            drng.standard_normal((batch.npsr, batch.ntoa_max, kcols)),
+            batch.toas_s.dtype,
+        )
+        recipe = dataclasses.replace(
+            recipe, fit_design=fitD, fit_gls=(fit_mode == "gls")
+        )
+        extra["fit_columns"] = kcols
+
     @jax.jit
     def run_chunk(key, static):
         keys = jax.random.split(key, chunk)
 
         def one(k):
             d = realization_delays(k, batch, recipe) + static
+            if fit_mode != "quad":
+                return B.finalize_residuals(d, batch, recipe, True)
             # the quad fit projects out the weighted constant at full
             # precision, so no separate residualize pass is needed
             return quadratic_fit_subtract(d, batch)
