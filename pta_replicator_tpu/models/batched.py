@@ -40,6 +40,22 @@ from .gwb import (
 STREAM_VERSION = 4
 
 
+def _check_backend_table(params, batch, name: str):
+    """Fail loudly (at trace time — shapes are static) when a per-backend
+    table is narrower than the batch's backend vocabulary: the
+    out-of-bounds gather would otherwise FILL with NaN and silently
+    poison every downstream realization."""
+    params = jnp.asarray(params)
+    nb = len(batch.backend_names)
+    if params.ndim == 2 and nb and params.shape[1] < nb:
+        raise ValueError(
+            f"{name} table has {params.shape[1]} backend column(s) but "
+            f"the batch carries {nb} backends ({batch.backend_names}); "
+            "size per-backend tables to PulsarBatch.backend_names"
+        )
+    return params
+
+
 def _per_toa(params, index, mask):
     """Gather per-backend parameters onto TOAs: (Np, NB) -> (Np, Nt)."""
     params = jnp.asarray(params)
@@ -96,12 +112,14 @@ def white_noise_delays(
     dtype = batch.toas_s.dtype
     shape = batch.toas_s.shape
     eps = _rows_draw(jax.random.normal, key, rows, shape, dtype)
-    ef = jnp.asarray(efac, dtype)
+    ef = _check_backend_table(efac, batch, "efac").astype(dtype)
     ef = jnp.broadcast_to(ef, (batch.npsr,)) if ef.ndim == 0 else ef
     efac_t = _per_toa(ef, batch.backend_index, batch.mask)
     var = (efac_t * batch.errors_s) ** 2
     if log10_equad is not None:
-        eq = 10.0 ** jnp.asarray(log10_equad, dtype)
+        eq = 10.0 ** _check_backend_table(
+            log10_equad, batch, "log10_equad"
+        ).astype(dtype)
         eq = jnp.broadcast_to(eq, (batch.npsr,)) if eq.ndim == 0 else eq
         equad_t = _per_toa(eq, batch.backend_index, batch.mask)
         if not tnequad:
@@ -118,7 +136,9 @@ def jitter_delays(key, batch: PulsarBatch, log10_ecorr, rows=None):
         jax.random.normal, key, rows,
         (batch.npsr, batch.max_epochs), batch.toas_s.dtype,
     )
-    ec = 10.0 ** jnp.asarray(log10_ecorr, batch.toas_s.dtype)
+    ec = 10.0 ** _check_backend_table(
+        log10_ecorr, batch, "log10_ecorr"
+    ).astype(batch.toas_s.dtype)
     if ec.ndim == 0:
         per_epoch = ec * batch.epoch_mask
     elif ec.ndim == 1:
@@ -987,14 +1007,16 @@ def gls_noise_model(batch: PulsarBatch, recipe: "Recipe"):
     dtype = batch.toas_s.dtype
     err = batch.errors_s
     if recipe.efac is not None:
-        ef = jnp.asarray(recipe.efac, dtype)
+        ef = _check_backend_table(recipe.efac, batch, "efac").astype(dtype)
         ef = jnp.broadcast_to(ef, (batch.npsr,)) if ef.ndim == 0 else ef
         efac_t = _per_toa(ef, batch.backend_index, batch.mask)
     else:
         efac_t = batch.mask
     sigma2 = (efac_t * err) ** 2
     if recipe.log10_equad is not None:
-        eq = 10.0 ** jnp.asarray(recipe.log10_equad, dtype)
+        eq = 10.0 ** _check_backend_table(
+            recipe.log10_equad, batch, "log10_equad"
+        ).astype(dtype)
         eq = jnp.broadcast_to(eq, (batch.npsr,)) if eq.ndim == 0 else eq
         equad_t = _per_toa(eq, batch.backend_index, batch.mask)
         if not recipe.tnequad:
@@ -1003,7 +1025,9 @@ def gls_noise_model(batch: PulsarBatch, recipe: "Recipe"):
 
     ecorr2 = None
     if recipe.log10_ecorr is not None:
-        ec = 10.0 ** jnp.asarray(recipe.log10_ecorr, dtype)
+        ec = 10.0 ** _check_backend_table(
+            recipe.log10_ecorr, batch, "log10_ecorr"
+        ).astype(dtype)
         if ec.ndim == 0:
             ecorr2 = ec**2 * batch.epoch_mask
         elif ec.ndim == 1:

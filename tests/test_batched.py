@@ -979,3 +979,25 @@ def test_gls_fit_subtract_matches_oracle_dense():
         num = np.sqrt(np.mean((post[i][:n] - ref_post) ** 2))
         den = np.sqrt(np.mean(ref_post**2))
         assert num / den < 1e-6, (i, num / den)
+
+
+def test_backend_table_width_validated():
+    """A per-backend table narrower than the batch's backend vocabulary
+    must raise at trace time — the out-of-bounds gather would otherwise
+    fill with NaN and silently poison every realization (found by the
+    f32 GLS test with a mis-sized fixture table)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+
+    b = synthetic_batch(npsr=3, ntoa=64, nbackend=3, seed=0)
+    key = jax.random.PRNGKey(0)
+    bad = jnp.ones((3, 2))  # 2 columns for a 3-backend batch
+    with pytest.raises(ValueError, match="backend column"):
+        B.white_noise_delays(key, b, efac=bad)
+    with pytest.raises(ValueError, match="backend column"):
+        B.jitter_delays(key, b, log10_ecorr=jnp.full((3, 2), -6.5))
+    with pytest.raises(ValueError, match="backend column"):
+        B.gls_noise_model(b, B.Recipe(efac=bad))
