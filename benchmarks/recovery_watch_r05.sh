@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-5 tunnel-recovery watcher (VERDICT r4 item 1): probe every 3
+# minutes for the full round; on recovery run the complete hardware
+# evidence battery in priority order, writing self-timestamped JSONs to
+# the repo root. One script (no staged watchers this round) so a
+# mid-battery tunnel drop still leaves the highest-priority artifacts.
+cd /root/repo
+for i in $(seq 1 230); do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp, numpy as np
+float(np.asarray(jnp.ones((128,128)) @ jnp.ones((128,128))).sum())
+" >/dev/null 2>&1; then
+    log() { date -u +"%H:%M:%SZ $*" >> /tmp/recovery_log_r05.txt; }
+    log "tunnel up, starting r05 battery"
+    timeout 1600 python bench.py > /root/repo/BENCH_PREVIEW_r05.json 2>/tmp/bench_r05.err
+    log "bench done rc=$?"
+    BENCH_FIT=gls timeout 1600 python bench.py > /root/repo/BENCH_GLS_r05.json 2>/tmp/bench_gls_r05.err
+    log "bench gls done rc=$?"
+    timeout 900 python benchmarks/validate_device.py 2000 > /root/repo/VALIDATE_DEVICE_r05.json 2>/tmp/validate_r05.err
+    log "validate done rc=$?"
+    timeout 900 python benchmarks/fused_ablation.py 800 5 > /root/repo/ABLATION_r05.json 2>/tmp/ablation_r05.err
+    log "ablation done rc=$?"
+    timeout 600 python benchmarks/vpu_ceiling.py > /root/repo/VPU_CEILING_r05.json 2>/tmp/vpu_r05.err
+    log "vpu_ceiling done rc=$?"
+    timeout 2400 python benchmarks/cw_scaling.py 6 both > /root/repo/CW_SCALING_r05.json 2>/tmp/cwscale_r05.err
+    log "cw_scaling 1e6 done rc=$?"
+    timeout 3000 python benchmarks/sweep_kill_resume.py 1000000 800 > /root/repo/SWEEP_RESUME_r05.json 2>/tmp/sweep_r05.err
+    log "sweep kill/resume done rc=$?"
+    timeout 3000 python benchmarks/cw_scaling.py 7 both > /root/repo/CW_SCALING_1E7_r05.json 2>/tmp/cw7_r05.err
+    log "cw_scaling 1e7 done rc=$?"
+    log "battery complete"
+    exit 0
+  fi
+  sleep 180
+done
+date -u +"%H:%M:%SZ gave up waiting" >> /tmp/recovery_log_r05.txt
