@@ -9,6 +9,11 @@ import scipy.constants as _sc
 DAY_IN_SEC = 86400.0
 YEAR_IN_SEC = 365.25 * DAY_IN_SEC
 
+#: radians <-> milliarcseconds, shared by the par value-write,
+#: error-write, and par-read paths so their units can never desync
+RAD_TO_MAS = (180.0 / _sc.pi) * 3.6e6
+MAS_TO_RAD = 1.0 / RAD_TO_MAS
+
 #: Dispersion constant, MHz^2 cm^3 pc s
 DM_K = 4.15e3
 

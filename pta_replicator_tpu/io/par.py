@@ -112,6 +112,46 @@ class ParModel:
             self.lines.append(f"{key}\t\t{text}")
         self.params[key] = [text] + self.params.get(key, [None, None])[1:]
 
+    def set_param_error(self, key: str, error: float, fmt: str = ".20g") -> None:
+        """Write a parameter's 1-sigma uncertainty into the par line's
+        error column (tempo/PINT layout ``KEY value fit_flag error``).
+        A missing fit flag is filled with "1" — errors are only written
+        for parameters the fit actually varied. The error is in the
+        par file's native display units for that key (e.g. RAJ in
+        seconds of right ascension, PX in mas)."""
+        key = key.upper()
+        text = format(error, fmt)
+        for i, line in enumerate(self.lines):
+            tokens = line.split()
+            if tokens and tokens[0].upper() == key:
+                if len(tokens) < 3:
+                    tokens.append("1")
+                if len(tokens) < 4:
+                    tokens.append(text)
+                else:
+                    tokens[3] = text
+                self.lines[i] = "\t".join(
+                    [tokens[0], tokens[1]] + tokens[2:]
+                )
+                vals = self.params.get(key, [tokens[1]])
+                vals = list(vals) + [None] * (3 - len(vals))
+                vals[1] = tokens[2]
+                vals[2] = text
+                self.params[key] = vals
+                return
+
+    def param_error(self, key: str):
+        """1-sigma uncertainty from the par line's error column
+        (``KEY value fit_flag error``), or None when absent/unparseable.
+        Units are the par file's native display units for the key."""
+        toks = self.params.get(key.upper())
+        if toks and len(toks) >= 3 and toks[2] is not None:
+            try:
+                return _parse_float(toks[2])
+            except ValueError:
+                return None
+        return None
+
     def _jump_lines(self):
         """(line_index, tokens) of every flag-matched JUMP declaration —
         the single filter behind :attr:`jumps` and :meth:`set_jump`, so
@@ -151,6 +191,21 @@ class ParModel:
         for seen, (i, tokens) in enumerate(self._jump_lines()):
             if seen == index:
                 tokens[3] = format(offset_s, ".20g")
+                self.lines[i] = "\t".join(tokens)
+                return
+        raise IndexError(f"par file has no flag-matched JUMP #{index}")
+
+    def set_jump_error(self, index: int, error_s: float) -> None:
+        """Write the ``index``-th flag-matched JUMP line's 1-sigma
+        uncertainty (``JUMP -flag value offset fit error`` layout)."""
+        for seen, (i, tokens) in enumerate(self._jump_lines()):
+            if seen == index:
+                if len(tokens) < 5:
+                    tokens.append("1")
+                if len(tokens) < 6:
+                    tokens.append(format(error_s, ".20g"))
+                else:
+                    tokens[5] = format(error_s, ".20g")
                 self.lines[i] = "\t".join(tokens)
                 return
         raise IndexError(f"par file has no flag-matched JUMP #{index}")

@@ -60,16 +60,18 @@ def characteristic_strain(
     xp=np,
 ):
     """hc(f): power law A (f/f_1yr)^alpha with optional turnover, or a
-    user-supplied spectrum interpolated — and linearly EXTRAPOLATED, the
-    reference's ``extrap1d`` behavior (red_noise.py:11-33, 255-263) — in
-    log-log space (f_1yr = 1/3.16e7 Hz as in the reference)."""
+    user-supplied spectrum interpolated in log-log space and clamped to
+    the endpoint values outside the node range — the reference's shipped
+    ``extrap1d`` behavior (red_noise.py:11-33, 255-263: the slope
+    continuation there is commented out, so out-of-range frequencies get
+    the flat endpoint value). f_1yr = 1/3.16e7 Hz as in the reference."""
     f = xp.asarray(f)
     if user_spectrum is not None:
         uf = xp.asarray(user_spectrum[:, 0])
         raw = xp.asarray(user_spectrum[:, 1])
         # Clamp so zero/underflowed strain entries cannot put -inf nodes
         # into the log-log interpolation (f32 device path). The reference
-        # log-log-extrapolates whatever it is given (red_noise.py:255-263),
+        # log-log-interpolates whatever it is given (red_noise.py:255-263),
         # so flooring a legitimate ultra-low spectrum is a behavioral
         # divergence — warn when the floor actually engages. Inside jit
         # the spectrum is a tracer and cannot be inspected; the warning
@@ -83,25 +85,19 @@ def characteristic_strain(
             warnings.warn(
                 f"user GWB spectrum: {n_floored} strain value(s) below "
                 "1e-30 were floored to 1e-30 for log-log interpolation "
-                "(the reference extrapolates the raw values); rescale "
+                "(the reference interpolates the raw values); rescale "
                 "the spectrum if the ultra-low entries are intentional",
                 stacklevel=2,
             )
         uh = xp.maximum(raw, 1e-30)
         lf, luf, luh = xp.log10(f), xp.log10(uf), xp.log10(uh)
+        # xp.interp clamps to the endpoint values outside the node range,
+        # which is exactly the reference's extrap1d (its slope continuation
+        # is commented out). The synthesis grid extends ~howml (10x) below
+        # typical user grids, where hc^2/f^3 dominates — so flat-vs-slope
+        # there changes injected power by large factors; match the
+        # reference.
         logh = xp.interp(lf, luf, luh)
-        # xp.interp clamps outside the node range; the reference instead
-        # continues the endpoint slopes (extrap1d) — frequencies below
-        # the first node follow the first segment's power law, above the
-        # last node the last segment's
-        slope_lo = (luh[1] - luh[0]) / (luf[1] - luf[0])
-        slope_hi = (luh[-1] - luh[-2]) / (luf[-1] - luf[-2])
-        logh = xp.where(
-            lf < luf[0], luh[0] + slope_lo * (lf - luf[0]), logh
-        )
-        logh = xp.where(
-            lf > luf[-1], luh[-1] + slope_hi * (lf - luf[-1]), logh
-        )
         return 10.0**logh
     amp = 10.0**log10_amplitude
     alpha = -0.5 * (spectral_index - 3.0)

@@ -78,13 +78,22 @@ def equatorial_to_ecliptic(ra_rad: float, dec_rad: float, epoch: str = "2000"):
     return float(np.rad2deg(lam)), float(np.rad2deg(beta))
 
 
-def equatorial_to_ecliptic_tangent(ra_rad: float, dec_rad: float):
+def equatorial_to_ecliptic_tangent(
+    ra_rad: float, dec_rad: float, epoch: str = "2000"
+):
     """2x2 rotation taking local tangent-plane components from the
     equatorial basis (e_ra, e_dec) to the ecliptic basis (e_lon, e_lat)
     at the given position: ``(u_lon*, u_lat) = R @ (u_ra*, u_dec)``
     where starred components carry the cos(lat) factor (proper-motion
     convention). Used to write equatorial-basis fit updates back to
-    ELONG/ELAT/PMELONG/PMELAT pars."""
+    ELONG/ELAT/PMELONG/PMELAT pars.
+
+    ``epoch`` must match the equinox of the input (ra, dec) — "1950" for
+    B-named pulsars, whose coordinates come from
+    :func:`ecliptic_to_equatorial` with the 1950 switch. The ecliptic
+    pole is then precessed into the same B1950 frame; mixing a B1950
+    position with the J2000 pole skews the rotation by the ~0.6 deg
+    precession angle."""
     p = np.array([
         np.cos(dec_rad) * np.cos(ra_rad),
         np.cos(dec_rad) * np.sin(ra_rad),
@@ -93,6 +102,8 @@ def equatorial_to_ecliptic_tangent(ra_rad: float, dec_rad: float):
     zhat = np.array([0.0, 0.0, 1.0])
     ce, se = np.cos(OBLIQUITY_J2000), np.sin(OBLIQUITY_J2000)
     n_ecl = np.array([0.0, -se, ce])  # ecliptic north pole, equatorial frame
+    if str(epoch) == "1950":
+        n_ecl = _precession_matrix_j2000_to_b1950() @ n_ecl
 
     def basis(nhat):
         e1 = np.cross(nhat, p)
@@ -107,6 +118,15 @@ def equatorial_to_ecliptic_tangent(ra_rad: float, dec_rad: float):
     ])
 
 
+def ecliptic_epoch(name: str) -> str:
+    """Equinox for a pulsar's ecliptic coordinates: "1950" for B-named
+    pulsars, "2000" otherwise — the reference's pyephem epoch switch
+    (red_noise.py:210-221). Single home for the rule; the same string
+    feeds ecliptic_to_equatorial, equatorial_to_ecliptic and the
+    tangent-plane rotation, which must all agree on the frame."""
+    return "1950" if "B" in (name or "") else "2000"
+
+
 def pulsar_ra_dec(loc: dict, name: str = ""):
     """Equatorial (ra, dec) [rad] from a reference-convention ``loc`` dict.
 
@@ -118,8 +138,9 @@ def pulsar_ra_dec(loc: dict, name: str = ""):
     if "RAJ" in loc and "DECJ" in loc:
         return float(loc["RAJ"]) * np.pi / 12.0, float(loc["DECJ"]) * np.pi / 180.0
     if "ELONG" in loc and "ELAT" in loc:
-        epoch = "1950" if "B" in name else "2000"
-        return ecliptic_to_equatorial(loc["ELONG"], loc["ELAT"], epoch=epoch)
+        return ecliptic_to_equatorial(
+            loc["ELONG"], loc["ELAT"], epoch=ecliptic_epoch(name)
+        )
     raise AttributeError("loc must contain RAJ/DECJ or ELONG/ELAT")
 
 

@@ -23,7 +23,7 @@ from .io.par import ParModel, read_par
 from .io.tim import TOAData, fabricate_toas, read_tim, write_tim
 from .timing.model import SpindownTiming, TimingModel, phase_residuals
 from .timing.fit import design_matrix, wls_fit, gls_fit
-from .constants import DAY_IN_SEC
+from .constants import DAY_IN_SEC, RAD_TO_MAS
 
 
 class Residuals:
@@ -185,10 +185,12 @@ class SimulatedPulsar:
                         "recipe/cov describe a GLS noise covariance; pass "
                         "fitter='gls' (a WLS fit would silently ignore them)"
                     )
-                p, post = wls_fit(res, self.toas.errors_s, M)
+                p, post, pcov = wls_fit(
+                    res, self.toas.errors_s, M, return_cov=True
+                )
             else:
                 C = cov if cov is not None else np.diag(self.toas.errors_s**2)
-                p, post = gls_fit(res, C, M)
+                p, post, pcov = gls_fit(res, C, M, return_cov=True)
             p = np.asarray(p, dtype=np.float64)
             updates = dict(zip(names, p))
 
@@ -227,7 +229,148 @@ class SimulatedPulsar:
                     copy.deepcopy(saved[2]),
                 )
             self.fit_results = {k: v * scale for k, v in updates.items()}
+        # 1-sigma parameter uncertainties from the final linearization's
+        # (M^T C^-1 M)^-1 diagonal — what PINT's fitters report and
+        # write_partim persists via the par error columns (reference
+        # simulate.py:44-77). Internal units (rad, rad/yr, Hz, ...),
+        # matching fit_results; the step-damping scale does NOT apply
+        # (the covariance describes the solution, not the step taken).
+        pcov = np.asarray(pcov, dtype=np.float64)
+        sig = np.sqrt(np.clip(np.diag(pcov), 0.0, None))
+        self.fit_uncertainties = dict(zip(names, sig))
+        self._write_par_errors(self.fit_uncertainties, names=names,
+                               pcov=pcov)
         self.update_residuals()
+
+    def _write_par_errors(self, sigmas: dict, names=None,
+                          pcov=None) -> None:
+        """Persist 1-sigma fit uncertainties into the par's error columns,
+        converting from the fit's internal units to each key's par-file
+        display units with the SAME conversion rules _apply_fit uses for
+        the values (a unit mismatch between value and error columns would
+        silently corrupt downstream noise analyses).
+
+        ``names``/``pcov`` (column labels + full parameter covariance)
+        feed the ecliptic frame rotation its RAJ-DECJ / PMRA-PMDEC cross
+        terms — diag(R Sigma R^T) needs them whenever the equatorial
+        estimates are correlated (sparse/uneven sampling); without them
+        the rotated sigmas can be tens of percent off. Only the OUTPUT
+        ecliptic cross-correlation is dropped (par error columns are
+        per-parameter).
+
+        OFFSET (the phase nuisance) and WAVE harmonics are skipped — par
+        files have no error column for either.
+        """
+        par = self.par
+        if par is None or not sigmas:
+            return
+        rad2mas = RAD_TO_MAS
+
+        def cross(k1: str, k2: str) -> float:
+            if pcov is None or names is None:
+                return 0.0
+            try:
+                return float(pcov[names.index(k1), names.index(k2)])
+            except ValueError:  # column not fitted
+                return 0.0
+
+        for k in ("F0", "F1", "F2"):
+            if k in sigmas:
+                par.set_param_error(k, sigmas[k])
+
+        ecliptic_par = (
+            par.raj_hours is None
+            and getattr(par, "elong_deg", None) is not None
+        )
+        if not ecliptic_par:
+            if "RAJ" in sigmas and par.raj_hours is not None:
+                # par displays RAJ sexagesimally; its error column is in
+                # seconds of right ascension (rad -> hours -> seconds)
+                par.set_param_error(
+                    "RAJ", sigmas["RAJ"] * (12.0 / np.pi) * 3600.0
+                )
+            if "DECJ" in sigmas and par.decj_deg is not None:
+                par.set_param_error(
+                    "DECJ", np.degrees(sigmas["DECJ"]) * 3600.0
+                )  # arcsec
+            cosd = (
+                np.cos(np.deg2rad(par.decj_deg))
+                if par.decj_deg is not None else 1.0
+            )
+            if "PMRA" in sigmas:
+                par.set_param_error("PMRA", sigmas["PMRA"] * cosd * rad2mas)
+            if "PMDEC" in sigmas:
+                par.set_param_error("PMDEC", sigmas["PMDEC"] * rad2mas)
+        elif any(k in sigmas for k in ("RAJ", "DECJ", "PMRA", "PMDEC")):
+            # Ecliptic par: rotate the tangent-plane variances into the
+            # ecliptic basis (diagonal of R diag(var) R^T — correlations
+            # are dropped, as par error columns are per-parameter)
+            from .ops.coords import (
+                ecliptic_epoch,
+                equatorial_to_ecliptic_tangent,
+                pulsar_ra_dec,
+            )
+
+            epoch = ecliptic_epoch(self.name)
+            ra, dec = pulsar_ra_dec(self.loc, self.name or "")
+            R = equatorial_to_ecliptic_tangent(ra, dec, epoch=epoch)
+            cosd = np.cos(dec)
+            elat = np.deg2rad(par.elat_deg or 0.0)
+
+            def rotated_sigmas(k1: str, k2: str) -> np.ndarray:
+                """sqrt(diag(R Sigma* R^T)) for the starred tangent pair
+                (k1* = k1 cos(dec), k2), incl. the cross term."""
+                s1 = sigmas.get(k1, 0.0) * cosd
+                s2 = sigmas.get(k2, 0.0)
+                c12 = cross(k1, k2) * cosd
+                Sig = np.array([[s1**2, c12], [c12, s2**2]])
+                return np.sqrt(
+                    np.clip(np.diag(R @ Sig @ R.T), 0.0, None)
+                )
+
+            if "RAJ" in sigmas or "DECJ" in sigmas:
+                s_lonstar, s_lat = rotated_sigmas("RAJ", "DECJ")
+                # ELONG's error column is in degrees of plain longitude
+                par.set_param_error(
+                    "ELONG", np.degrees(s_lonstar / np.cos(elat))
+                )
+                par.set_param_error("ELAT", np.degrees(s_lat))
+            if "PMRA" in sigmas or "PMDEC" in sigmas:
+                s_pmlon, s_pmlat = rotated_sigmas("PMRA", "PMDEC") * rad2mas
+                pm_lon_key = (
+                    "PMELONG" if "PMELONG" in par.params else "PMLAMBDA"
+                )
+                pm_lat_key = (
+                    "PMELAT" if "PMELAT" in par.params else "PMBETA"
+                )
+                if pm_lon_key in par.params:
+                    par.set_param_error(pm_lon_key, s_pmlon)
+                if pm_lat_key in par.params:
+                    par.set_param_error(pm_lat_key, s_pmlat)
+
+        if "PX" in sigmas:
+            par.set_param_error("PX", sigmas["PX"] * rad2mas)
+        for k in ("DM", "DM1"):
+            if k in sigmas:
+                par.set_param_error(k, sigmas[k])
+        for k in range(1, len(par.fd_terms) + 1):
+            if f"FD{k}" in sigmas:
+                par.set_param_error(f"FD{k}", sigmas[f"FD{k}"])
+        for label, _v, _r1, _r2 in par.dmx_windows:
+            nm = f"DMX_{label}"
+            if nm in sigmas:
+                par.set_param_error(nm, sigmas[nm])
+        for k in range(len(par.jumps)):
+            nm = f"JUMP{k + 1}"
+            if nm in sigmas:
+                par.set_jump_error(k, sigmas[nm])
+        from .timing.components import BinaryModel
+
+        binary = BinaryModel.from_par(par)
+        if binary is not None:
+            for nm in binary.fit_param_names():
+                if nm in sigmas:
+                    par.set_param_error(nm, sigmas[nm])
 
     def _apply_fit(self, updates: dict) -> None:
         """Apply fitted parameter corrections to the model and par file.
@@ -254,7 +397,7 @@ class SimulatedPulsar:
             if "F2" in updates:
                 par.set_param("F2", new_spin.f2)
 
-            rad2mas = np.degrees(1.0) * 3.6e6
+            rad2mas = RAD_TO_MAS
             ecliptic_par = (
                 par.raj_hours is None
                 and getattr(par, "elong_deg", None) is not None
@@ -296,13 +439,14 @@ class SimulatedPulsar:
                 # dropping them (the pre-round-4 behavior) made fit() a
                 # no-op on sky position for ecliptic pulsars.
                 from .ops.coords import (
+                    ecliptic_epoch,
                     equatorial_to_ecliptic,
                     equatorial_to_ecliptic_tangent,
                     pulsar_ra_dec,
                 )
                 from .timing.components import _parf
 
-                epoch = "1950" if "B" in (self.name or "") else "2000"
+                epoch = ecliptic_epoch(self.name)
                 ra, dec = pulsar_ra_dec(self.loc, self.name or "")
                 if "RAJ" in updates or "DECJ" in updates:
                     lon, lat = equatorial_to_ecliptic(
@@ -314,7 +458,7 @@ class SimulatedPulsar:
                     par.set_param("ELAT", lat)
                     self.loc = {"ELONG": lon, "ELAT": lat}
                 if "PMRA" in updates or "PMDEC" in updates:
-                    R = equatorial_to_ecliptic_tangent(ra, dec)
+                    R = equatorial_to_ecliptic_tangent(ra, dec, epoch=epoch)
                     cosd = np.cos(dec)
                     dstar = np.array([
                         updates.get("PMRA", 0.0) * cosd,

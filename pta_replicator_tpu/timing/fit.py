@@ -30,32 +30,48 @@ def design_matrix(toas_s: np.ndarray, f0: float, nspin: int = 2, xp=np):
     return xp.stack(cols, axis=-1)
 
 
-def _normalized_lstsq(Mw, rw, M, r, xp):
-    """Column-normalized least squares (the t^k columns span ~1e14 in scale)."""
+def _normalized_lstsq(Mw, rw, M, r, xp, return_cov: bool = False):
+    """Column-normalized least squares (the t^k columns span ~1e14 in scale).
+
+    With ``return_cov`` also returns the parameter covariance
+    (M^T C^-1 M)^-1 — the PINT-fitter uncertainty matrix — computed from
+    the whitened design via pinv so rank-deficient (zeroed) columns give
+    zero variance instead of raising.
+    """
     norms = xp.sqrt(xp.sum(Mw**2, axis=-2))
     norms = xp.where(norms == 0, 1.0, norms)
-    p_scaled, *_ = xp.linalg.lstsq(Mw / norms, rw)
+    Mn = Mw / norms
+    p_scaled, *_ = xp.linalg.lstsq(Mn, rw)
     p = p_scaled / norms
     post = r - M @ p
-    return p, post
+    if not return_cov:
+        return p, post
+    pcov = xp.linalg.pinv(Mn.T @ Mn, hermitian=True)
+    pcov = pcov / (norms[..., :, None] * norms[..., None, :])
+    return p, post, pcov
 
 
-def wls_fit(residuals_s, errors_s, M, xp=np):
+def wls_fit(residuals_s, errors_s, M, xp=np, return_cov: bool = False):
     """Weighted least squares: minimize ||(r - M p)/sigma||^2.
 
-    Returns (param_update, postfit_residuals_s).
+    Returns (param_update, postfit_residuals_s); with ``return_cov``
+    additionally the parameter covariance (M^T N^-1 M)^-1 whose diagonal
+    holds the 1-sigma parameter uncertainties squared.
     """
     r = xp.asarray(residuals_s)
     sigma = xp.asarray(errors_s)
     Mw = M / sigma[..., None]
     rw = r / sigma
-    return _normalized_lstsq(Mw, rw, M, r, xp)
+    return _normalized_lstsq(Mw, rw, M, r, xp, return_cov=return_cov)
 
 
-def gls_fit(residuals_s, cov, M, xp=np, jitter: float = 0.0):
+def gls_fit(residuals_s, cov, M, xp=np, jitter: float = 0.0,
+            return_cov: bool = False):
     """Generalized least squares with a dense noise covariance ``cov``.
 
-    Solves p = (M^T C^-1 M)^-1 M^T C^-1 r via Cholesky of C.
+    Solves p = (M^T C^-1 M)^-1 M^T C^-1 r via Cholesky of C; with
+    ``return_cov`` additionally returns (M^T C^-1 M)^-1 itself (the
+    per-parameter uncertainty matrix PINT's GLSFitter reports).
     """
     r = xp.asarray(residuals_s)
     n = r.shape[-1]
@@ -64,7 +80,7 @@ def gls_fit(residuals_s, cov, M, xp=np, jitter: float = 0.0):
     # whiten by solving L x = v
     Mw = xp.linalg.solve(L, M)
     rw = xp.linalg.solve(L, r)
-    return _normalized_lstsq(Mw, rw, M, r, xp)
+    return _normalized_lstsq(Mw, rw, M, r, xp, return_cov=return_cov)
 
 
 def noise_covariance(
@@ -84,6 +100,8 @@ def noise_covariance(
     chrom_nmodes: int = 30,
     chrom_ref_freq_mhz: float = 1400.0,
     freqs_mhz=None,
+    gwb_spectrum: dict = None,
+    gwb_nmodes: int = 30,
     xp=np,
 ):
     """Assemble the dense GLS noise covariance the reference gets from
@@ -91,6 +109,13 @@ def noise_covariance(
 
         C = diag((EFAC sigma)^2 + EQUAD^2) + U diag(ECORR^2) U^T
             + F Phi(A, gamma) F^T  [+ S F Phi_chrom F^T S, chromatic]
+
+    ``gwb_spectrum``: kwargs for models.gwb.characteristic_strain
+    (log10_amplitude/spectral_index, or turnover/user_spectrum forms) —
+    adds the injected GWB's per-pulsar auto-term as a further low-rank
+    block with prior hc^2(f)/(12 pi^2 f^3 T). The reference omits this
+    (PINT knows nothing of the injection), leaving GWB-recipe refits
+    mis-specified; see gls_noise_model for the measured calibration.
 
     ``efac``/``equad_s`` are scalars or per-TOA vectors; ``ecorr_s`` is a
     scalar or per-epoch vector with ``epoch_index`` mapping TOAs to
@@ -163,6 +188,20 @@ def noise_covariance(
         )
         Fs = F * s[:, None]
         C = C + (Fs * phi[None, :]) @ Fs.T
+
+    if gwb_spectrum is not None:
+        if toas_s is None:
+            raise ValueError("GWB auto-term covariance needs toas_s")
+        from ..models.gwb import characteristic_strain
+        from ..ops.fourier import fourier_basis, fourier_frequencies
+
+        t = xp.asarray(toas_s)
+        T = tspan_s if tspan_s is not None else float(t.max() - t.min())
+        f = fourier_frequencies(T, nmodes=gwb_nmodes, xp=xp)
+        F = fourier_basis(t, f, xp=xp)
+        hc = characteristic_strain(f, xp=xp, **gwb_spectrum)
+        phi = xp.repeat(hc**2 / (12.0 * xp.pi**2 * f**3 * T), 2)
+        C = C + (F * phi[None, :]) @ F.T
     return C
 
 
@@ -314,6 +353,29 @@ def covariance_from_recipe(
             chrom_ref_freq_mhz=recipe.chrom_ref_freq_mhz,
             freqs_mhz=psr.toas.freqs_mhz,
         )
+    gwb_spectrum = None
+    if (
+        getattr(recipe, "gwb_log10_amplitude", None) is not None
+        or getattr(recipe, "gwb_user_spectrum", None) is not None
+    ):
+        gwb_spectrum = dict(
+            log10_amplitude=(
+                None if recipe.gwb_log10_amplitude is None
+                else float(np.asarray(row(recipe.gwb_log10_amplitude)))
+            ),
+            spectral_index=(
+                None if recipe.gwb_gamma is None
+                else float(np.asarray(row(recipe.gwb_gamma)))
+            ),
+            turnover=recipe.gwb_turnover,
+            f0=recipe.gwb_f0,
+            beta=recipe.gwb_beta,
+            power=recipe.gwb_power,
+            user_spectrum=(
+                None if recipe.gwb_user_spectrum is None
+                else np.asarray(recipe.gwb_user_spectrum)
+            ),
+        )
     return noise_covariance(
         psr.toas.errors_s,
         efac=efac,
@@ -327,5 +389,7 @@ def covariance_from_recipe(
         chrom_log10_amplitude=chrom_amp,
         chrom_gamma=chrom_gamma,
         **chrom_kwargs,
+        gwb_spectrum=gwb_spectrum,
+        gwb_nmodes=getattr(recipe, "gwb_gls_nmodes", 30),
         xp=xp,
     )

@@ -25,7 +25,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from ..constants import DAY_IN_SEC
+from ..constants import DAY_IN_SEC, MAS_TO_RAD
 from ..io.par import ParModel
 
 
@@ -185,6 +185,7 @@ class TimingModel:
     @classmethod
     def from_par(cls, par) -> "TimingModel":
         from ..ops.coords import (
+            ecliptic_epoch,
             equatorial_to_ecliptic_tangent,
             pulsar_ra_dec,
         )
@@ -203,7 +204,7 @@ class TimingModel:
         px_rad = 0.0
         posepoch = 0.0
         if ra is not None:
-            mas2rad = np.deg2rad(1.0) / 3.6e6
+            mas2rad = MAS_TO_RAD
             pm_star = None  # (mu_alpha*, mu_delta) [rad/yr]
             if "PMRA" in par.params or "PMDEC" in par.params:
                 pm_star = np.array([
@@ -220,7 +221,9 @@ class TimingModel:
                     (_parf(par, "PMELAT", None)
                      or _parf(par, "PMBETA", 0.0) or 0.0),
                 ]) * mas2rad
-                R = equatorial_to_ecliptic_tangent(ra, dec)
+                R = equatorial_to_ecliptic_tangent(
+                    ra, dec, epoch=ecliptic_epoch(par.name)
+                )
                 pm_star = R.T @ pm_ecl  # orthonormal: inverse = transpose
             if pm_star is not None and np.any(pm_star):
                 ca, sa = np.cos(ra), np.sin(ra)

