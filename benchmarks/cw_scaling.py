@@ -12,7 +12,23 @@ recorded evidence rather than a claim.
 Usage: python benchmarks/cw_scaling.py [max_exp] [backend]
   max_exp: ladder goes 10^2 .. 10^max_exp sources (default 5)
   backend: scan | pallas | both (default scan; pallas needs a real TPU)
+  CW_CHUNKS="1024" (env): comma-separated scan-chunk candidates for the
+  >=1e5 rungs, overriding the default {512,1024,4096} sweep — a single
+  1e6-source evaluation takes tens of minutes on a 1-core CPU host, so
+  a CPU evidence run must bound the sweep to stay feasible.
+  CW_LOOPS=2 (env): timed best-of loops per candidate (1 on CPU).
+  CW_NPSR=68 / CW_NTOA=7758 (env): batch shape. The per-(source x TOA)
+  throughput metric is shape-normalized, so a reduced-TOA ladder (e.g.
+  CW_NTOA=122, the reference's own parity-workload TOA count) reaches
+  the reference's 1e7-source regime on hosts where the full 7,758-TOA
+  product would take days; rungs record the shape they ran at.
 Prints one JSON line.
+
+The "pallas" arm measures the ARCHIVED Mosaic kernel (retired from the
+production backend enum in round 5 — docs/DESIGN.md section 4) by
+calling ops.pallas_cw.cw_catalog_response directly; this tool remains
+the instrument that could reopen the decision if a large-catalog regime
+ever shows the kernel winning on real hardware.
 """
 import json
 import os
@@ -39,7 +55,8 @@ def main():
     from pta_replicator_tpu.batch import synthetic_batch
     from pta_replicator_tpu.models import batched as B
 
-    npsr, ntoa = 68, 7758
+    npsr = int(os.environ.get("CW_NPSR", "68"))
+    ntoa = int(os.environ.get("CW_NTOA", "7758"))
     batch = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=4, seed=0)
     rng = np.random.default_rng(1)
 
@@ -66,21 +83,52 @@ def main():
             # size itself is a first-order knob for BOTH backends, so
             # the win-or-retire comparison sweeps it and keeps the best
             # per backend (each candidate is recorded).
-            if n >= 10**5:
-                chunks = [512, 1024, 4096]
+            if backend == "pallas":
+                # the archived kernel's tiling knob is (src_tile,
+                # toa_tile), swept like the scan chunk so the
+                # reopen-the-decision comparison is fair to both
+                chunks = [(8, 1024), (8, 2048), (16, 1024), (32, 1024)]
+            elif n >= 10**5:
+                env_chunks = os.environ.get("CW_CHUNKS")
+                chunks = (
+                    [int(c) for c in env_chunks.split(",")]
+                    if env_chunks else [512, 1024, 4096]
+                )
             else:
                 chunks = [min(1024, n)]
             best_row = None
             tried = {}
             for chunk in chunks:
                 try:
-                    fn = jax.jit(
-                        lambda eps, args=args, chunk=chunk:
-                        B.cgw_catalog_delays(
-                            batch, *args, chunk=chunk, backend=backend
+                    if backend == "pallas":
+                        from pta_replicator_tpu.ops.pallas_cw import (
+                            cw_catalog_response,
                         )
-                        + eps
-                    )
+
+                        src_c, psr_c, evolve = B.cw_catalog_planes_for(
+                            batch, *args
+                        )
+                        u = batch.toas_s - jnp.asarray(
+                            batch.start_s, batch.toas_s.dtype
+                        )
+                        st, tt = chunk
+                        fn = jax.jit(
+                            lambda eps, u=u, s=src_c, p=psr_c, e=evolve,
+                            st=st, tt=tt:
+                            cw_catalog_response(
+                                u, s, p, psr_term=True, evolve=e,
+                                src_tile=st, toa_tile=tt,
+                            ) * batch.mask
+                            + eps
+                        )
+                    else:
+                        fn = jax.jit(
+                            lambda eps, args=args, chunk=chunk:
+                            B.cgw_catalog_delays(
+                                batch, *args, chunk=chunk, backend=backend
+                            )
+                            + eps
+                        )
                     zero = jnp.zeros((), batch.toas_s.dtype)
                     np.asarray(fn(zero))  # compile + run once
                     t0 = time.perf_counter()
@@ -89,7 +137,7 @@ def main():
                     # target ~1s of measurement per rung, 50 reps max
                     reps = max(1, min(50, int(1.0 / max(t1, 1e-4))))
                     best = np.inf
-                    for _ in range(2):
+                    for _ in range(int(os.environ.get("CW_LOOPS", "2"))):
                         t0 = time.perf_counter()
                         for _ in range(reps):
                             r = fn(zero)

@@ -15,6 +15,12 @@ Protocol:
 Usage: python benchmarks/sweep_kill_resume.py [nreal] [chunk]
   defaults 1_000_000 x 800 on TPU-class hardware; use small values
   (e.g. 2048 256) for a CPU smoke run with BENCH_PLATFORM=cpu.
+  SWEEP_NPSR / SWEEP_NTOA / SWEEP_NCW shrink the per-realization
+  workload (default: the full 68 x 7758 bench shape) so a CPU-only
+  round can still push the REALIZATION axis past 1e5 — the checkpoint
+  cadence, chunk files, and stream-contract fingerprints are what this
+  rehearsal exercises, and they scale with nreal/chunk, not with the
+  pulsar count.
 Prints one JSON line.
 """
 import glob
@@ -31,6 +37,17 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _workload_shape() -> tuple:
+    """(npsr, ntoa, ncw) from the SWEEP_* env knobs — parsed in exactly
+    one place so the report fingerprint and the executed workload can
+    never disagree."""
+    return (
+        int(os.environ.get("SWEEP_NPSR", "68")),
+        int(os.environ.get("SWEEP_NTOA", "7758")),
+        int(os.environ.get("SWEEP_NCW", "100")),
+    )
+
+
 def _run_sweep(ckpt: str, nreal: int, chunk: int) -> np.ndarray:
     import jax
 
@@ -40,7 +57,8 @@ def _run_sweep(ckpt: str, nreal: int, chunk: int) -> np.ndarray:
     from bench import build_workload
     from pta_replicator_tpu.utils.sweep import sweep
 
-    batch, recipe = build_workload()
+    npsr, ntoa, ncw = _workload_shape()
+    batch, recipe = build_workload(npsr=npsr, ntoa=ntoa, ncw=ncw)
     return sweep(
         jax.random.PRNGKey(42), batch, recipe, nreal=nreal,
         checkpoint_path=ckpt, chunk=chunk,
@@ -61,8 +79,10 @@ def main():
     d = tempfile.mkdtemp(prefix="sweep_kr_")
     ckpt_a = os.path.join(d, "a.npz")
     ckpt_b = os.path.join(d, "b.npz")
+    npsr, ntoa, ncw = _workload_shape()
     report = {
         "nreal": nreal, "chunk": chunk,
+        "npsr": npsr, "ntoa": ntoa, "ncw": ncw,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
 
@@ -71,6 +91,8 @@ def main():
     report["uninterrupted_s"] = round(time.perf_counter() - t0, 2)
     report["rate_real_per_s"] = round(nreal / report["uninterrupted_s"], 1)
 
+    # the child inherits the SWEEP_* workload env unchanged, so A and B
+    # provably run the same shape
     env = dict(os.environ, SWEEP_CHILD="1")
     args = [sys.executable, os.path.abspath(__file__), ckpt_b,
             str(nreal), str(chunk)]

@@ -425,80 +425,6 @@ def gwb_delays(
     return uniform_grid_interp(batch.toas_s, ut[0], ut[-1], grid_series) * batch.mask
 
 
-#: cached result of the one-shot Pallas viability probe, keyed by the
-#: (npsr, toa_tile, src_tile, dtype, psr_term, evolve) kernel variant
-_PALLAS_PROBE: dict = {}
-#: diagnosis of failed probes, same keys — surfaced by the bench JSON so
-#: an on-hardware Mosaic failure is recorded evidence, not a lost warning
-_PALLAS_PROBE_ERRORS: dict = {}
-
-
-def pallas_probe_report() -> dict:
-    """Outcome of every Pallas viability probe run in this process:
-    ``{key-string: True | error-string}``."""
-    return {
-        " ".join(map(str, k)): _PALLAS_PROBE_ERRORS.get(k, ok)
-        for k, ok in _PALLAS_PROBE.items()
-    }
-
-
-def _pallas_usable(
-    npsr: int, ntoa: int, nsrc: int, dtype, psr_term: bool, evolve: bool
-) -> bool:
-    """Compile-and-run the Pallas CW kernel once at exactly the tile
-    sizes, pulsar count, and dtype a production ``backend='pallas'`` call
-    would use on the current default backend. Since round 3 the library's
-    ``backend='auto'`` no longer consults this (auto is always scan —
-    docs/DESIGN.md section 4); the probe remains the viability evidence
-    path for bench.py, which records each probe's outcome or exception
-    string per round. A failed probe is cached and warns once; clear
-    ``_PALLAS_PROBE`` to retry."""
-    # mirror cw_catalog_response's tile derivation so the probe compiles
-    # the same kernel instantiation production will
-    from ..ops.pallas_cw import cw_tiles
-
-    src_tile, toa_tile = cw_tiles(nsrc, ntoa)
-    key = (
-        npsr, toa_tile, src_tile, jnp.dtype(dtype).name, psr_term, evolve,
-    )
-    if key not in _PALLAS_PROBE:
-        try:
-            from ..ops.pallas_cw import (
-                cw_catalog_planes,
-                cw_catalog_response,
-            )
-
-            # 2x2-tile workload so the probe exercises the multi-tile
-            # grid (incl. the out_ref accumulation across source tiles)
-            # production compiles, not just a (1,1)-grid program
-            one = np.full((2 * src_tile,), 0.5)
-            phat = np.tile(np.eye(3), (npsr // 3 + 1, 1))[:npsr]
-            src_c, psr_c = cw_catalog_planes(
-                phat, one, one, 1e8 * one, 100.0 * one,
-                1e-8 * one, one, one, one, evolve=evolve, dtype=dtype,
-            )
-            toas = jnp.broadcast_to(
-                jnp.linspace(0.0, 1e8, 2 * toa_tile, dtype=dtype),
-                (npsr, 2 * toa_tile),
-            )
-            out = cw_catalog_response(
-                toas, src_c, psr_c, psr_term=psr_term, evolve=evolve,
-                src_tile=src_tile, toa_tile=toa_tile,
-            )
-            # host readback forces real execution, not just dispatch
-            _PALLAS_PROBE[key] = bool(np.isfinite(np.asarray(out)).all())
-        except Exception as exc:  # Mosaic lowering/compile/runtime failure
-            import warnings
-
-            warnings.warn(
-                "Pallas CW kernel probe failed; cgw backend 'auto' falls "
-                f"back to 'scan' for this process: {exc!r}"
-            )
-            _PALLAS_PROBE[key] = False
-            _PALLAS_PROBE_ERRORS[key] = repr(exc)
-    return _PALLAS_PROBE[key]
-
-
 def _cw_scan_response(
     toas_rel, src_c, psr_c, psr_term: bool, evolve: bool, chunk: int
 ):
@@ -650,12 +576,25 @@ def cgw_catalog_delays_from_planes(
     u = batch.toas_s - jnp.asarray(batch.start_s, dtype)
     if backend == "auto":
         backend = "scan"  # docs/DESIGN.md section 4
-    if backend not in ("pallas", "pallas_interpret", "scan"):
+    if backend == "pallas":
+        # Retired round 5: measured tied-or-lost vs the scan tiling on a
+        # real v5e at the flagship shape (rounds 3-4), never chosen by
+        # `auto`, and the large-catalog regime where it might win never
+        # got a hardware window. The kernel stays in ops/pallas_cw.py as
+        # a working Mosaic study — `pallas_interpret` still runs its
+        # logic everywhere, and benchmarks/cw_scaling.py measures the
+        # archived kernel directly on TPU. docs/DESIGN.md section 4.
+        raise ValueError(
+            "CW-catalog backend 'pallas' was retired in round 5 (see "
+            "docs/DESIGN.md section 4); use 'scan' (production) or "
+            "'pallas_interpret' (kernel-logic study)"
+        )
+    if backend not in ("pallas_interpret", "scan"):
         raise ValueError(f"unknown CW-catalog backend {backend!r}")
-    if backend in ("pallas", "pallas_interpret"):
+    if backend == "pallas_interpret":
         out = cw_catalog_response(
             u, src_c, psr_c, psr_term=psr_term, evolve=evolve,
-            interpret=backend == "pallas_interpret",
+            interpret=True,
         )
     else:
         out = _cw_scan_response(u, src_c, psr_c, psr_term, evolve, chunk)
@@ -687,22 +626,24 @@ def cgw_catalog_delays(
     (deterministic.py:258-294, 321-440) with explicit memory tiling of the
     (Nsrc x Nt) product. ``pdist`` (kpc) may be a scalar, (Ns,), or
     (Np, Ns); ``pphase`` ((Ns,) or (Np, Ns)) overrides it with explicit
-    pulsar-term phases (reference deterministic.py:99-108). Two
-    interchangeable backends consume the same epoch-folded coefficient
-    planes (ops.pallas_cw.cw_catalog_planes — precomputed in float64 on
+    pulsar-term phases (reference deterministic.py:99-108). The backends
+    consume the same epoch-folded coefficient planes
+    (ops.pallas_cw.cw_catalog_planes — precomputed in float64 on
     the host whenever the parameters are concrete, which is what makes
     the float32 device path accurate; see the pallas_cw module docstring):
 
-    * ``"pallas"`` — the TPU kernel in ops.pallas_cw: a (Nt/T, Ns/S)
-      grid holding one (S, T) workspace tile in VMEM per program;
-    * ``"scan"``   — a portable ``lax.scan`` over ``chunk``-sized source
-      tiles (the (chunk x Nt) workspace stays VMEM-scale while the scan
-      accumulates the (Np, Nt) sum).
+    * ``"scan"`` (= ``"auto"``, production) — a portable ``lax.scan``
+      over ``chunk``-sized source tiles (the (chunk x Nt) workspace
+      stays VMEM-scale while the scan accumulates the (Np, Nt) sum);
+    * ``"pallas_interpret"`` — the archived Mosaic kernel's logic in
+      Pallas interpret mode (kernel study / tests).
 
-    ``"auto"`` picks scan on every backend (measured statistically tied
-    with the kernel on a real v5e, and scan has no Mosaic failure modes —
-    docs/DESIGN.md section 4); pass ``"pallas"`` explicitly to use the
-    kernel. Deterministic (no key): source parameters are data.
+    ``"pallas"`` (the compiled TPU kernel) was RETIRED in round 5: it
+    measured statistically tied-or-slower than scan on a real v5e at
+    the flagship shape and was never chosen by ``auto``
+    (docs/DESIGN.md section 4 keeps the Mosaic findings;
+    benchmarks/cw_scaling.py can still measure the archived kernel
+    directly). Deterministic (no key): source parameters are data.
 
     For catalog sweeps under jit/vmap, precompute planes per catalog
     with :func:`cw_catalog_planes_for` and run
@@ -908,10 +849,10 @@ class Recipe:
     gwb_synthesis_precision: object = field(
         metadata=dict(static=True), default=None
     )
-    #: CW-catalog backend: "auto" (resolves to "scan" everywhere — the
-    #: Pallas kernel measures tied on a real v5e and has more failure
-    #: modes, docs/DESIGN.md section 4), "pallas", "pallas_interpret",
-    #: or "scan"
+    #: CW-catalog backend: "auto" (= "scan", the production tiling) or
+    #: "pallas_interpret" (archived-kernel logic study). "pallas" was
+    #: retired round 5 — tied-or-lost on a real v5e, never chosen by
+    #: auto (docs/DESIGN.md section 4) — and now raises.
     cgw_backend: str = field(metadata=dict(static=True), default="auto")
     transient_psr: int = field(metadata=dict(static=True), default=0)
 

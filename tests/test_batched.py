@@ -381,6 +381,20 @@ def test_cgw_pallas_kernel_matches_scan(batch, mode):
     )
 
 
+def test_cgw_pallas_backend_retired(batch):
+    """backend='pallas' was retired in round 5 and must raise with a
+    pointer to the rationale, not silently fall back or try to compile
+    Mosaic on an unknown platform."""
+    b, _ = batch
+    cat = [np.array([1.0]), np.array([0.5]), np.array([1e9]),
+           np.array([100.0]), np.array([1e-8]), np.array([0.3]),
+           np.array([0.1]), np.array([0.7])]
+    with pytest.raises(ValueError, match="retired"):
+        B.cgw_catalog_delays(b, *cat, backend="pallas")
+    with pytest.raises(ValueError, match="unknown CW-catalog backend"):
+        B.cgw_catalog_delays(b, *cat, backend="numba")
+
+
 def test_cgw_pallas_nan_guard(batch):
     """Merged binaries (past-merger chirp) inject zeros, not NaNs, in both
     backends (reference deterministic.py:433-438)."""
@@ -1023,6 +1037,40 @@ def test_gwb_auto_prior_powerlaw_equivalence():
     # difference (~0.2% at gamma = 13/3)
     ent = np.asarray(powerlaw_prior(np.repeat(f, 2), A, gam, T))
     np.testing.assert_allclose(np.asarray(phi[0]), ent, rtol=5e-3)
+
+
+def test_gwb_auto_prior_user_spectrum():
+    """The GLS GWB block must follow a user-supplied hc(f) — including
+    the flat endpoint clamp — not just the power law."""
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.ops.fourier import fourier_frequencies
+
+    b = synthetic_batch(npsr=2, ntoa=64, nbackend=2, seed=1,
+                        dtype=jnp.float64)
+    T = float(np.asarray(b.tspan_s[0]))
+    uf = np.logspace(-8.6, -7.6, 16)
+    uh = 2e-15 * (uf / 1e-8) ** (-2.0 / 3.0)
+    rec = B.Recipe(
+        efac=jnp.asarray(1.0),
+        gwb_user_spectrum=jnp.asarray(np.column_stack([uf, uh])),
+    )
+    _, _, U, phi = B.gls_noise_model(b, rec)
+    assert U is not None and bool(jnp.all(jnp.isfinite(phi)))
+    f = np.asarray(fourier_frequencies(T, nmodes=30))
+    # inside the node range the prior tracks the user power law
+    inside = (f >= uf[0]) & (f <= uf[-1])
+    hc = 2e-15 * (f / 1e-8) ** (-2.0 / 3.0)
+    want = hc**2 / (12.0 * np.pi**2 * f**3 * T)
+    got = np.asarray(phi[0])[0::2]  # sin coefficients, one per freq
+    np.testing.assert_allclose(got[inside], want[inside], rtol=1e-6)
+    # below the first node: flat hc clamp (uh[0])
+    below = f < uf[0]
+    if below.any():
+        want_lo = uh[0] ** 2 / (12.0 * np.pi**2 * f[below] ** 3 * T)
+        np.testing.assert_allclose(got[below], want_lo, rtol=1e-6)
 
 
 def test_gwb_auto_term_variance_calibration():
