@@ -1068,25 +1068,13 @@ def gls_noise_model(batch: PulsarBatch, recipe: "Recipe"):
     return sigma2, ecorr2, U, phi
 
 
-def gls_fit_subtract(
-    delays, batch: PulsarBatch, design, recipe: "Recipe", ridge=1e-10
-):
-    """Batched full-model GLS refit on device: subtract the
-    C^-1-weighted best fit of the design columns, with
-    C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T from the recipe's
-    own noise model (gls_noise_model) — the device analog of the
-    oracle's ``fit(fitter='gls', recipe=...)`` and of the reference's
-    PINT GLSFitter path (simulate.py:57-61).
-
-    C is never materialized: the ECORR block inverts analytically
-    per-epoch (disjoint indicators -> diagonal inner system, segment
-    sums), and the red-noise block goes through a Woodbury solve of an
-    (R, R) system, so the cost is batched (Nt x K/R) matmuls — MXU
-    work — instead of an (Nt, Nt) dense factorization per pulsar.
-    f32 caveat as design_fit_subtract: validate against the oracle GLS
-    when exact parameter recovery matters (test_batched does, in f64).
-    """
-    dtype = delays.dtype
+def _gls_design_system(batch: PulsarBatch, design, recipe: "Recipe",
+                       ridge, dtype):
+    """Shared assembly for the batched GLS refit: the column-normalized
+    normal matrix A = N^-1 (M^T C^-1 M) N^-1 (+ ridge and padding-column
+    unit rows), its normalization, and the C^-1 operator itself. Split
+    out so :func:`gls_fit_uncertainties` prices the SAME system
+    gls_fit_subtract solves — the two can never drift apart."""
     sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
     winv = jnp.where(batch.mask > 0, 1.0 / sigma2, 0.0)  # N^-1 diagonal
     psr_rows = jnp.arange(batch.npsr)[:, None]
@@ -1143,7 +1131,6 @@ def gls_fit_subtract(
         cinv_mat = c0inv_mat
 
     CiM = cinv_mat(design)  # (Np, Nt, K)
-    Cir = cinv_mat(delays[..., None])[..., 0]  # (Np, Nt)
     # column normalization + zero-column neutralization, as in
     # design_fit_subtract (padded columns solve to exactly 0)
     norms = jnp.sqrt(
@@ -1161,10 +1148,63 @@ def gls_fit_subtract(
     )
     A = A + jnp.eye(K, dtype=dtype) * zero_col[:, None, :].astype(dtype)
     A = A + ridge * jnp.eye(K, dtype=dtype)
+    return A, norms, zero_col, cinv_mat, design
+
+
+def gls_fit_subtract(
+    delays, batch: PulsarBatch, design, recipe: "Recipe", ridge=1e-10
+):
+    """Batched full-model GLS refit on device: subtract the
+    C^-1-weighted best fit of the design columns, with
+    C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T from the recipe's
+    own noise model (gls_noise_model) — the device analog of the
+    oracle's ``fit(fitter='gls', recipe=...)`` and of the reference's
+    PINT GLSFitter path (simulate.py:57-61).
+
+    C is never materialized: the ECORR block inverts analytically
+    per-epoch (disjoint indicators -> diagonal inner system, segment
+    sums), and the red-noise block goes through a Woodbury solve of an
+    (R, R) system, so the cost is batched (Nt x K/R) matmuls — MXU
+    work — instead of an (Nt, Nt) dense factorization per pulsar.
+    f32 caveat as design_fit_subtract: validate against the oracle GLS
+    when exact parameter recovery matters (test_batched does, in f64).
+    """
+    dtype = delays.dtype
+    A, norms, _zero, cinv_mat, design = _gls_design_system(
+        batch, design, recipe, ridge, dtype
+    )
+    Cir = cinv_mat(delays[..., None])[..., 0]  # (Np, Nt)
     b = jnp.einsum("pnk,pn->pk", design, Cir, precision="highest") / norms
     coef = jnp.linalg.solve(A, b[..., None])[..., 0] / norms
     model = jnp.einsum("pnk,pk->pn", design, coef, precision="highest")
     return (delays - model) * batch.mask
+
+
+def gls_fit_uncertainties(
+    batch: PulsarBatch, design, recipe: "Recipe", ridge=1e-10, dtype=None
+):
+    """Per-parameter 1-sigma uncertainties of the batched GLS refit:
+    sqrt(diag((M^T C^-1 M)^-1)), (Np, K) — the device twin of the
+    oracle ``fit()``'s ``fit_uncertainties`` (timing.fit.gls_fit
+    ``return_cov``; the reference reports these via PINT's fitters).
+
+    Delay-independent (the covariance describes the estimator, not any
+    one realization), so a sweep prices it ONCE per (batch, design,
+    recipe), not per realization. Padding (all-zero) design columns
+    report 0. Same nested-Woodbury system as gls_fit_subtract — the
+    shared :func:`_gls_design_system` assembly guarantees it, PROVIDED
+    the dtypes match: gls_fit_subtract assembles at its ``delays``
+    dtype, so pass ``dtype=delays.dtype`` when it differs from the
+    batch's (e.g. f64 delays on an f32 batch under JAX_ENABLE_X64).
+    """
+    dtype = dtype if dtype is not None else batch.toas_s.dtype
+    A, norms, zero_col, _cinv, _design = _gls_design_system(
+        batch, design, recipe, ridge, dtype
+    )
+    Ainv = jnp.linalg.inv(A)
+    var = jnp.maximum(jnp.diagonal(Ainv, axis1=-2, axis2=-1), 0.0)
+    sig = jnp.sqrt(var) / norms
+    return jnp.where(zero_col, 0.0, sig)
 
 
 def residualize(delays, batch: PulsarBatch):

@@ -980,6 +980,9 @@ def test_gls_fit_subtract_matches_oracle_dense():
     post = np.asarray(
         B.gls_fit_subtract(delays, batch, design, recipe)
     )
+    dev_sig = np.asarray(
+        B.gls_fit_uncertainties(batch, design, recipe)
+    )
 
     # oracle, per pulsar, dense C (quantize epochs must match the
     # batch's: same coarsegrain default)
@@ -993,10 +996,18 @@ def test_gls_fit_subtract_matches_oracle_dense():
             f0=psr.model.f0, flags=psr.toas.flags,
         )
         r = np.asarray(delays[i][:n], dtype=np.float64)
-        _, ref_post = gls_fit(r, C, M)
+        _, ref_post, ref_cov = gls_fit(r, C, M, return_cov=True)
         num = np.sqrt(np.mean((post[i][:n] - ref_post) ** 2))
         den = np.sqrt(np.mean(ref_post**2))
         assert num / den < 1e-6, (i, num / den)
+        # device (M^T C^-1 M)^-1 sigmas match the dense-oracle ones
+        ref_sig = np.sqrt(np.clip(np.diag(ref_cov), 0.0, None))
+        kk = M.shape[1]
+        np.testing.assert_allclose(
+            dev_sig[i][:kk], ref_sig, rtol=1e-6
+        )
+        # padding columns report exactly 0
+        assert np.all(dev_sig[i][kk:] == 0.0)
 
 
 def test_gwb_auto_prior_powerlaw_equivalence():
