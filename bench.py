@@ -252,6 +252,20 @@ def _bench():
     if platform:
         jax.config.update("jax_platforms", platform)
 
+    # persistent compilation cache: on the tunneled backend the flagship
+    # compile is minutes, and the tunnel flaps on a minutes cadence — a
+    # cached executable from any earlier successful window (e.g. the
+    # recovery watcher's capture run) makes the next bench attempt fit
+    # inside a short window instead of burning it on recompilation
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization, never a bench failure
+
     prng = os.environ.get("BENCH_PRNG", "threefry")
     if prng not in ("threefry", "rbg"):
         raise SystemExit(f"BENCH_PRNG must be 'threefry' or 'rbg', got {prng!r}")

@@ -1194,10 +1194,13 @@ def gls_fit_uncertainties(
     report 0. Same nested-Woodbury system as gls_fit_subtract — the
     shared :func:`_gls_design_system` assembly guarantees it, PROVIDED
     the dtypes match: gls_fit_subtract assembles at its ``delays``
-    dtype, so pass ``dtype=delays.dtype`` when it differs from the
-    batch's (e.g. f64 delays on an f32 batch under JAX_ENABLE_X64).
+    dtype, so the default promotes the batch dtype with the design's
+    (f64 design on an f32 batch prices in f64, matching a subtract of
+    f64 delays); pass ``dtype=delays.dtype`` explicitly when the delays
+    dtype differs from both.
     """
-    dtype = dtype if dtype is not None else batch.toas_s.dtype
+    if dtype is None:
+        dtype = jnp.result_type(batch.toas_s.dtype, jnp.asarray(design).dtype)
     A, norms, zero_col, _cinv, _design = _gls_design_system(
         batch, design, recipe, ridge, dtype
     )
