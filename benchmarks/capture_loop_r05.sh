@@ -1,0 +1,79 @@
+#!/bin/bash
+# Re-probe the tunnel on a ~4 min cadence; on a live window run the
+# evidence battery in priority order. Every stage writes to /tmp and is
+# promoted into the repo only when it produced valid JSON, so a
+# mid-battery wedge can never clobber evidence captured by an earlier
+# window; completed artifacts are skipped on later windows, and the loop
+# keeps hunting until the whole battery is in.
+cd /root/repo
+LOG=/tmp/capture_log.txt
+log() { date -u +"%H:%M:%SZ $*" >> $LOG; }
+
+have() { # $1: repo artifact — present and parses as JSON?
+  [ -s "$1" ] && python -c "import json,sys; json.load(open(sys.argv[1]))" "$1" 2>/dev/null
+}
+
+stage() { # $1 target  $2 timeout  $3... command (stdout -> target)
+  local target=$1 tmo=$2; shift 2
+  if have "$target"; then log "skip $(basename $target) (already captured)"; return 0; fi
+  local tmp=/tmp/stage_out_$$.json
+  timeout "$tmo" "$@" > "$tmp" 2>> /tmp/stage_err.txt
+  local rc=$?
+  if [ $rc -eq 0 ] && have "$tmp"; then
+    mv "$tmp" "$target"; log "captured $(basename $target)"
+  else
+    log "stage $(basename $target) failed rc=$rc"
+    return 1
+  fi
+}
+
+bench_stage() { # $1 target  $2 done-marker  $3... bench cmd
+  # bench.py emits a value-0.0 failure JSON on a wedge: promote only a
+  # NONZERO value so a failed run never overwrites or freezes evidence
+  local target=$1 marker=$2; shift 2
+  local tmp=/tmp/bench_stage_$$.json
+  timeout 1800 "$@" > "$tmp" 2>>/tmp/stage_err.txt
+  local rc=$?
+  log "$(basename $target) bench rc=$rc"
+  if [ $rc -eq 0 ] && python -c "
+import json,sys; sys.exit(0 if json.load(open(sys.argv[1])).get('value') else 1)" "$tmp"; then
+    cp "$tmp" "$target"
+    log "promoted $(basename $target)"
+    touch "$marker"
+  fi
+}
+
+log "capture loop started"
+for i in $(seq 1 150); do
+  timeout 2400 python benchmarks/fast_capture.py >> /tmp/fast_capture.out 2>&1
+  rc=$?
+  log "fast_capture attempt $i rc=$rc"
+  if [ $rc -eq 0 ] || [ $rc -eq 5 ]; then
+    # rc=5: wedged mid-ladder but early rungs may have landed; push on
+    log "window found (rc=$rc); running battery"
+    [ -f /tmp/bench_canonical_done ] || \
+      bench_stage /root/repo/BENCH_PREVIEW_r05.json /tmp/bench_canonical_done python bench.py
+    stage /root/repo/VPU_CEILING_r05.json     900 python benchmarks/vpu_ceiling.py
+    stage /root/repo/VALIDATE_DEVICE_r05.json 1200 python benchmarks/validate_device.py 2000
+    [ -f /tmp/bench_gls_done ] || \
+      bench_stage /root/repo/BENCH_GLS_r05.json /tmp/bench_gls_done env BENCH_FIT=gls python bench.py
+    stage /root/repo/ABLATION_r05.json        1200 python benchmarks/fused_ablation.py 800 5
+    stage /root/repo/CW_SCALING_r05.json      2400 python benchmarks/cw_scaling.py 6 both
+    stage /root/repo/SWEEP_RESUME_r05.json    3000 python benchmarks/sweep_kill_resume.py 1000000 800
+    stage /root/repo/CW_SCALING_1E7_r05.json  3000 python benchmarks/cw_scaling.py 7 both
+    if [ -f /tmp/bench_canonical_done ] \
+       && have /root/repo/VPU_CEILING_r05.json \
+       && have /root/repo/VALIDATE_DEVICE_r05.json \
+       && [ -f /tmp/bench_gls_done ] \
+       && have /root/repo/ABLATION_r05.json \
+       && have /root/repo/CW_SCALING_r05.json \
+       && have /root/repo/SWEEP_RESUME_r05.json \
+       && have /root/repo/CW_SCALING_1E7_r05.json; then
+      log "battery complete"
+      exit 0
+    fi
+    log "battery incomplete; continuing to hunt windows"
+  fi
+  sleep 45
+done
+log "gave up"

@@ -1,0 +1,255 @@
+"""Lean TPU-window capture: hold the connection from a successful probe
+straight into measurement; flush every stage's number to disk the moment
+it exists. Exit 3 = backend init wedged (retry later), 0 = got the
+headline number."""
+import json, os, sys, threading, time
+import numpy as np
+
+OUT = "/root/repo/BENCH_CAPTURE_r05.jsonl"
+T0 = time.time()
+
+def log(msg):
+    print(f"[{time.time()-T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+def emit(rec):
+    rec = {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           **rec}
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    log(f"emitted: {rec}")
+
+# resettable stage watchdog: the tunnel can wedge at ANY device call
+# (rounds 3-5 saw both init wedges and the 03:53 first-big-op wedge), so
+# every stage arms its own deadline; a wedged stage exits fast and the
+# outer loop re-probes on its short cadence instead of waiting out the
+# 2400 s kill
+_deadline = [time.time() + 180.0]
+_exit_code = [3]
+def _watchdog():
+    while True:
+        time.sleep(5.0)
+        if time.time() > _deadline[0]:
+            log(f"stage wedged past its deadline, exiting {_exit_code[0]}")
+            os._exit(_exit_code[0])
+threading.Thread(target=_watchdog, daemon=True).start()
+
+def arm(seconds, code=5):
+    """(Re)arm the watchdog for the next stage."""
+    _deadline[0] = time.time() + seconds
+    _exit_code[0] = code
+
+os.makedirs("/root/repo/.jax_cache", exist_ok=True)
+import jax
+_want = os.environ.get("FAST_CAPTURE_PLATFORM", "tpu")
+if _want != "tpu":
+    jax.config.update("jax_platforms", _want)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+import jax.numpy as jnp
+
+probe = float(np.asarray(jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum())
+arm(300)  # workload build + device transfer budget
+log(f"backend up: {jax.default_backend()} {jax.devices()[0].device_kind}, probe={probe}")
+if jax.default_backend() != _want:
+    log(f"backend is {jax.default_backend()}, wanted {_want}; exiting 4")
+    sys.exit(4)
+
+META = {
+    "jax_backend": jax.default_backend(),
+    "device_kind": jax.devices()[0].device_kind,
+    "jax_version": jax.__version__,
+    "metric": ("NG15-scale full-dataset realizations/sec, single chip "
+               "(68 psr x 7758 TOAs: EFAC+EQUAD+ECORR+RN30+HD-GWB(Nf~3000)"
+               "+100-CW catalog+quadratic fit)"),
+}
+
+sys.path.insert(0, "/root/repo")
+from bench import build_workload
+from pta_replicator_tpu.models import batched as B
+from pta_replicator_tpu.models.batched import (
+    quadratic_fit_subtract, realization_delays,
+)
+
+t = time.time()
+batch, recipe = build_workload(ncw=100)
+# the deterministic (CW-catalog) static plane is key-independent data:
+# a pre-serialized copy (benchmarks/mk_workload.py writes it on the CPU
+# backend) saves one tunnel compile inside the window; fall back to the
+# on-device eager compute bench.py uses when the cache file is absent
+_npz = "/tmp/workload.npz"
+static_np = None
+if os.path.exists(_npz):
+    try:
+        cand = np.load(_npz)["static"]
+        # a stale/foreign cache must not silently change the workload
+        if (cand.shape == tuple(np.shape(batch.toas_s))
+                and cand.dtype == np.dtype(np.float32)):
+            static_np = cand
+        else:
+            log(f"stale workload cache {cand.shape}/{cand.dtype}, recomputing")
+    except Exception as exc:  # truncated/corrupt file: fall back, don't die
+        log(f"unreadable workload cache ({exc!r}), recomputing")
+log(f"workload built {time.time()-t:.1f}s (static cached: {static_np is not None})")
+
+t = time.time()
+batch = jax.device_put(batch)
+if static_np is not None:
+    static = jax.device_put(jnp.asarray(static_np))
+else:
+    from pta_replicator_tpu.models.batched import deterministic_delays
+    static = deterministic_delays(batch, recipe)
+np.asarray(static)
+log(f"static ready + fence {time.time()-t:.1f}s")
+emit({**META, "stage": "device_ready", "setup_s": round(time.time()-T0, 1)})
+
+
+def make_chunk_fn(chunk):
+    @jax.jit
+    def run_chunk(key, static):
+        keys = jax.random.split(key, chunk)
+        def one(k):
+            d = realization_delays(k, batch, recipe) + static
+            return quadratic_fit_subtract(d, batch)
+        res = jax.vmap(one)(keys)
+        return jnp.sqrt(jnp.sum(res**2 * batch.mask, axis=-1)
+                        / jnp.sum(batch.mask, axis=-1))
+    return run_chunk
+
+
+def write_preview(rec, path="/root/repo/BENCH_PREVIEW_r05.json"):
+    """Canonical single-JSON artifact in bench.py's schema, written the
+    moment a headline number exists so bench.py's failure path can cite
+    it as backup evidence."""
+    with open(path, "w") as f:
+        json.dump(rec, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def measure(chunk, nrep, tag, budget=600):
+    arm(budget)
+    t = time.time()
+    compiled = make_chunk_fn(chunk).lower(
+        jax.random.PRNGKey(0), static).compile()
+    compile_s = time.time() - t
+    log(f"{tag}: compiled in {compile_s:.1f}s")
+    t = time.time()
+    out = compiled(jax.random.PRNGKey(0), static)
+    np.asarray(out)
+    warm_s = time.time() - t
+    t0 = time.perf_counter()
+    for i in range(nrep):
+        out = compiled(jax.random.PRNGKey(i + 1), static)
+    np.asarray(out)
+    elapsed = time.perf_counter() - t0
+    rate = nrep * chunk / elapsed
+    rec = {**META, "stage": tag, "value": round(rate, 3),
+           "unit": "realizations/s", "bench_chunk": chunk, "nrep": nrep,
+           "measure_elapsed_s": round(elapsed, 3),
+           "compile_s": round(compile_s, 1), "warmup_s": round(warm_s, 2),
+           "vs_baseline": round(rate / (1000.0 / 60.0), 3),
+           "cgw_static_amortized": True}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        fl = float(ca.get("flops", 0.0))
+        if fl > 0:
+            rec["xla_flops_per_chunk"] = fl
+            rec["achieved_tflops_per_s"] = round(fl * nrep / elapsed / 1e12, 3)
+            rec["mfu_vs_bf16_peak_pct"] = round(
+                100 * fl * nrep / elapsed / 197e12, 3)
+    except Exception as exc:
+        rec["cost_analysis_error"] = repr(exc)[:150]
+    emit(rec)
+    return rec
+
+
+# smallest first: ANY window yields a number — and every rung becomes
+# the preview immediately, so a window that dies mid-ladder still leaves
+# the best number captured so far in the canonical artifact
+rec = measure(100, 3, "chunk100_quick")
+write_preview(rec)
+rec = measure(800, 5, "chunk800_headline")
+write_preview(rec)
+rec = measure(800, 20, "chunk800_long")
+write_preview(rec)
+
+
+def measure_fit(chunk, nrep, mode, tag, kcols=166):
+    """BENCH_FIT=full|gls analog: full-design refit at bench scale. The
+    design is generated on device (350 MB host->tunnel transfer would
+    eat the window; the measurement is statistically identical)."""
+    arm(900)  # GLS compile is the most expensive in the battery
+    import dataclasses
+    fitD = jax.random.normal(
+        jax.random.PRNGKey(99), (batch.npsr, batch.ntoa_max, kcols),
+        batch.toas_s.dtype)
+    rec2 = dataclasses.replace(recipe, fit_design=fitD,
+                               fit_gls=(mode == "gls"))
+
+    @jax.jit
+    def run_chunk(key, static):
+        keys = jax.random.split(key, chunk)
+        def one(k):
+            d = realization_delays(k, batch, rec2) + static
+            return B.finalize_residuals(d, batch, rec2, True)
+        res = jax.vmap(one)(keys)
+        return jnp.sqrt(jnp.sum(res**2 * batch.mask, axis=-1)
+                        / jnp.sum(batch.mask, axis=-1))
+
+    t = time.time()
+    compiled = run_chunk.lower(jax.random.PRNGKey(0), static).compile()
+    compile_s = time.time() - t
+    log(f"{tag}: compiled in {compile_s:.1f}s")
+    out = compiled(jax.random.PRNGKey(0), static)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    for i in range(nrep):
+        out = compiled(jax.random.PRNGKey(i + 1), static)
+    np.asarray(out)
+    elapsed = time.perf_counter() - t0
+    rate = nrep * chunk / elapsed
+    rec = {**META, "stage": tag, "value": round(rate, 3),
+           "unit": "realizations/s", "bench_chunk": chunk, "nrep": nrep,
+           "fit_mode": mode, "fit_columns": kcols,
+           "measure_elapsed_s": round(elapsed, 3),
+           "compile_s": round(compile_s, 1),
+           "vs_baseline": round(rate / (1000.0 / 60.0), 3)}
+    emit(rec)
+    return rec
+
+
+try:
+    rec = measure_fit(400, 3, "gls", "chunk400_gls")
+    write_preview(rec, "/root/repo/BENCH_PREVIEW_r05_gls.json")
+except Exception as exc:
+    emit({"stage": "gls_error", "error": repr(exc)[:300]})
+try:
+    measure_fit(400, 3, "full", "chunk400_wls_full")
+except Exception as exc:
+    emit({"stage": "wls_full_error", "error": repr(exc)[:300]})
+
+# CW scan op timing at the flagship shape
+try:
+    arm(600)
+    args8 = [recipe.cgw_params[i] for i in range(8)]
+    fn = jax.jit(lambda eps: B.cgw_catalog_delays(
+        batch, *args8, chunk=recipe.cgw_chunk, backend="scan") + eps)
+    zero = jnp.zeros((), batch.toas_s.dtype)
+    t = time.time()
+    np.asarray(fn(zero))
+    log(f"cw scan compile+run {time.time()-t:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = fn(zero)
+    np.asarray(out)
+    emit({**META, "stage": "cgw_scan_ms",
+          "value": round((time.perf_counter() - t0) / 10 * 1e3, 3),
+          "unit": "ms per 100-source catalog eval"})
+except Exception as exc:
+    emit({"stage": "cgw_scan_error", "error": repr(exc)[:300]})
+
+log("fast capture complete")

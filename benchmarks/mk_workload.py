@@ -1,0 +1,35 @@
+"""Pre-serialize the bench workload's deterministic static plane on the
+CPU backend, so benchmarks/fast_capture.py spends a flaky-tunnel window
+on the measurement instead of on an extra compile.
+
+The static plane (CW-catalog delays; deterministic_delays) is
+key-independent data: its f64 host plane precompute happens on the host
+either way, so the CPU-computed f32 plane is numerically equivalent input
+data for the rate measurement (the timed region is run_chunk only).
+Writes /tmp/workload.npz (~2 MB).
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from bench import build_workload  # noqa: E402
+from pta_replicator_tpu.models.batched import deterministic_delays  # noqa: E402
+
+t = time.time()
+batch, recipe = build_workload(ncw=100)
+static = np.asarray(deterministic_delays(batch, recipe))
+# atomic write: a reader (fast_capture mid-window) must never see a
+# truncated file
+tmp = "/tmp/workload.tmp.npz"  # np.savez appends .npz to other suffixes
+np.savez(tmp, static=static)
+os.replace(tmp, "/tmp/workload.npz")
+print(f"wrote /tmp/workload.npz {static.shape} {static.dtype} "
+      f"in {time.time()-t:.1f}s")

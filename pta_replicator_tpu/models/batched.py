@@ -1194,13 +1194,15 @@ def gls_fit_uncertainties(
     report 0. Same nested-Woodbury system as gls_fit_subtract — the
     shared :func:`_gls_design_system` assembly guarantees it, PROVIDED
     the dtypes match: gls_fit_subtract assembles at its ``delays``
-    dtype, so the default promotes the batch dtype with the design's
-    (f64 design on an f32 batch prices in f64, matching a subtract of
-    f64 delays); pass ``dtype=delays.dtype`` explicitly when the delays
-    dtype differs from both.
+    dtype, and this helper defaults to the batch dtype — the dtype a
+    subtract of batch-dtype delays (the production pipelines) assembles
+    at. When your delays dtype differs (e.g. f64 delays on an f32 batch
+    under JAX_ENABLE_X64), pass ``dtype=delays.dtype`` explicitly or
+    the sigmas describe a different-precision system than the one the
+    residuals were actually fit with.
     """
     if dtype is None:
-        dtype = jnp.result_type(batch.toas_s.dtype, jnp.asarray(design).dtype)
+        dtype = batch.toas_s.dtype
     A, norms, zero_col, _cinv, _design = _gls_design_system(
         batch, design, recipe, ridge, dtype
     )
