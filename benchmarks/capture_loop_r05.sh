@@ -9,8 +9,13 @@ cd /root/repo
 LOG=/tmp/capture_log.txt
 log() { date -u +"%H:%M:%SZ $*" >> $LOG; }
 
-have() { # $1: repo artifact — present and parses as JSON?
-  [ -s "$1" ] && python -c "import json,sys; json.load(open(sys.argv[1]))" "$1" 2>/dev/null
+have() { # $1: artifact — present, parses as JSON, and is NOT an error
+  # report (several battery scripts print {'error': ...} with exit 0;
+  # freezing one of those as evidence would stop the retry forever)
+  [ -s "$1" ] && python -c "
+import json, sys
+d = json.load(open(sys.argv[1]))
+sys.exit(1 if (isinstance(d, dict) and d.get('error')) else 0)" "$1" 2>/dev/null
 }
 
 stage() { # $1 target  $2 timeout  $3... command (stdout -> target)
@@ -48,8 +53,9 @@ for i in $(seq 1 150); do
   timeout 2400 python benchmarks/fast_capture.py >> /tmp/fast_capture.out 2>&1
   rc=$?
   log "fast_capture attempt $i rc=$rc"
-  if [ $rc -eq 0 ] || [ $rc -eq 5 ]; then
-    # rc=5: wedged mid-ladder but early rungs may have landed; push on
+  if [ $rc -eq 0 ] || [ $rc -eq 5 ] || [ $rc -eq 6 ]; then
+    # rc=5: wedged mid-ladder; rc=6: a rung errored on a live window —
+    # either way early rungs may have landed and the backend was up
     log "window found (rc=$rc); running battery"
     [ -f /tmp/bench_canonical_done ] || \
       bench_stage /root/repo/BENCH_PREVIEW_r05.json /tmp/bench_canonical_done python bench.py

@@ -12,6 +12,9 @@ def log(msg):
     print(f"[{time.time()-T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 def emit(rec):
+    """Append the timestamped record to the capture journal; returns the
+    timestamped copy so callers persist the SAME record (previews must be
+    self-timestamped — bench.py's failure path cites backup_timestamp)."""
     rec = {"timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
            **rec}
     with open(OUT, "a") as f:
@@ -19,6 +22,7 @@ def emit(rec):
         f.flush()
         os.fsync(f.fileno())
     log(f"emitted: {rec}")
+    return rec
 
 # resettable stage watchdog: the tunnel can wedge at ANY device call
 # (rounds 3-5 saw both init wedges and the 03:53 first-big-op wedge), so
@@ -56,17 +60,15 @@ if jax.default_backend() != _want:
     log(f"backend is {jax.default_backend()}, wanted {_want}; exiting 4")
     sys.exit(4)
 
+sys.path.insert(0, "/root/repo")
+from bench import _METRIC, _NORTH_STAR_RATE, build_workload
+
 META = {
     "jax_backend": jax.default_backend(),
     "device_kind": jax.devices()[0].device_kind,
     "jax_version": jax.__version__,
-    "metric": ("NG15-scale full-dataset realizations/sec, single chip "
-               "(68 psr x 7758 TOAs: EFAC+EQUAD+ECORR+RN30+HD-GWB(Nf~3000)"
-               "+100-CW catalog+quadratic fit)"),
+    "metric": _METRIC,  # single source of truth: bench.py
 }
-
-sys.path.insert(0, "/root/repo")
-from bench import build_workload
 from pta_replicator_tpu.models import batched as B
 from pta_replicator_tpu.models.batched import (
     quadratic_fit_subtract, realization_delays,
@@ -149,7 +151,7 @@ def measure(chunk, nrep, tag, budget=600):
            "unit": "realizations/s", "bench_chunk": chunk, "nrep": nrep,
            "measure_elapsed_s": round(elapsed, 3),
            "compile_s": round(compile_s, 1), "warmup_s": round(warm_s, 2),
-           "vs_baseline": round(rate / (1000.0 / 60.0), 3),
+           "vs_baseline": round(rate / _NORTH_STAR_RATE, 3),
            "cgw_static_amortized": True}
     try:
         ca = compiled.cost_analysis()
@@ -159,23 +161,40 @@ def measure(chunk, nrep, tag, budget=600):
         if fl > 0:
             rec["xla_flops_per_chunk"] = fl
             rec["achieved_tflops_per_s"] = round(fl * nrep / elapsed / 1e12, 3)
-            rec["mfu_vs_bf16_peak_pct"] = round(
-                100 * fl * nrep / elapsed / 197e12, 3)
+            # peak gated on device_kind exactly as bench.py does: an MFU
+            # against TPU peak is meaningless in a CPU harness run
+            peak = {"TPU v5 lite": 197e12}.get(META["device_kind"])
+            if peak:
+                rec["mfu_vs_bf16_peak_pct"] = round(
+                    100 * fl * nrep / elapsed / peak, 3)
     except Exception as exc:
         rec["cost_analysis_error"] = repr(exc)[:150]
-    emit(rec)
-    return rec
+    return emit(rec)
 
 
 # smallest first: ANY window yields a number — and every rung becomes
 # the preview immediately, so a window that dies mid-ladder still leaves
-# the best number captured so far in the canonical artifact
-rec = measure(100, 3, "chunk100_quick")
-write_preview(rec)
-rec = measure(800, 5, "chunk800_headline")
-write_preview(rec)
-rec = measure(800, 20, "chunk800_long")
-write_preview(rec)
+# the best number captured so far in the canonical artifact. A rung that
+# RAISES (device error, OOM — not a silent wedge) must not kill the
+# capture: later rungs and the battery can still use the live window, so
+# record the error and push on (exit 6 tells the loop the window was
+# live despite the partial failure).
+_rung_errors = 0
+def try_rung(fn):
+    global _rung_errors
+    try:
+        return fn()
+    except Exception as exc:
+        _rung_errors += 1
+        emit({"stage": "rung_error", "error": repr(exc)[:300]})
+        return None
+
+rec = try_rung(lambda: measure(100, 3, "chunk100_quick"))
+if rec: write_preview(rec)
+rec = try_rung(lambda: measure(800, 5, "chunk800_headline"))
+if rec: write_preview(rec)
+rec = try_rung(lambda: measure(800, 20, "chunk800_long"))
+if rec: write_preview(rec)
 
 
 def measure_fit(chunk, nrep, mode, tag, kcols=166):
@@ -217,14 +236,16 @@ def measure_fit(chunk, nrep, mode, tag, kcols=166):
            "fit_mode": mode, "fit_columns": kcols,
            "measure_elapsed_s": round(elapsed, 3),
            "compile_s": round(compile_s, 1),
-           "vs_baseline": round(rate / (1000.0 / 60.0), 3)}
-    emit(rec)
-    return rec
+           "vs_baseline": round(rate / _NORTH_STAR_RATE, 3)}
+    return emit(rec)
 
 
 try:
     rec = measure_fit(400, 3, "gls", "chunk400_gls")
-    write_preview(rec, "/root/repo/BENCH_PREVIEW_r05_gls.json")
+    # OUTSIDE the BENCH_PREVIEW_* namespace: bench.py's failure path
+    # scans that prefix for the HEADLINE config's backup value, and the
+    # slower GLS-mode rate must never be cited as the headline's
+    write_preview(rec, "/root/repo/BENCH_GLS_CAPTURE_r05.json")
 except Exception as exc:
     emit({"stage": "gls_error", "error": repr(exc)[:300]})
 try:
@@ -252,4 +273,7 @@ try:
 except Exception as exc:
     emit({"stage": "cgw_scan_error", "error": repr(exc)[:300]})
 
+if _rung_errors:
+    log(f"fast capture complete with {_rung_errors} rung error(s); exit 6")
+    sys.exit(6)
 log("fast capture complete")
