@@ -18,9 +18,24 @@ d = json.load(open(sys.argv[1]))
 sys.exit(1 if (isinstance(d, dict) and d.get('error')) else 0)" "$1" 2>/dev/null
 }
 
+alive() { # 90 s probe: is the tunnel still breathing? A wedged tunnel
+  # must not let the battery burn each stage's full timeout in sequence
+  # (~3 h of dead time before the loop would hunt again).
+  timeout 90 python -c "
+import numpy as np, jax, jax.numpy as jnp
+print(float(np.asarray(jnp.ones((128,128)) @ jnp.ones((128,128))).sum()))
+" >/dev/null 2>&1
+}
+
 stage() { # $1 target  $2 timeout  $3... command (stdout -> target)
   local target=$1 tmo=$2; shift 2
+  [ -f /tmp/tunnel_dead ] && return 2
   if have "$target"; then log "skip $(basename $target) (already captured)"; return 0; fi
+  if ! alive; then
+    log "tunnel dead before $(basename $target); back to hunting"
+    touch /tmp/tunnel_dead
+    return 2
+  fi
   local tmp=/tmp/stage_out_$$.json
   timeout "$tmo" "$@" > "$tmp" 2>> /tmp/stage_err.txt
   local rc=$?
@@ -36,6 +51,12 @@ bench_stage() { # $1 target  $2 done-marker  $3... bench cmd
   # bench.py emits a value-0.0 failure JSON on a wedge: promote only a
   # NONZERO value so a failed run never overwrites or freezes evidence
   local target=$1 marker=$2; shift 2
+  [ -f /tmp/tunnel_dead ] && return 2
+  if ! alive; then
+    log "tunnel dead before $(basename $target); back to hunting"
+    touch /tmp/tunnel_dead
+    return 2
+  fi
   local tmp=/tmp/bench_stage_$$.json
   timeout 1800 "$@" > "$tmp" 2>>/tmp/stage_err.txt
   local rc=$?
@@ -57,6 +78,7 @@ for i in $(seq 1 150); do
     # rc=5: wedged mid-ladder; rc=6: a rung errored on a live window —
     # either way early rungs may have landed and the backend was up
     log "window found (rc=$rc); running battery"
+    rm -f /tmp/tunnel_dead
     [ -f /tmp/bench_canonical_done ] || \
       bench_stage /root/repo/BENCH_PREVIEW_r05.json /tmp/bench_canonical_done python bench.py
     stage /root/repo/VPU_CEILING_r05.json     900 python benchmarks/vpu_ceiling.py
