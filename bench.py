@@ -104,15 +104,18 @@ def _stage_breakdown(batch, recipe, nreal: int = 20) -> dict:
     # queue reps back-to-back, fence once (a per-call readback would
     # measure the tunnel roundtrip, not the device); two interleaved
     # passes + min per stage to shave tunnel-throughput drift
+    from pta_replicator_tpu import obs
+
     reps = 10
     best = {}
     for _ in range(2):
         for name, f in stages.items():
-            t0 = time.perf_counter()
-            for _ in range(reps):
-                r = f(keys)
-            float(jnp.sum(jnp.abs(r)))
-            per = (time.perf_counter() - t0) / reps
+            with obs.span(f"stage_{name}", reps=reps):
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    r = f(keys)
+                float(jnp.sum(jnp.abs(r)))
+                per = (time.perf_counter() - t0) / reps
             per /= 1 if name.endswith("_once") else nreal
             best[name] = min(best.get(name, per), per)
     return {name: round(per * 1e3, 4) for name, per in best.items()}
@@ -266,6 +269,13 @@ def _bench():
     except Exception:
         pass  # cache is an optimization, never a bench failure
 
+    # structured telemetry: jax compile accounting + per-section spans,
+    # embedded into the bench JSON as the "telemetry" block so future
+    # rounds carry per-stage evidence (obs.telemetry_summary below)
+    from pta_replicator_tpu import obs
+
+    obs.install_jax_hooks()
+
     prng = os.environ.get("BENCH_PRNG", "threefry")
     if prng not in ("threefry", "rbg"):
         raise SystemExit(f"BENCH_PRNG must be 'threefry' or 'rbg', got {prng!r}")
@@ -300,10 +310,11 @@ def _bench():
             from pta_replicator_tpu import load_pulsar, make_ideal
             from pta_replicator_tpu.batch import freeze
 
-            t0 = time.perf_counter()
-            psr = load_pulsar(par, tim)
-            make_ideal(psr)
-            b1855 = freeze([psr], dtype=jnp.float32)
+            with obs.span("ingest_b1855"):
+                t0 = time.perf_counter()
+                psr = load_pulsar(par, tim)
+                make_ideal(psr)
+                b1855 = freeze([psr], dtype=jnp.float32)
             extra["ingest_b1855_s"] = round(time.perf_counter() - t0, 3)
             extra["ingest_b1855_ntoa"] = int(b1855.ntoa_max)
     except Exception as exc:
@@ -407,22 +418,25 @@ def _bench():
     # timed loop, and cost_analysis (calling the jit wrapper after
     # .lower().compile() would build a second executable — minutes of
     # extra compile on the tunneled backend, risking BENCH_TIMEOUT)
-    compiled = run_chunk.lower(jax.random.PRNGKey(0), static).compile()
+    with obs.span("aot_compile", chunk=chunk):
+        compiled = run_chunk.lower(jax.random.PRNGKey(0), static).compile()
 
     # warm-up. NOTE: sync via host readback of the (chunk, Np)
     # reduction, not block_until_ready() — on the remote-tunneled TPU
     # backend block_until_ready returns at dispatch, before execution.
     # Device execution is FIFO, so reading the last chunk's result back
     # fences every queued chunk.
-    out = compiled(jax.random.PRNGKey(0), static)
-    np.asarray(out)
+    with obs.span("warmup"):
+        out = compiled(jax.random.PRNGKey(0), static)
+        np.asarray(out)
 
     nrep = int(os.environ.get("BENCH_NREP", "5"))
-    t0 = time.perf_counter()
-    for i in range(nrep):
-        out = compiled(jax.random.PRNGKey(i + 1), static)
-    np.asarray(out)
-    elapsed = time.perf_counter() - t0
+    with obs.span("measure", nrep=nrep, chunk=chunk):
+        t0 = time.perf_counter()
+        for i in range(nrep):
+            out = compiled(jax.random.PRNGKey(i + 1), static)
+        np.asarray(out)
+        elapsed = time.perf_counter() - t0
 
     rate = nrep * chunk / elapsed
     extra["measure_elapsed_s"] = round(elapsed, 3)
@@ -473,6 +487,16 @@ def _bench():
         )
     except Exception as exc:
         extra["stage_breakdown_error"] = repr(exc)
+
+    # per-stage wall times + jax compile/trace counters, captured by the
+    # obs subsystem across everything this child process just ran
+    try:
+        extra["telemetry"] = obs.telemetry_summary()
+        mem = obs.device_memory_snapshot()
+        if any("bytes_in_use" in m for m in mem):
+            extra["telemetry"]["device_memory"] = mem
+    except Exception as exc:
+        extra["telemetry_error"] = repr(exc)
     print(
         json.dumps(
             {

@@ -84,6 +84,18 @@ def main(argv=None):
         p.add_argument("--pardir", required=True)
         p.add_argument("--timdir", required=True)
         p.add_argument("--num-psrs", type=int, default=None)
+        p.add_argument("--telemetry", default=None, metavar="DIR",
+                       help="capture structured telemetry (spans, metrics, "
+                            "JAX compile accounting) into DIR; inspect with "
+                            "the 'report' subcommand")
+    p = sub.add_parser(
+        "report", help="pretty-print a captured --telemetry directory")
+    p.add_argument("dir", help="telemetry directory (events.jsonl + "
+                               "metrics.json)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable aggregate instead of the tree")
+    p.add_argument("--min-ms", type=float, default=0.0,
+                   help="hide span paths with total wall below this")
     p = sub.choices["realize"]
     p.add_argument("--recipe", required=True, help="JSON recipe file")
     p.add_argument("--nreal", type=int, default=100)
@@ -120,17 +132,44 @@ def main(argv=None):
                  "remote plugin that hangs when unreachable)")
     args = ap.parse_args(argv)
 
+    if args.cmd == "report":
+        from .obs.report import print_report
+
+        print_report(args.dir, min_ms=args.min_ms, as_json=args.json)
+        return
+
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
 
-    from . import load_from_directories, make_ideal
+    telemetry = getattr(args, "telemetry", None)
+    if not telemetry:
+        return _run_command(args)
 
-    psrs = load_from_directories(args.pardir, args.timdir,
-                                 num_psrs=args.num_psrs)
-    for psr in psrs:
-        make_ideal(psr)
+    # capture mode: stream spans/metrics (and JAX compile accounting)
+    # into the telemetry dir; flush artifacts even when the run raises
+    from . import obs
+
+    obs.start_capture(telemetry)
+    try:
+        with obs.span(args.cmd):
+            return _run_command(args)
+    finally:
+        obs.finish_capture(context={
+            "argv": list(argv) if argv is not None else sys.argv[1:],
+        })
+
+
+def _run_command(args):
+    from . import load_from_directories, make_ideal
+    from .obs import span
+
+    with span("ingest", pardir=args.pardir):
+        psrs = load_from_directories(args.pardir, args.timdir,
+                                     num_psrs=args.num_psrs)
+        for psr in psrs:
+            make_ideal(psr)
 
     from .batch import freeze
 
@@ -148,7 +187,7 @@ def main(argv=None):
 
     import jax
 
-    with open(args.recipe) as fh:
+    with span("build_recipe"), open(args.recipe) as fh:
         recipe = _build_recipe(json.load(fh), psrs)
     if args.gls_fit:
         args.full_fit = True
@@ -166,39 +205,41 @@ def main(argv=None):
         )
     key = jax.random.PRNGKey(args.seed)
 
-    if args.checkpoint:
-        from .utils.sweep import sweep
+    with span("compute", nreal=args.nreal, fit=bool(args.fit)):
+        if args.checkpoint:
+            from .utils.sweep import sweep
 
-        chunk = min(args.chunk, args.nreal)
-        if args.nreal % chunk:
-            raise SystemExit(
-                f"--nreal {args.nreal} must be a multiple of --chunk {chunk}"
-            )
-        mesh = None
-        if args.sharded:
-            from .parallel import make_mesh
+            chunk = min(args.chunk, args.nreal)
+            if args.nreal % chunk:
+                raise SystemExit(
+                    f"--nreal {args.nreal} must be a multiple of --chunk {chunk}"
+                )
+            mesh = None
+            if args.sharded:
+                from .parallel import make_mesh
 
-            mesh = make_mesh()
-        out = sweep(key, batch, recipe, nreal=args.nreal,
-                    checkpoint_path=args.checkpoint, chunk=chunk,
-                    reduce_fn=None, fit=args.fit, mesh=mesh,
-                    progress=lambda d, t: print(f"chunk {d}/{t}",
-                                                file=sys.stderr))
-    elif args.sharded:
-        from .parallel import make_mesh, sharded_realize
+                mesh = make_mesh()
+            out = sweep(key, batch, recipe, nreal=args.nreal,
+                        checkpoint_path=args.checkpoint, chunk=chunk,
+                        reduce_fn=None, fit=args.fit, mesh=mesh,
+                        progress=lambda d, t: print(f"chunk {d}/{t}",
+                                                    file=sys.stderr))
+        elif args.sharded:
+            from .parallel import make_mesh, sharded_realize
 
-        out = np.asarray(sharded_realize(
-            key, batch, recipe, nreal=args.nreal, mesh=make_mesh(),
-            fit=args.fit,
-        ))
-    else:
-        from .models.batched import realize
+            out = np.asarray(sharded_realize(
+                key, batch, recipe, nreal=args.nreal, mesh=make_mesh(),
+                fit=args.fit,
+            ))
+        else:
+            from .models.batched import realize
 
-        out = np.asarray(realize(key, batch, recipe, nreal=args.nreal,
-                                 fit=args.fit))
+            out = np.asarray(realize(key, batch, recipe, nreal=args.nreal,
+                                     fit=args.fit))
 
-    np.savez(args.out, residuals=out, mask=np.asarray(batch.mask),
-             names=np.array(batch.names))
+    with span("write_output", out=args.out):
+        np.savez(args.out, residuals=out, mask=np.asarray(batch.mask),
+                 names=np.array(batch.names))
     summary = {
         "out": args.out,
         "shape": list(out.shape),

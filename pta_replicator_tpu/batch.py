@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from .constants import DAY_IN_SEC
+from .obs import counter, span
 from .ops.coords import pulsar_theta_phi, unit_vector
 from .ops.quantize import quantize
 
@@ -176,6 +177,25 @@ def freeze(
     groups shared across the array (so per-backend parameters are (Np,
     n_backends) arrays gathered per TOA on device).
     """
+    with span("freeze", npsr=len(psrs)) as sp:
+        batch = _freeze_impl(
+            psrs, flagid=flagid, coarsegrain=coarsegrain,
+            tref_mjd=tref_mjd, dtype=dtype,
+        )
+        sp["ntoa_max"] = batch.ntoa_max
+        sp["max_epochs"] = batch.max_epochs
+        counter("batch.freezes").inc()
+        counter("batch.toas_frozen").inc(int(np.asarray(batch.ntoas).sum()))
+        return batch
+
+
+def _freeze_impl(
+    psrs: List,
+    flagid: str,
+    coarsegrain: float,
+    tref_mjd: Optional[float],
+    dtype,
+) -> PulsarBatch:
     if dtype is None:
         dtype = jnp.zeros(0).dtype  # jax default float (f64 under x64)
     npsr = len(psrs)

@@ -8,6 +8,7 @@ every line verbatim for lossless round-tripping via :func:`ParModel.write`.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -348,6 +349,16 @@ class ParModel:
 
 def read_par(path: str) -> ParModel:
     """Parse a ``.par`` file into a :class:`ParModel`."""
+    from ..obs import counter, span
+
+    with span("read_par", file=os.path.basename(path)) as sp:
+        model = _read_par_impl(path)
+        sp["nparams"] = len(model.params)
+        counter("io.par.files").inc()
+    return model
+
+
+def _read_par_impl(path: str) -> ParModel:
     model = ParModel(path=path)
     with open(path) as fh:
         raw = fh.read().splitlines()

@@ -202,12 +202,24 @@ def read_tim(path: str, use_native: bool = True) -> TOAData:
     tokenizer when available (csrc/fast_tim.cpp); directive-bearing files
     and toolchain-less environments use the Python parser.
     """
+    from ..obs import counter, span
+
+    with span("read_tim", file=os.path.basename(path)) as sp:
+        toas = _read_tim_impl(path, use_native=use_native, span_attrs=sp)
+        sp["ntoa"] = toas.ntoas
+        counter("io.tim.files").inc()
+        counter("io.tim.toas").inc(toas.ntoas)
+    return toas
+
+
+def _read_tim_impl(path: str, use_native: bool, span_attrs: dict) -> TOAData:
     if use_native:
         from .native import fast_read_tim
 
         fast = fast_read_tim(path)
         if fast is not None:
             mjd, errs, freqs, labels, obs, flag_strs = fast
+            span_attrs["parser"] = "native"
             return TOAData(
                 mjd=mjd,
                 errors_s=errs,
@@ -218,6 +230,7 @@ def read_tim(path: str, use_native: bool = True) -> TOAData:
             )
     st = _TimParserState()
     _parse_tim_into(path, st)
+    span_attrs["parser"] = "python"
     return TOAData(
         mjd=np.array(st.mjds, dtype=np.longdouble),
         errors_s=np.array(st.errs, dtype=np.float64),

@@ -1,0 +1,137 @@
+"""Structured telemetry for pta_replicator_tpu: spans, metrics, and JAX
+compile/retrace accounting.
+
+Quick start (library instrumentation uses exactly these entry points)::
+
+    from ..obs import span, counter
+
+    with span("freeze", npsr=npsr) as sp:
+        ...
+        sp["ntoa_max"] = nt
+    counter("io.tim.toas").inc(ntoas)
+
+Capturing a run::
+
+    from pta_replicator_tpu import obs
+    obs.start_capture("/tmp/telemetry")   # spans stream to events.jsonl
+    ...                                    # run the pipeline
+    obs.finish_capture(context={"argv": sys.argv})
+
+then ``python -m pta_replicator_tpu report /tmp/telemetry``. The CLI's
+``--telemetry DIR`` flag does the capture automatically; docs in
+docs/observability.md.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+from . import jaxhooks, metrics, report, trace
+from .jaxhooks import (
+    RetraceWarning,
+    device_memory_snapshot,
+    instrumented_jit,
+    record_transfer,
+    trace_count,
+    tree_nbytes,
+)
+from .metrics import REGISTRY, counter, gauge, histogram
+from .trace import TRACER, configure, event, span, traced
+
+install_jax_hooks = jaxhooks.install
+
+__all__ = [
+    "span", "event", "configure", "traced", "counter", "gauge", "histogram",
+    "REGISTRY", "TRACER", "RetraceWarning", "instrumented_jit",
+    "install_jax_hooks", "device_memory_snapshot", "record_transfer",
+    "trace_count", "tree_nbytes", "start_capture", "finish_capture",
+    "telemetry_summary", "reset_all", "metrics", "trace", "report",
+    "jaxhooks",
+]
+
+
+def start_capture(directory: str) -> None:
+    """Begin streaming telemetry to ``directory`` and install the JAX
+    compile-accounting hooks. Safe to call early (before jax init).
+
+    Starts the capture from a clean slate: tracer buffers and the metrics
+    registry are reset so the directory describes exactly one run — the
+    same contract under which ``configure`` truncates events.jsonl
+    (otherwise a second capture in one process would write metrics.json /
+    chrome_trace.json still carrying the first run's counts)."""
+    TRACER.reset()
+    REGISTRY.reset()
+    trace.configure(directory)
+    jaxhooks.install()
+
+
+def finish_capture(context: dict = None) -> None:
+    """Write the remaining artifacts of the configured telemetry dir:
+    metrics.json / metrics.prom / chrome_trace.json / meta.json. The
+    events.jsonl stream was written live; this just flushes it."""
+    import json
+    import os
+
+    directory = TRACER.directory
+    if directory is None:
+        return
+    TRACER.flush()
+    with open(os.path.join(directory, "metrics.json"), "w") as fh:
+        json.dump(REGISTRY.to_json(), fh, indent=1, sort_keys=True)
+    with open(os.path.join(directory, "metrics.prom"), "w") as fh:
+        fh.write(REGISTRY.to_prometheus())
+    with open(os.path.join(directory, "chrome_trace.json"), "w") as fh:
+        json.dump(TRACER.chrome_trace(), fh)
+    meta = {
+        "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "dropped_events": TRACER.dropped,
+        "device_memory": device_memory_snapshot(),
+    }
+    if "jax" in sys.modules:
+        import jax
+
+        meta["jax_version"] = jax.__version__
+        try:
+            meta["backend"] = jax.default_backend()
+        except Exception:
+            pass
+    meta.update(context or {})
+    with open(os.path.join(directory, "meta.json"), "w") as fh:
+        json.dump(meta, fh, indent=1, sort_keys=True, default=repr)
+
+
+def telemetry_summary() -> dict:
+    """In-process snapshot for embedding into other evidence artifacts
+    (bench.py's BENCH JSON): per-stage wall times + the jax counters."""
+    spans = {
+        path: {
+            "calls": s["calls"],
+            "total_s": round(s["total_s"], 6),
+            "mean_s": round(s["mean_s"], 6),
+        }
+        for path, s in TRACER.summary().items()
+    }
+    jax_metrics = {}
+    for name, insts in REGISTRY.to_json().items():
+        if not name.startswith("jax."):
+            continue
+        for inst in insts:
+            key = name + (
+                "{" + ",".join(
+                    f"{k}={v}" for k, v in sorted(inst["labels"].items())
+                ) + "}" if inst["labels"] else ""
+            )
+            if inst["kind"] == "histogram":
+                jax_metrics[key] = {
+                    "count": inst["count"],
+                    "sum_s": round(inst["sum"], 6),
+                }
+            else:
+                jax_metrics[key] = inst["value"]
+    return {"spans": spans, "jax": jax_metrics}
+
+
+def reset_all() -> None:
+    """Clear the global tracer buffers and metrics registry (tests)."""
+    TRACER.reset()
+    REGISTRY.reset()

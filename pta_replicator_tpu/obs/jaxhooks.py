@@ -1,0 +1,193 @@
+"""JAX-specific accounting: compile counts/durations, retrace detection,
+device memory snapshots, and host<->device transfer counters.
+
+Everything here imports jax lazily so the obs package stays importable
+(and cheap) in jax-free tooling like the report CLI and the schema
+checker.
+
+Compile accounting rides ``jax.monitoring``: XLA emits
+``/jax/core/compile/backend_compile_duration`` (one per executable
+built) and ``/jax/core/compile/jaxpr_trace_duration`` (one per trace) —
+:func:`install` registers a listener once and folds them into the global
+metrics registry as
+
+* ``jax.compiles`` (counter) / ``jax.compile_s`` (histogram)
+* ``jax.traces`` (counter) / ``jax.trace_s`` (histogram)
+* ``jax.lowering_s`` (histogram, jaxpr->MLIR time)
+
+Per-function retrace detection needs cooperation from the call site:
+wrap the function with :func:`instrumented_jit` instead of ``jax.jit``.
+The wrapper's Python body only runs while JAX is tracing, so counting
+its executions counts (re)traces exactly; past ``retrace_warn`` traces a
+:class:`RetraceWarning` fires naming the function (the classic symptom:
+a "static" argument that changes every call, silently recompiling a
+minutes-long XLA program).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+import threading
+import warnings
+from typing import Dict, Optional
+
+from .metrics import REGISTRY
+
+_COMPILE_EVENT = "backend_compile_duration"
+_TRACE_EVENT = "jaxpr_trace_duration"
+_LOWER_EVENT = "jaxpr_to_mlir_module_duration"
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+class RetraceWarning(UserWarning):
+    """A jit-wrapped function retraced more often than its threshold."""
+
+
+def _duration_listener(event: str, duration_secs: float, **_kw) -> None:
+    if event.endswith(_COMPILE_EVENT):
+        REGISTRY.counter("jax.compiles").inc()
+        REGISTRY.histogram("jax.compile_s").observe(duration_secs)
+    elif event.endswith(_TRACE_EVENT):
+        REGISTRY.counter("jax.traces").inc()
+        REGISTRY.histogram("jax.trace_s").observe(duration_secs)
+    elif event.endswith(_LOWER_EVENT):
+        REGISTRY.histogram("jax.lowering_s").observe(duration_secs)
+
+
+def install() -> bool:
+    """Register the jax.monitoring compile/trace listener (idempotent).
+
+    Returns True when the listener is active, False when this jax build
+    has no monitoring API. Safe to call before any jit runs; listeners
+    persist for the life of the process.
+    """
+    global _installed
+    with _install_lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:  # pragma: no cover - ancient/absent jax
+            return False
+        if not hasattr(monitoring, "register_event_duration_secs_listener"):
+            return False  # pragma: no cover
+        monitoring.register_event_duration_secs_listener(_duration_listener)
+        _installed = True
+        return True
+
+
+#: per-function trace counts maintained by instrumented_jit wrappers
+_TRACE_COUNTS: Dict[str, int] = {}
+_trace_lock = threading.Lock()
+
+
+def trace_count(name: str) -> int:
+    """Total traces recorded under label ``name``, aggregated across every
+    instrumented_jit wrapper sharing it (the per-wrapper RetraceWarning
+    threshold is tracked separately, inside each wrapper)."""
+    return _TRACE_COUNTS.get(name, 0)
+
+
+def instrumented_jit(
+    fun=None,
+    *,
+    name: Optional[str] = None,
+    retrace_warn: int = 5,
+    **jit_kwargs,
+):
+    """``jax.jit`` with per-function (re)trace accounting.
+
+    Counts every trace of ``fun`` in the ``jax.trace_count`` counter
+    (label ``fn=name``) and warns with :class:`RetraceWarning` once the
+    count exceeds ``retrace_warn`` — each recompile beyond the first few
+    usually means an argument the caller believes is static isn't.
+    Usable as ``instrumented_jit(f, ...)`` or ``@instrumented_jit(...)``.
+    """
+    if fun is None:
+        return functools.partial(
+            instrumented_jit, name=name, retrace_warn=retrace_warn,
+            **jit_kwargs,
+        )
+    import jax
+
+    label = name or getattr(fun, "__qualname__", None) or repr(fun)
+    # the warning threshold applies per WRAPPER, not per label: several
+    # engine instances may legitimately share a label (one trace each —
+    # e.g. the lru_cached mesh engines, one per (mesh, fit)), which must
+    # not read as one function retracing; only THIS jit cache thrashing
+    # is the pathology the warning names
+    local_count = [0]
+
+    @functools.wraps(fun)
+    def traced(*args, **kwargs):
+        # this body executes exactly once per trace (cache hits bypass
+        # Python entirely), so it IS the retrace probe
+        with _trace_lock:
+            _TRACE_COUNTS[label] = _TRACE_COUNTS.get(label, 0) + 1
+            local_count[0] += 1
+            n = local_count[0]
+        REGISTRY.counter("jax.trace_count", fn=label).inc()
+        if n > retrace_warn:
+            warnings.warn(
+                f"jit function {label!r} traced {n} times "
+                f"(threshold {retrace_warn}): an argument assumed static "
+                "is changing across calls, forcing recompilation",
+                RetraceWarning,
+                stacklevel=2,
+            )
+        return fun(*args, **kwargs)
+
+    return jax.jit(traced, **jit_kwargs)
+
+
+def device_memory_snapshot() -> list:
+    """Per-device ``memory_stats()`` dicts (empty stats on backends that
+    don't report, e.g. CPU). Never initializes jax: returns [] unless the
+    caller's process already imported it."""
+    if "jax" not in sys.modules:
+        return []
+    import jax
+
+    out = []
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append({
+            "device": str(dev),
+            "platform": dev.platform,
+            **{k: int(v) for k, v in stats.items()},
+        })
+    return out
+
+
+def record_memory_gauges() -> None:
+    """Fold the current device memory snapshot into gauges
+    (``jax.memory.bytes_in_use`` etc., labeled by device)."""
+    for snap in device_memory_snapshot():
+        dev = snap["device"]
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in snap:
+                REGISTRY.gauge(f"jax.memory.{key}", device=dev).set(snap[key])
+
+
+def record_transfer(nbytes: int, direction: str = "h2d") -> None:
+    """Account a host<->device transfer (direction 'h2d' or 'd2h')."""
+    if direction not in ("h2d", "d2h"):
+        raise ValueError(f"direction must be h2d|d2h, got {direction!r}")
+    REGISTRY.counter(f"jax.transfer.{direction}_bytes").inc(max(0, int(nbytes)))
+    REGISTRY.counter(f"jax.transfer.{direction}_count").inc()
+
+
+def tree_nbytes(tree) -> int:
+    """Total byte size of the array leaves of a pytree (for transfer
+    accounting around device_put of frozen batches / key blocks)."""
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0))
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )

@@ -1,0 +1,308 @@
+"""Nested span tracer: the host-side timing backbone of the telemetry layer.
+
+Spans are thread-local nested timing scopes (``with span("freeze"): ...``)
+recording wall and CPU time plus free-form attributes. Every completed
+span is
+
+* aggregated in-process (per-path call counts / totals, always on, a few
+  hundred ns per span), and
+* appended as one JSON line to ``<telemetry_dir>/events.jsonl`` when a
+  sink directory is configured (``configure(dir)``), so a crashed run
+  still leaves its partial trace on disk.
+
+The JSONL stream is the contract consumed by :mod:`.report`, by
+``scripts/check_telemetry_schema.py`` and by the BENCH telemetry block;
+its schema lives in :data:`EVENT_SCHEMA`. A Perfetto/``chrome://tracing``
+view of the same spans is written by :meth:`Tracer.chrome_trace`.
+
+Device-side (XLA) tracing is a separate concern: capture it alongside
+host telemetry with :func:`pta_replicator_tpu.utils.profiling.device_trace`
+(see docs/observability.md).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+SCHEMA_VERSION = 1
+
+#: Required fields (and their JSON types) of each record kind in
+#: events.jsonl. ``scripts/check_telemetry_schema.py`` validates captured
+#: streams against this table — extend it when adding record kinds.
+EVENT_SCHEMA = {
+    "span": {
+        "type": str,      # literal "span"
+        "name": str,      # leaf name
+        "path": str,      # "/"-joined ancestry incl. name
+        "t0": float,      # start, seconds since epoch
+        "wall_s": float,  # wall-clock duration
+        "cpu_s": float,   # process CPU time consumed
+        "tid": int,       # thread id
+        "seq": int,       # process-wide monotonic sequence number
+        "attrs": dict,    # free-form JSON-safe attributes
+    },
+    "event": {
+        "type": str, "name": str, "t0": float, "tid": int, "seq": int,
+        "attrs": dict,
+    },
+    "meta": {"type": str, "schema": int, "t0": float},
+}
+
+
+def _json_safe(value):
+    """Coerce an attribute value to something json.dumps accepts."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, dict):
+        return {str(k): _json_safe(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_json_safe(v) for v in value]
+    try:  # numpy / jax scalars
+        return float(value)
+    except Exception:
+        return repr(value)
+
+
+class Tracer:
+    """Span recorder with per-path aggregation and an optional JSONL sink.
+
+    One process-global instance (:data:`TRACER`) serves the whole library;
+    construct private instances only in tests.
+    """
+
+    def __init__(self, max_events: int = 200_000):
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._seq = itertools.count()
+        self._max_events = max_events
+        self._events: list = []
+        self._dropped = 0
+        self._agg: Dict[str, dict] = {}
+        self._dir: Optional[str] = None
+        self._sink = None
+
+    # -- configuration -------------------------------------------------
+    def configure(self, directory: Optional[str]) -> None:
+        """Set (or clear, with None) the on-disk telemetry directory.
+
+        An existing events.jsonl in the directory is truncated: one
+        capture dir describes one run (re-running --telemetry into the
+        same dir must not merge span streams against a fresh
+        metrics.json — the report would double-count every stage).
+        Within a run the stream is append-as-you-go, so a crash still
+        leaves everything up to the last completed span on disk.
+        """
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+            self._dir = directory
+            if directory is not None:
+                os.makedirs(directory, exist_ok=True)
+                self._sink = open(
+                    os.path.join(directory, "events.jsonl"), "w", buffering=1
+                )
+                self._sink.write(json.dumps({
+                    "type": "meta", "schema": SCHEMA_VERSION,
+                    "t0": time.time(), "pid": os.getpid(),
+                }) + "\n")
+
+    @property
+    def directory(self) -> Optional[str]:
+        return self._dir
+
+    # -- recording ------------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    #: event-buffer cap while NO sink is configured: enough for tests and
+    #: ad-hoc chrome_trace() exports, small enough that always-on library
+    #: instrumentation can't grow a long-lived process by more than ~MB
+    IDLE_MAX_EVENTS = 2000
+
+    def _record(self, rec: dict) -> None:
+        # serialize outside the lock (racy sink check is benign: worst
+        # case one wasted dumps, or a late serialize under the lock) so
+        # concurrent pool-worker spans don't contend on JSON encoding
+        line = json.dumps(rec) + "\n" if self._sink is not None else None
+        with self._lock:
+            cap = (
+                self._max_events if self._sink is not None
+                else min(self._max_events, self.IDLE_MAX_EVENTS)
+            )
+            if len(self._events) < cap:
+                self._events.append(rec)
+            else:
+                self._dropped += 1
+            if rec["type"] == "span":
+                agg = self._agg.get(rec["path"])
+                if agg is None:
+                    agg = self._agg[rec["path"]] = {
+                        "calls": 0, "total_s": 0.0, "cpu_s": 0.0,
+                        "max_s": 0.0, "first_seq": rec["seq"],
+                    }
+                agg["calls"] += 1
+                agg["total_s"] += rec["wall_s"]
+                agg["cpu_s"] += rec["cpu_s"]
+                agg["max_s"] = max(agg["max_s"], rec["wall_s"])
+            if self._sink is not None:
+                self._sink.write(
+                    line if line is not None else json.dumps(rec) + "\n"
+                )
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Time a nested stage. Yields the (mutable) attrs dict so callers
+        can attach results computed inside the span::
+
+            with tracer.span("freeze", npsr=n) as sp:
+                ...
+                sp["ntoa_max"] = nt
+        """
+        stack = self._stack()
+        path = "/".join(stack + [name])
+        stack.append(name)
+        attrs = dict(attrs)
+        t0 = time.time()
+        w0 = time.perf_counter()
+        c0 = time.process_time()
+        try:
+            yield attrs
+        finally:
+            stack.pop()
+            self._record({
+                "type": "span",
+                "name": name,
+                "path": path,
+                "t0": t0,
+                "wall_s": time.perf_counter() - w0,
+                "cpu_s": time.process_time() - c0,
+                "tid": threading.get_ident(),
+                "seq": next(self._seq),
+                "attrs": {k: _json_safe(v) for k, v in attrs.items()},
+            })
+
+    def current_stack(self) -> tuple:
+        """The calling thread's open-span ancestry (for :meth:`inherit`)."""
+        return tuple(self._stack())
+
+    @contextlib.contextmanager
+    def inherit(self, stack: tuple):
+        """Adopt ``stack`` (a :meth:`current_stack` snapshot from another
+        thread) as this thread's span ancestry for the duration.
+
+        Span nesting is thread-local, so work handed to a pool would
+        otherwise record its spans at the root; wrapping the worker body
+        in ``inherit`` keeps e.g. per-file parse spans nested under the
+        ingest span that dispatched them.
+        """
+        saved = getattr(self._local, "stack", None)
+        self._local.stack = list(stack)
+        try:
+            yield
+        finally:
+            self._local.stack = saved if saved is not None else []
+
+    def event(self, name: str, **attrs) -> None:
+        """Record an instant (zero-duration) event."""
+        self._record({
+            "type": "event",
+            "name": name,
+            "t0": time.time(),
+            "tid": threading.get_ident(),
+            "seq": next(self._seq),
+            "attrs": {k: _json_safe(v) for k, v in attrs.items()},
+        })
+
+    # -- inspection / export -------------------------------------------
+    def summary(self) -> Dict[str, dict]:
+        """Per-path aggregates: calls, total/mean/max wall, total CPU."""
+        with self._lock:
+            out = {}
+            for path, agg in self._agg.items():
+                out[path] = {
+                    "calls": agg["calls"],
+                    "total_s": agg["total_s"],
+                    "mean_s": agg["total_s"] / agg["calls"],
+                    "max_s": agg["max_s"],
+                    "cpu_s": agg["cpu_s"],
+                    "first_seq": agg["first_seq"],
+                }
+            return out
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def chrome_trace(self) -> dict:
+        """The buffered spans as a ``chrome://tracing`` / Perfetto JSON
+        object (phase-"X" complete events, microsecond timestamps)."""
+        pid = os.getpid()
+        trace_events = []
+        for rec in self.events():
+            if rec["type"] != "span":
+                continue
+            trace_events.append({
+                "name": rec["name"],
+                "cat": "host",
+                "ph": "X",
+                "ts": rec["t0"] * 1e6,
+                "dur": rec["wall_s"] * 1e6,
+                "pid": pid,
+                "tid": rec["tid"],
+                "args": {**rec["attrs"], "path": rec["path"]},
+            })
+        return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.flush()
+
+    def reset(self) -> None:
+        """Drop buffered events and aggregates (sink file is kept open)."""
+        with self._lock:
+            self._events.clear()
+            self._agg.clear()
+            self._dropped = 0
+
+
+#: the process-global tracer used by all library instrumentation
+TRACER = Tracer()
+
+span = TRACER.span
+event = TRACER.event
+configure = TRACER.configure
+summary = TRACER.summary
+reset = TRACER.reset
+flush = TRACER.flush
+
+
+def traced(name: Optional[str] = None):
+    """Decorator form of :func:`span`: wrap every call of the function in
+    a span named ``name`` (default: the function's ``__name__``)."""
+    import functools
+
+    def deco(fn):
+        label = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with TRACER.span(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

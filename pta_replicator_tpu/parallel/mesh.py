@@ -32,6 +32,7 @@ from ..models.batched import (
     finalize_residuals,
     realization_delays,
 )
+from ..obs import gauge, instrumented_jit, record_transfer, span, tree_nbytes
 
 
 def make_mesh(
@@ -44,17 +45,20 @@ def make_mesh(
     Default: all devices on the realization axis (the right choice until
     Np or memory forces pulsar sharding).
     """
-    devices = list(devices if devices is not None else jax.devices())
-    if n_real is None:
-        n_real = len(devices) // n_psr
-    needed = n_real * n_psr
-    if needed > len(devices):
-        raise ValueError(
-            f"mesh {n_real}x{n_psr} needs {needed} devices, "
-            f"only {len(devices)} available"
-        )
-    dev_array = np.array(devices[:needed]).reshape(n_real, n_psr)
-    return Mesh(dev_array, axis_names=("real", "psr"))
+    with span("make_mesh") as sp:
+        devices = list(devices if devices is not None else jax.devices())
+        if n_real is None:
+            n_real = len(devices) // n_psr
+        needed = n_real * n_psr
+        if needed > len(devices):
+            raise ValueError(
+                f"mesh {n_real}x{n_psr} needs {needed} devices, "
+                f"only {len(devices)} available"
+            )
+        sp["n_real"], sp["n_psr"] = n_real, n_psr
+        gauge("mesh.devices").set(needed)
+        dev_array = np.array(devices[:needed]).reshape(n_real, n_psr)
+        return Mesh(dev_array, axis_names=("real", "psr"))
 
 
 def shard_batch(batch: PulsarBatch, mesh: Mesh) -> PulsarBatch:
@@ -63,11 +67,17 @@ def shard_batch(batch: PulsarBatch, mesh: Mesh) -> PulsarBatch:
 
     def place(x):
         if hasattr(x, "ndim") and x.ndim >= 1:
-            spec = P("psr", *([None] * (x.ndim - 1)))
-            return jax.device_put(x, NamedSharding(mesh, spec))
+            sharding = NamedSharding(mesh, P("psr", *([None] * (x.ndim - 1))))
+            # transfer accounting: only leaves that actually move — a
+            # chunked sweep re-shards the same (already placed) batch
+            # every chunk, where device_put is a no-op
+            if getattr(x, "sharding", None) != sharding:
+                record_transfer(int(x.nbytes), "h2d")
+            return jax.device_put(x, sharding)
         return x
 
-    return jax.tree_util.tree_map(place, batch)
+    with span("shard_batch", npsr=batch.npsr):
+        return jax.tree_util.tree_map(place, batch)
 
 
 def sharded_realize(
@@ -96,18 +106,22 @@ def sharded_realize(
     if nreal % n_real_axis:
         raise ValueError(f"nreal={nreal} not divisible by mesh 'real'={n_real_axis}")
 
-    keys = jax.random.split(key, nreal)
-    keys = jax.device_put(keys, NamedSharding(mesh, P("real")))
-    if static is None:
-        # computing the deterministic delays inside the jitted engine
-        # would trace the source params and lose the f64 host plane
-        # precompute (see static_delays) — default to the accurate path
-        # for every caller, opt-in `static=` merely skips the recompute.
-        # Computed from the pre-shard batch: the CW plane precompute
-        # reads host values, which a multi-host global array can't serve.
-        static = static_delays(batch, recipe, mesh=mesh)
-    batch = shard_batch(batch, mesh)
-    return _constraint_engine(mesh, fit)(keys, batch, recipe, static)
+    with span("sharded_realize", nreal=nreal,
+              mesh=f"{mesh.shape['real']}x{mesh.shape.get('psr', 1)}"):
+        keys = jax.random.split(key, nreal)
+        keys = jax.device_put(keys, NamedSharding(mesh, P("real")))
+        record_transfer(tree_nbytes(keys), "h2d")
+        if static is None:
+            # computing the deterministic delays inside the jitted engine
+            # would trace the source params and lose the f64 host plane
+            # precompute (see static_delays) — default to the accurate path
+            # for every caller, opt-in `static=` merely skips the recompute.
+            # Computed from the pre-shard batch: the CW plane precompute
+            # reads host values, which a multi-host global array can't serve.
+            static = static_delays(batch, recipe, mesh=mesh)
+        batch = shard_batch(batch, mesh)
+        with span("dispatch", engine="constraint"):
+            return _constraint_engine(mesh, fit)(keys, batch, recipe, static)
 
 
 def static_delays(batch: PulsarBatch, recipe: Recipe, mesh: Optional[Mesh] = None):
@@ -125,10 +139,12 @@ def static_delays(batch: PulsarBatch, recipe: Recipe, mesh: Optional[Mesh] = Non
     tests/test_regressions.py::test_static_delays_uses_f64_host_planes).
     This runs once per sweep, so eager dispatch costs nothing.
     """
-    out = deterministic_delays(batch, recipe)
-    if mesh is not None:
-        out = jax.device_put(out, NamedSharding(mesh, P("psr", None)))
-    return out
+    with span("static_delays", npsr=batch.npsr):
+        out = deterministic_delays(batch, recipe)
+        if mesh is not None:
+            out = jax.device_put(out, NamedSharding(mesh, P("psr", None)))
+            record_transfer(tree_nbytes(out), "h2d")
+        return out
 
 
 def _realize_block(
@@ -155,12 +171,14 @@ def _constraint_engine(mesh: Mesh, fit: bool):
     closure every invocation."""
     out_spec = NamedSharding(mesh, P("real", "psr", None))
 
-    @jax.jit
     def run(keys, batch, recipe, static):
         out = _realize_block(keys, batch, recipe, fit, static=static)
         return jax.lax.with_sharding_constraint(out, out_spec)
 
-    return run
+    # instrumented_jit: each retrace/recompile of the engine is counted
+    # in jax.trace_count{fn=...} and warns past the threshold (a fresh
+    # mesh or fit flag per call would silently recompile minutes of XLA)
+    return instrumented_jit(run, name="mesh.constraint_engine", retrace_warn=32)
 
 
 def _shard_map():
@@ -180,13 +198,15 @@ def _shardmap_engine(mesh: Mesh, fit: bool):
     def local(keys_shard, batch, recipe, static):
         return _realize_block(keys_shard, batch, recipe, fit, static=static)
 
-    return jax.jit(
+    return instrumented_jit(
         _shard_map()(
             local,
             mesh=mesh,
             in_specs=(P("real"), P(), P(), P()),
             out_specs=P("real"),
-        )
+        ),
+        name="mesh.shardmap_engine",
+        retrace_warn=32,
     )
 
 
@@ -216,13 +236,15 @@ def _shardmap_psr_engine(mesh: Mesh, fit: bool, recipe_treedef, recipe_specs):
             keys_shard, batch, recipe, fit, rows=rows, static=static
         )
 
-    return jax.jit(
+    return instrumented_jit(
         _shard_map()(
             local,
             mesh=mesh,
             in_specs=(P("real"), P("psr"), recipe_spec_tree, P("psr")),
             out_specs=P("real", "psr"),
-        )
+        ),
+        name="mesh.shardmap_psr_engine",
+        retrace_warn=32,
     )
 
 
@@ -309,11 +331,14 @@ def shardmap_realize(
 
     n_psr_axis = mesh.shape.get("psr", 1)
     if n_psr_axis == 1:
-        if static is None:
-            # same accuracy rationale as in sharded_realize: keep the CW
-            # plane precompute out of the traced engine
-            static = static_delays(batch, recipe, mesh=mesh)
-        return _shardmap_engine(mesh, fit)(keys, batch, recipe, static)
+        with span("shardmap_realize", nreal=nreal,
+                  mesh=f"{n_real_axis}x{n_psr_axis}"):
+            if static is None:
+                # same accuracy rationale as in sharded_realize: keep the
+                # CW plane precompute out of the traced engine
+                static = static_delays(batch, recipe, mesh=mesh)
+            with span("dispatch", engine="shardmap"):
+                return _shardmap_engine(mesh, fit)(keys, batch, recipe, static)
 
     npsr = batch.npsr
     if npsr % n_psr_axis:
@@ -341,10 +366,13 @@ def shardmap_realize(
             * jnp.eye(npsr, dtype=batch.toas_s.dtype),
         )
 
-    if static is None:
-        # after the psr-axis validity checks: accurate eager precompute
-        static = static_delays(batch, recipe, mesh=mesh)
-    spec_tree = _recipe_psr_specs(recipe, npsr)
-    leaves, treedef = jax.tree_util.tree_flatten(spec_tree)
-    engine = _shardmap_psr_engine(mesh, fit, treedef, tuple(leaves))
-    return engine(keys, batch, recipe, static)
+    with span("shardmap_realize", nreal=nreal,
+              mesh=f"{n_real_axis}x{n_psr_axis}"):
+        if static is None:
+            # after the psr-axis validity checks: accurate eager precompute
+            static = static_delays(batch, recipe, mesh=mesh)
+        spec_tree = _recipe_psr_specs(recipe, npsr)
+        leaves, treedef = jax.tree_util.tree_flatten(spec_tree)
+        engine = _shardmap_psr_engine(mesh, fit, treedef, tuple(leaves))
+        with span("dispatch", engine="shardmap_psr"):
+            return engine(keys, batch, recipe, static)

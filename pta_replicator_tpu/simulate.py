@@ -21,6 +21,7 @@ import numpy as np
 
 from .io.par import ParModel, read_par
 from .io.tim import TOAData, fabricate_toas, read_tim, write_tim
+from .obs import counter, span, traced
 from .timing.model import SpindownTiming, TimingModel, phase_residuals
 from .timing.fit import design_matrix, wls_fit, gls_fit
 from .constants import DAY_IN_SEC, RAD_TO_MAS
@@ -68,31 +69,50 @@ class SimulatedPulsar:
     def update_residuals(self) -> None:
         self.residuals = Residuals(self.toas, self.model)
 
-    def update_added_signals(self, signal_name: str, param_dict: dict, dt=None) -> None:
+    def update_added_signals(self, signal_name: str, param_dict: dict, dt=None) -> str:
         """Record an injected signal in the provenance ledger.
 
         ``added_signals`` maps signal name -> parameter dict;
         ``added_signals_time`` maps signal name -> per-TOA delay vector [s],
         enabling exact decomposition of total residuals by cause (a
         first-class feature of the reference, simulate.py:79-89).
+
+        Repeated injections under the same name are disambiguated
+        deterministically (``name`` -> ``name_2``, ``name_3``, ...) and the
+        original name is recorded in the entry's parameter dict under
+        ``disambiguated_from``, so injecting a signal twice keeps both
+        delay vectors instead of colliding (pre-PR-1 behavior was a hard
+        ValueError, which made legitimate repeat injections — two CW
+        sources, or noise re-draws in sensitivity sweeps — impossible).
+        Returns the ledger name actually used.
         """
         if self.added_signals is None:
             raise ValueError(
                 "make_ideal() must be called on SimulatedPulsar before adding new signals."
             )
-        if signal_name in self.added_signals:
-            raise ValueError(f"{signal_name} already exists in the model.")
-        self.added_signals[signal_name] = param_dict
+        name = signal_name
+        if name in self.added_signals:
+            k = 2
+            while f"{signal_name}_{k}" in self.added_signals:
+                k += 1
+            name = f"{signal_name}_{k}"
+            param_dict = dict(param_dict, disambiguated_from=signal_name)
+            counter("simulate.ledger_disambiguated").inc()
+        self.added_signals[name] = param_dict
         if dt is not None:
-            self.added_signals_time[signal_name] = np.asarray(dt, dtype=np.float64)
+            self.added_signals_time[name] = np.asarray(dt, dtype=np.float64)
+        return name
 
-    def inject(self, signal_name: str, param_dict: dict, dt_s: np.ndarray) -> None:
+    def inject(self, signal_name: str, param_dict: dict, dt_s: np.ndarray) -> str:
         """Ledger -> adjust TOAs -> re-residualize: the invariant operator
-        contract shared by every injection (11 call sites in the reference)."""
-        self.update_added_signals(signal_name, param_dict, dt_s)
+        contract shared by every injection (11 call sites in the reference).
+        Returns the (possibly disambiguated) ledger name used."""
+        name = self.update_added_signals(signal_name, param_dict, dt_s)
         self.toas.adjust_seconds(dt_s)
         self.update_residuals()
+        return name
 
+    @traced("oracle_fit")
     def fit(
         self,
         fitter: str = "auto",
@@ -696,25 +716,39 @@ def load_from_directories(
 
     if workers is None:
         workers = min(8, len(pairs)) or 1
-    if workers <= 1 or len(pairs) <= 1:
-        return [load_one(pt) for pt in pairs]
+    with span("load_pulsars", npsr=len(pairs), workers=workers):
+        counter("simulate.pulsars_loaded").inc(len(pairs))
+        if workers <= 1 or len(pairs) <= 1:
+            return [load_one(pt) for pt in pairs]
 
-    from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import ThreadPoolExecutor
 
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        return list(pool.map(load_one, pairs))
+        from .obs import TRACER
+
+        # span nesting is thread-local: hand the load_pulsars ancestry to
+        # the pool workers so per-file read_par/read_tim spans nest under
+        # it instead of surfacing at the report's root
+        parent = TRACER.current_stack()
+
+        def load_nested(pt):
+            with TRACER.inherit(parent):
+                return load_one(pt)
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return list(pool.map(load_nested, pairs))
 
 
 def make_ideal(psr: SimulatedPulsar, iterations: int = 2) -> None:
     """Zero the residuals by absorbing them into the TOAs, then initialize
     the provenance ledger (reference analog simulate.py:193-202)."""
-    for _ in range(iterations):
-        res = phase_residuals(
-            psr.model, psr.toas.mjd, psr.toas.errors_s,
-            freqs_mhz=psr.toas.freqs_mhz, flags=psr.toas.flags,
-            observatories=psr.toas.observatories,
-        )
-        psr.toas.adjust_seconds(-res)
-    psr.added_signals = {}
-    psr.added_signals_time = {}
-    psr.update_residuals()
+    with span("make_ideal", psr=psr.name, iterations=iterations):
+        for _ in range(iterations):
+            res = phase_residuals(
+                psr.model, psr.toas.mjd, psr.toas.errors_s,
+                freqs_mhz=psr.toas.freqs_mhz, flags=psr.toas.flags,
+                observatories=psr.toas.observatories,
+            )
+            psr.toas.adjust_seconds(-res)
+        psr.added_signals = {}
+        psr.added_signals_time = {}
+        psr.update_residuals()

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..obs.trace import traced as _traced
+
 
 def design_matrix(toas_s: np.ndarray, f0: float, nspin: int = 2, xp=np):
     """Timing design matrix in time units, columns [1, dt, dt^2/2, dt^3/6][:nspin+1] / F0-scaled.
@@ -217,29 +219,33 @@ def design_tensor(psrs, ntoa_max=None, nspin: int = 2, include="auto"):
     order) used to freeze the batch. Returns ``(tensor, names)`` with
     ``names[i]`` the column labels of pulsar ``i``.
     """
+    from ..obs import span
     from .components import full_design_matrix
 
-    mats, names = [], []
-    for psr in psrs:
-        M, nm = full_design_matrix(
-            psr.par,
-            psr.toas.get_mjds(),
-            freqs_mhz=psr.toas.freqs_mhz,
-            f0=psr.model.f0,
-            nspin=nspin,
-            include=include,
-            flags=psr.toas.flags,
-        )
-        mats.append(np.asarray(M, np.float64))
-        names.append(nm)
-    nt = ntoa_max or max(m.shape[0] for m in mats)
-    kmax = max(m.shape[1] for m in mats)
-    out = np.zeros((len(mats), nt, kmax))
-    for i, m in enumerate(mats):
-        out[i, : m.shape[0], : m.shape[1]] = m
-    return out, names
+    with span("design_tensor", npsr=len(psrs), nspin=nspin) as sp:
+        mats, names = [], []
+        for psr in psrs:
+            M, nm = full_design_matrix(
+                psr.par,
+                psr.toas.get_mjds(),
+                freqs_mhz=psr.toas.freqs_mhz,
+                f0=psr.model.f0,
+                nspin=nspin,
+                include=include,
+                flags=psr.toas.flags,
+            )
+            mats.append(np.asarray(M, np.float64))
+            names.append(nm)
+        nt = ntoa_max or max(m.shape[0] for m in mats)
+        kmax = max(m.shape[1] for m in mats)
+        sp["kmax"] = kmax
+        out = np.zeros((len(mats), nt, kmax))
+        for i, m in enumerate(mats):
+            out[i, : m.shape[0], : m.shape[1]] = m
+        return out, names
 
 
+@_traced("covariance_from_recipe")
 def covariance_from_recipe(
     psr,
     recipe,

@@ -1,40 +1,55 @@
-"""Lightweight tracing/profiling hooks.
+"""Legacy profiling facade over the :mod:`pta_replicator_tpu.obs` tracer.
 
-The reference has none (a commented-out @profile and debug prints,
-SURVEY.md section 5). Device-side profiling delegates to jax.profiler
-(XLA traces viewable in TensorBoard/Perfetto); host-side stages get a
-simple timer registry.
+``stage()`` / ``timings()`` / ``reset()`` predate the structured
+telemetry subsystem and are kept as thin compatibility shims (same
+signatures, same summary dict shape) so existing callers — notably
+``benchmarks/profile_stages.py`` — keep working unchanged. New code
+should use :func:`pta_replicator_tpu.obs.span` directly, which adds
+nesting, attributes, and the JSONL/Perfetto sinks.
+
+Device-side profiling still delegates to jax.profiler (XLA traces
+viewable in TensorBoard/Perfetto) via :func:`device_trace`.
 """
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
 from typing import Dict
 
-_TIMINGS: Dict[str, list] = defaultdict(list)
+from ..obs import trace as _trace
 
 
-@contextlib.contextmanager
 def stage(name: str):
-    """Time a host-side stage: ``with stage('ingest'): ...``"""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        _TIMINGS[name].append(time.perf_counter() - t0)
+    """Time a host-side stage: ``with stage('ingest'): ...``
+
+    Compatibility shim: records an :mod:`..obs` span named ``name``."""
+    return _trace.span(name)
 
 
 def timings() -> Dict[str, dict]:
-    """Summary of recorded stages: calls, total and mean seconds."""
-    return {
-        k: {"calls": len(v), "total_s": sum(v), "mean_s": sum(v) / len(v)}
-        for k, v in _TIMINGS.items()
-    }
+    """Summary of recorded stages: calls, total and mean seconds.
+
+    Aggregated by span *leaf name* (the pre-obs registry was flat), over
+    every span recorded since the last :func:`reset` — including ones
+    from library instrumentation, which the old registry never saw."""
+    out: Dict[str, dict] = {}
+    for path, s in _trace.summary().items():
+        leaf = path.rsplit("/", 1)[-1]
+        agg = out.setdefault(leaf, {"calls": 0, "total_s": 0.0})
+        agg["calls"] += s["calls"]
+        agg["total_s"] += s["total_s"]
+    for agg in out.values():
+        agg["mean_s"] = agg["total_s"] / agg["calls"]
+    return out
 
 
 def reset() -> None:
-    _TIMINGS.clear()
+    """Clear recorded timings.
+
+    NOTE: unlike the pre-obs registry this clears the *global* tracer's
+    buffers — under an active ``--telemetry`` capture the aggregates and
+    chrome-trace buffer restart from here (the on-disk events.jsonl
+    stream already written is unaffected)."""
+    _trace.reset()
 
 
 @contextlib.contextmanager

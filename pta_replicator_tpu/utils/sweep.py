@@ -204,17 +204,25 @@ def sweep(
 
         static = static_delays(batch, recipe, mesh=mesh)
 
+    from ..obs import counter, span
+
     for i in range(done, nchunks):
         k = jax.random.fold_in(key, i)
-        if mesh is not None:
-            res = sharded_realize(
-                k, batch, recipe, nreal=chunk, mesh=mesh, fit=fit,
-                static=static,
-            )
-        else:
-            res = realize(k, batch, recipe, nreal=chunk, fit=fit, static=static)
-        out = reduce_fn(res, batch) if reduce_fn is not None else res
-        block = np.asarray(out)  # readback = the sync fence
+        with span("sweep_chunk", chunk=i, nreal=chunk):
+            if mesh is not None:
+                res = sharded_realize(
+                    k, batch, recipe, nreal=chunk, mesh=mesh, fit=fit,
+                    static=static,
+                )
+            else:
+                res = realize(k, batch, recipe, nreal=chunk, fit=fit,
+                              static=static)
+            out = reduce_fn(res, batch) if reduce_fn is not None else res
+            # the host readback is the device-sync fence: this span is
+            # where queued device work (incl. collectives) actually drains
+            with span("readback_fence"):
+                block = np.asarray(out)
+            counter("sweep.realizations").inc(chunk)
         blocks.append(block)
 
         # chunk file first, sidecar last: a crash between the two only

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Static telemetry health check (fast, CPU-only, jax-free).
+
+Two guarantees, run as part of the test suite (tests/test_obs.py) and
+usable standalone in CI:
+
+1. **Event schema** — a telemetry events.jsonl stream (a captured one
+   passed as argv, or a fresh sample generated in-process) validates
+   against ``pta_replicator_tpu.obs.trace.EVENT_SCHEMA``: every record
+   kind is known and carries its required fields with the right JSON
+   types.
+
+2. **Instrumentation coverage** — every public pipeline entrypoint in
+   :data:`INSTRUMENTED_ENTRYPOINTS` still carries its span. The list is
+   deliberately greppable source text: renaming a span or stripping the
+   instrumentation from a hot path fails this check instead of silently
+   un-instrumenting the pipeline.
+
+Usage:
+    python scripts/check_telemetry_schema.py [events.jsonl | telemetry_dir]
+Exit code 0 on success, 1 with a finding list on failure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+#: (source file, required span/instrumentation marker) — one row per
+#: public entrypoint the telemetry subsystem promises to cover. Grep for
+#: the marker to find the instrumentation site.
+INSTRUMENTED_ENTRYPOINTS = [
+    ("pta_replicator_tpu/batch.py", 'span("freeze"'),
+    ("pta_replicator_tpu/simulate.py", 'span("make_ideal"'),
+    ("pta_replicator_tpu/simulate.py", 'span("load_pulsars"'),
+    ("pta_replicator_tpu/simulate.py", '@traced("oracle_fit")'),
+    ("pta_replicator_tpu/io/par.py", 'span("read_par"'),
+    ("pta_replicator_tpu/io/tim.py", 'span("read_tim"'),
+    ("pta_replicator_tpu/timing/fit.py", 'span("design_tensor"'),
+    ("pta_replicator_tpu/timing/fit.py", '@_traced("covariance_from_recipe")'),
+    ("pta_replicator_tpu/parallel/mesh.py", 'span("make_mesh"'),
+    ("pta_replicator_tpu/parallel/mesh.py", 'span("shard_batch"'),
+    ("pta_replicator_tpu/parallel/mesh.py", 'span("static_delays"'),
+    ("pta_replicator_tpu/parallel/mesh.py", 'span("sharded_realize"'),
+    ("pta_replicator_tpu/parallel/mesh.py", 'span("shardmap_realize"'),
+    ("pta_replicator_tpu/parallel/mesh.py", 'name="mesh.constraint_engine"'),
+    ("pta_replicator_tpu/utils/sweep.py", 'span("sweep_chunk"'),
+    ("pta_replicator_tpu/__main__.py", 'span("compute"'),
+    ("pta_replicator_tpu/__main__.py", 'span("ingest"'),
+    ("bench.py", 'obs.span("measure"'),
+]
+
+
+def check_entrypoints() -> list:
+    problems = []
+    for rel, marker in INSTRUMENTED_ENTRYPOINTS:
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path):
+            problems.append(f"{rel}: file missing")
+            continue
+        with open(path) as fh:
+            if marker not in fh.read():
+                problems.append(
+                    f"{rel}: instrumentation marker {marker!r} not found "
+                    "(span removed or renamed without updating "
+                    "scripts/check_telemetry_schema.py)"
+                )
+    return problems
+
+
+def validate_events(path: str) -> list:
+    from pta_replicator_tpu.obs.trace import EVENT_SCHEMA
+
+    problems = []
+    valid = 0
+    with open(path) as fh:
+        lines = fh.readlines()
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if lineno == len(lines):
+                continue  # truncated final line of a crashed run is legal
+            problems.append(f"{path}:{lineno}: unparseable JSON")
+            continue
+        valid += 1
+        kind = rec.get("type")
+        schema = EVENT_SCHEMA.get(kind)
+        if schema is None:
+            problems.append(
+                f"{path}:{lineno}: unknown record type {kind!r} "
+                "(add it to EVENT_SCHEMA)"
+            )
+            continue
+        for field, ftype in schema.items():
+            if field not in rec:
+                problems.append(
+                    f"{path}:{lineno}: {kind} record missing {field!r}"
+                )
+            elif ftype is float:
+                if not isinstance(rec[field], (int, float)) or isinstance(
+                    rec[field], bool
+                ):
+                    problems.append(
+                        f"{path}:{lineno}: {kind}.{field} not numeric"
+                    )
+            elif not isinstance(rec[field], ftype) or (
+                ftype is int and isinstance(rec[field], bool)
+            ):
+                problems.append(
+                    f"{path}:{lineno}: {kind}.{field} is "
+                    f"{type(rec[field]).__name__}, expected {ftype.__name__}"
+                )
+    if valid == 0:
+        # catches the empty stream AND the single-corrupt-line stream
+        # (which the truncated-final-line exemption would otherwise pass)
+        problems.append(f"{path}: no valid telemetry records")
+    return problems
+
+
+def generate_sample(directory: str) -> str:
+    """Capture a tiny span/event stream with a private tracer."""
+    from pta_replicator_tpu.obs.trace import Tracer
+
+    tracer = Tracer()
+    tracer.configure(directory)
+    with tracer.span("sample_root", check="schema"):
+        with tracer.span("sample_child") as sp:
+            sp["n"] = 1
+    tracer.event("sample_event", ok=True)
+    tracer.configure(None)  # close the sink
+    return os.path.join(directory, "events.jsonl")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    problems = check_entrypoints()
+
+    if argv:
+        target = argv[0]
+        if os.path.isdir(target):
+            target = os.path.join(target, "events.jsonl")
+        problems += validate_events(target)
+    else:
+        with tempfile.TemporaryDirectory() as d:
+            problems += validate_events(generate_sample(d))
+
+    if problems:
+        for p in problems:
+            print(f"TELEMETRY-CHECK FAIL: {p}", file=sys.stderr)
+        return 1
+    print("telemetry schema + instrumentation coverage OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,411 @@
+"""Telemetry subsystem: spans, metrics, JAX accounting, report CLI.
+
+CPU-only and fixture-free (pulsar datasets are fabricated in-process), so
+this file runs everywhere tier-1 runs.
+"""
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from pta_replicator_tpu import obs
+from pta_replicator_tpu.obs.metrics import MetricsRegistry
+from pta_replicator_tpu.obs.trace import EVENT_SCHEMA, Tracer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_globals():
+    """Each test sees empty global tracer buffers / metrics registry."""
+    obs.reset_all()
+    yield
+    obs.configure(None)
+    obs.reset_all()
+
+
+# ---------------------------------------------------------------- spans
+def test_span_nesting_paths_and_summary():
+    t = Tracer()
+    with t.span("outer", run=1):
+        with t.span("inner"):
+            pass
+        with t.span("inner"):
+            pass
+    s = t.summary()
+    assert set(s) == {"outer", "outer/inner"}
+    assert s["outer"]["calls"] == 1
+    assert s["outer/inner"]["calls"] == 2
+    # the parent's wall time contains its children's
+    assert s["outer"]["total_s"] >= s["outer/inner"]["total_s"]
+
+
+def test_span_attrs_mutable_inside():
+    t = Tracer()
+    with t.span("stage", npsr=3) as sp:
+        sp["result"] = "ok"
+    rec = [e for e in t.events() if e["type"] == "span"][0]
+    assert rec["attrs"] == {"npsr": 3, "result": "ok"}
+
+
+def test_jsonl_sink_roundtrip_and_schema(tmp_path):
+    t = Tracer()
+    t.configure(str(tmp_path))
+    with t.span("a", k="v"):
+        with t.span("b"):
+            pass
+    t.event("marker", n=2)
+    t.configure(None)  # close the sink
+
+    lines = [
+        json.loads(l)
+        for l in (tmp_path / "events.jsonl").read_text().splitlines()
+    ]
+    kinds = [r["type"] for r in lines]
+    assert kinds[0] == "meta" and kinds.count("span") == 2
+    for rec in lines:
+        for field, ftype in EVENT_SCHEMA[rec["type"]].items():
+            assert field in rec
+            if ftype is float:
+                assert isinstance(rec[field], (int, float))
+            else:
+                assert isinstance(rec[field], ftype)
+    # spans written at completion: child precedes parent in the stream
+    spans = [r for r in lines if r["type"] == "span"]
+    assert [s["path"] for s in spans] == ["a/b", "a"]
+
+
+def test_chrome_trace_export():
+    t = Tracer()
+    with t.span("x"):
+        pass
+    ct = t.chrome_trace()
+    (ev,) = ct["traceEvents"]
+    assert ev["ph"] == "X" and ev["name"] == "x" and ev["dur"] >= 0
+
+
+def test_start_capture_resets_state(tmp_path):
+    """Back-to-back captures in one process: the second dir's metrics and
+    chrome trace must describe only the second run."""
+    d1, d2 = tmp_path / "one", tmp_path / "two"
+    obs.start_capture(str(d1))
+    with obs.span("first_run"):
+        pass
+    obs.counter("demo.count").inc(7)
+    obs.finish_capture()
+    obs.start_capture(str(d2))
+    with obs.span("second_run"):
+        pass
+    obs.finish_capture()
+
+    m2 = json.loads((d2 / "metrics.json").read_text())
+    assert "demo.count" not in m2
+    ct2 = json.loads((d2 / "chrome_trace.json").read_text())
+    assert [e["name"] for e in ct2["traceEvents"]] == ["second_run"]
+    # the first capture's artifacts are untouched
+    m1 = json.loads((d1 / "metrics.json").read_text())
+    assert m1["demo.count"][0]["value"] == 7
+
+
+def test_reconfigure_truncates_previous_stream(tmp_path):
+    """One capture dir describes one run: a second capture into the same
+    dir must not merge with (and double-count against) the first."""
+    t = Tracer()
+    t.configure(str(tmp_path))
+    with t.span("first_run"):
+        pass
+    t.configure(str(tmp_path))
+    with t.span("second_run"):
+        pass
+    t.configure(None)
+    text = (tmp_path / "events.jsonl").read_text()
+    assert "second_run" in text and "first_run" not in text
+
+
+def test_idle_event_buffer_is_bounded():
+    t = Tracer()
+    for _ in range(Tracer.IDLE_MAX_EVENTS + 50):
+        with t.span("spin"):
+            pass
+    assert len(t.events()) == Tracer.IDLE_MAX_EVENTS
+    assert t.dropped == 50
+    # aggregation keeps counting past the buffer cap
+    assert t.summary()["spin"]["calls"] == Tracer.IDLE_MAX_EVENTS + 50
+
+
+def test_inherit_nests_worker_thread_spans():
+    from concurrent.futures import ThreadPoolExecutor
+
+    t = Tracer()
+    with t.span("parent"):
+        ctx = t.current_stack()
+
+        def work():
+            with t.inherit(ctx):
+                with t.span("child"):
+                    pass
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            pool.submit(work).result()
+    assert "parent/child" in t.summary()
+
+
+# -------------------------------------------------------------- metrics
+def test_metrics_json_and_prometheus_format():
+    r = MetricsRegistry()
+    r.counter("io.tim.toas").inc(122)
+    r.counter("jax.trace_count", fn="engine").inc()
+    r.gauge("mesh.devices").set(8)
+    h = r.histogram("compile.s")
+    h.observe(0.02)
+    h.observe(3.0)
+
+    j = r.to_json()
+    assert j["io.tim.toas"][0]["value"] == 122
+    assert j["compile.s"][0]["count"] == 2
+    assert j["compile.s"][0]["min"] == 0.02 and j["compile.s"][0]["max"] == 3.0
+
+    prom = r.to_prometheus()
+    assert "# TYPE io_tim_toas counter" in prom
+    assert "io_tim_toas 122.0" in prom
+    assert 'jax_trace_count{fn="engine"} 1.0' in prom
+    assert "# TYPE mesh_devices gauge" in prom
+    assert "# TYPE compile_s histogram" in prom
+    assert 'compile_s_bucket{le="+Inf"} 2' in prom
+    assert "compile_s_count 2" in prom
+    # cumulative bucket counts are monotone
+    counts = [
+        int(l.rsplit(" ", 1)[1])
+        for l in prom.splitlines() if l.startswith("compile_s_bucket")
+    ]
+    assert counts == sorted(counts)
+
+
+def test_metric_kind_collision_raises():
+    r = MetricsRegistry()
+    r.counter("x")
+    with pytest.raises(TypeError, match="registered as counter"):
+        r.gauge("x")
+
+
+# ------------------------------------------------------ jax accounting
+def test_jax_compile_counter_increments():
+    import jax
+    import jax.numpy as jnp
+
+    assert obs.install_jax_hooks()
+    before = obs.counter("jax.compiles").value
+    # a fresh shape through a fresh jit always compiles at least once
+    f = jax.jit(lambda x: (x * 3).sum())
+    np.asarray(f(jnp.ones((7, 13))))
+    assert obs.counter("jax.compiles").value > before
+    assert obs.REGISTRY.histogram("jax.compile_s").count > 0
+
+
+def test_retrace_warning_on_changed_static_arg():
+    import jax.numpy as jnp
+
+    calls = obs.instrumented_jit(
+        lambda x, n: x * n, name="retrace_probe", retrace_warn=2,
+        static_argnums=1,
+    )
+    x = jnp.ones(3)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for n in range(4):  # 4 distinct static args -> 4 traces
+            np.asarray(calls(x, n))
+    msgs = [w for w in caught if issubclass(w.category, obs.RetraceWarning)]
+    assert len(msgs) == 2  # traces 3 and 4 exceed the threshold of 2
+    assert "retrace_probe" in str(msgs[0].message)
+    assert obs.trace_count("retrace_probe") == 4
+    # cached call: no new trace, no new warning
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        np.asarray(calls(x, 3))
+    assert not caught
+    assert obs.trace_count("retrace_probe") == 4
+
+
+def test_device_memory_snapshot_shape():
+    import jax  # ensure jax is initialized so the snapshot is attempted
+
+    jax.devices()
+    snaps = obs.device_memory_snapshot()
+    assert isinstance(snaps, list) and snaps  # conftest forces 8 cpu devs
+    assert all("device" in s and "platform" in s for s in snaps)
+
+
+def test_transfer_counters():
+    obs.record_transfer(1024, "h2d")
+    obs.record_transfer(512, "h2d")
+    assert obs.counter("jax.transfer.h2d_bytes").value == 1536
+    assert obs.counter("jax.transfer.h2d_count").value == 2
+    with pytest.raises(ValueError):
+        obs.record_transfer(1, "sideways")
+
+
+# ------------------------------------------------- legacy profiling API
+def test_profiling_shims_delegate_to_obs():
+    from pta_replicator_tpu.utils.profiling import reset, stage, timings
+
+    reset()
+    with stage("demo"):
+        with stage("sub"):
+            pass
+    with stage("demo"):
+        pass
+    t = timings()
+    assert t["demo"]["calls"] == 2
+    assert t["sub"]["calls"] == 1
+    assert t["demo"]["total_s"] >= 0
+    # the same data is visible as obs spans (nested path)
+    assert "demo/sub" in obs.TRACER.summary()
+
+
+# ------------------------------------------------ pipeline + report CLI
+PAR_TEMPLATE = """PSR JFAKE0{i}
+RAJ 0{i}:37:15.8
+DECJ -{dec}:15:08.6
+F0 173.6879458121843
+F1 -1.728e-15
+PEPOCH 53000
+DM 2.64
+"""
+
+
+@pytest.fixture()
+def fabricated_partim(tmp_path):
+    """3 fabricated pulsars written as par/tim directories (no reference
+    fixtures needed)."""
+    import pta_replicator_tpu as ptr
+
+    pardir = tmp_path / "par"
+    timdir = tmp_path / "tim"
+    pardir.mkdir()
+    timdir.mkdir()
+    mjds = np.linspace(53000.0, 53000.0 + 2 * 365.25, 64)
+    for i in range(3):
+        src = tmp_path / f"src{i}.par"
+        src.write_text(PAR_TEMPLATE.format(i=i, dec=17 + 25 * i))
+        psr = ptr.simulate_pulsar(str(src), mjds, 0.5)
+        psr.write_partim(str(pardir / f"JFAKE{i:02d}.par"),
+                         str(timdir / f"JFAKE{i:02d}.tim"))
+    return str(pardir), str(timdir)
+
+
+def test_cli_telemetry_capture_and_report(
+    tmp_path, fabricated_partim, capsys
+):
+    """The acceptance path: realize --telemetry DIR, then report DIR —
+    span tree with >= 5 distinct instrumented stages and nonzero
+    jit-compile counters."""
+    from pta_replicator_tpu.__main__ import main
+
+    pardir, timdir = fabricated_partim
+    recipe = tmp_path / "recipe.json"
+    recipe.write_text(json.dumps({
+        "efac": 1.1, "rn_log10_amplitude": -14.0, "rn_gamma": 4.33,
+    }))
+    tdir = tmp_path / "telemetry"
+    main(["realize", "--pardir", pardir, "--timdir", timdir,
+          "--recipe", str(recipe), "--nreal", "4",
+          "--out", str(tmp_path / "res.npz"), "--fit",
+          "--telemetry", str(tdir)])
+    capsys.readouterr()
+
+    for artifact in ("events.jsonl", "metrics.json", "metrics.prom",
+                     "chrome_trace.json", "meta.json"):
+        assert (tdir / artifact).exists()
+
+    from pta_replicator_tpu.obs.report import aggregate_spans, load_telemetry
+
+    data = load_telemetry(str(tdir))
+    agg = aggregate_spans(data["events"])
+    assert len(agg) >= 5, f"only {sorted(agg)} stages captured"
+    for stage in ("realize", "realize/ingest", "realize/freeze",
+                  "realize/compute"):
+        assert stage in agg
+    # pool-worker parse spans inherit the ingest ancestry (not roots)
+    assert "realize/ingest/load_pulsars/read_tim" in agg
+    jax_compiles = data["metrics"]["jax.compiles"][0]["value"]
+    assert jax_compiles > 0
+
+    main(["report", str(tdir)])
+    text = capsys.readouterr().out
+    assert "realize" in text and "compute" in text
+    assert "jax.compiles" in text
+
+    main(["report", str(tdir), "--json"])
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["spans"]["realize/compute"]["calls"] == 1
+
+
+def test_schema_checker_passes_on_capture(tmp_path, fabricated_partim,
+                                          capsys):
+    """scripts/check_telemetry_schema.py: the fast CI wiring — validates
+    both the static instrumentation coverage and a real captured stream."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+
+    # no-arg mode: generated sample stream + entrypoint grep
+    assert checker.main([]) == 0
+
+    # captured-dir mode, against a real info run
+    from pta_replicator_tpu.__main__ import main
+
+    pardir, timdir = fabricated_partim
+    tdir = tmp_path / "telemetry"
+    main(["info", "--pardir", pardir, "--timdir", timdir,
+          "--telemetry", str(tdir)])
+    capsys.readouterr()
+    assert checker.main([str(tdir)]) == 0
+
+    # a corrupted stream (non-final line) is caught
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "events.jsonl").write_text('{"type": "span"}\nnot-json\n{}\n')
+    assert checker.main([str(bad)]) == 1
+
+
+def test_sweep_and_sharded_paths_record_spans(tmp_path, fabricated_partim,
+                                              capsys):
+    """The mesh + sweep engines leave their spans and transfer counters."""
+    from pta_replicator_tpu.__main__ import main
+
+    pardir, timdir = fabricated_partim
+    recipe = tmp_path / "recipe.json"
+    recipe.write_text(json.dumps({"efac": 1.0}))
+    tdir = tmp_path / "telemetry"
+    main(["realize", "--pardir", pardir, "--timdir", timdir,
+          "--recipe", str(recipe), "--nreal", "16", "--sharded",
+          "--chunk", "8", "--checkpoint", str(tmp_path / "ck.npz"),
+          "--out", str(tmp_path / "res.npz"), "--telemetry", str(tdir)])
+    capsys.readouterr()
+
+    from pta_replicator_tpu.obs.report import aggregate_spans, load_telemetry
+
+    agg = aggregate_spans(load_telemetry(str(tdir))["events"])
+    chunk_paths = [p for p in agg if p.endswith("sweep_chunk")]
+    assert chunk_paths and agg[chunk_paths[0]]["calls"] == 2
+    assert any("sharded_realize" in p for p in agg)
+    assert any(p.endswith("readback_fence") for p in agg)
+    metrics = load_telemetry(str(tdir))["metrics"]
+    assert metrics["jax.transfer.h2d_bytes"][0]["value"] > 0
+    assert metrics["sweep.realizations"][0]["value"] == 16
+
+
+# ------------------------------------------------------- bench summary
+def test_telemetry_summary_shape():
+    with obs.span("stage_one"):
+        pass
+    obs.counter("jax.compiles").inc(3)
+    s = obs.telemetry_summary()
+    assert s["spans"]["stage_one"]["calls"] == 1
+    assert s["jax"]["jax.compiles"] == 3

@@ -35,11 +35,38 @@ def test_inject_requires_make_ideal(partim_small):
         psr.update_added_signals("x", {})
 
 
-def test_duplicate_signal_rejected(psrs_small):
-    psr = psrs_small[0]
-    psr.update_added_signals("sig", {"a": 1})
-    with pytest.raises(ValueError, match="already exists"):
-        psr.update_added_signals("sig", {"a": 2})
+@pytest.fixture()
+def fabricated_psr(tmp_path):
+    """A make_ideal'd pulsar with no reference-fixture dependency."""
+    par = tmp_path / "fake.par"
+    par.write_text(
+        "PSR JFAKE00\nRAJ 04:37:15.8\nDECJ -47:15:08.6\n"
+        "F0 173.6879458121843\nF1 -1.728e-15\nPEPOCH 53000\nDM 2.64\n"
+    )
+    psr = simulate_pulsar(
+        str(par), np.linspace(53000.0, 53600.0, 50), 0.5
+    )
+    make_ideal(psr)
+    return psr
+
+
+def test_duplicate_signal_disambiguated(fabricated_psr):
+    """Repeated injections under one name get deterministic suffixes
+    (name, name_2, name_3, ...) and keep separate ledger entries."""
+    psr = fabricated_psr
+    assert psr.update_added_signals("sig", {"a": 1}) == "sig"
+    assert psr.update_added_signals("sig", {"a": 2}) == "sig_2"
+    assert psr.update_added_signals("sig", {"a": 3}) == "sig_3"
+    assert psr.added_signals["sig"] == {"a": 1}
+    assert psr.added_signals["sig_2"] == {
+        "a": 2, "disambiguated_from": "sig"
+    }
+    # the delay ledger stays per-entry too
+    n = psr.toas.ntoas
+    psr.inject("dup", {}, np.full(n, 1e-7))
+    assert psr.inject("dup", {}, np.full(n, 2e-7)) == "dup_2"
+    assert np.allclose(psr.added_signals_time["dup"], 1e-7)
+    assert np.allclose(psr.added_signals_time["dup_2"], 2e-7)
 
 
 def test_injected_delay_appears_in_residuals(psrs_small):
