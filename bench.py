@@ -202,11 +202,24 @@ def _cpu_oracle_rate(npsr=68, ntoa=7758, ncw=100):
         return 1.0 / (time.perf_counter() - t0)
 
 
-def build_workload(npsr=68, ntoa=7758, nbackend=4, ncw=100):
+def build_workload(npsr=68, ntoa=7758, nbackend=4, ncw=100,
+                   with_fingerprint=False):
     """The canonical bench workload: NG15-scale synthetic batch + full
     recipe (per-backend EFAC/EQUAD/ECORR, 30-mode RN, HD GWB, 100-source
     CW catalog). Shared with benchmarks/fused_ablation.py so stage
-    attribution is always measured on the headline workload."""
+    attribution is always measured on the headline workload.
+
+    ``with_fingerprint=True`` also returns a content hash binding the
+    workload definition: the build parameters, RNG stream contract
+    version (STREAM_VERSION), and the bytes of every host-side random
+    draw feeding the recipe. The ONE fingerprint shared by
+    benchmarks/mk_workload.py (stamps it into the /tmp/workload.npz
+    static-plane cache) and benchmarks/fast_capture.py (refuses a cache
+    whose stamp differs) — shape/dtype alone let a stale plane from an
+    older workload definition masquerade as current (ADVICE.md r5).
+    Hashed from the numpy intermediates BEFORE device placement, so
+    verification never hauls device arrays back through the tunnel.
+    """
     import jax.numpy as jnp
 
     from pta_replicator_tpu.batch import synthetic_batch
@@ -221,17 +234,28 @@ def build_workload(npsr=68, ntoa=7758, nbackend=4, ncw=100):
         axis=1,
     )
     orf = hellings_downs_matrix(locs)
-    cat = random_cw_catalog(rng, ncw)
+    # host draws in a dict BOTH to feed the recipe and to fingerprint —
+    # the rng call order here is the workload definition and must not
+    # change (it is what keeps rounds comparable)
+    draws = {
+        "cgw_params": random_cw_catalog(rng, ncw),
+        "efac": rng.uniform(0.9, 1.3, (npsr, nbackend)),
+        "log10_equad": rng.uniform(-7.5, -6.0, (npsr, nbackend)),
+        "log10_ecorr": rng.uniform(-7.5, -6.3, (npsr, nbackend)),
+        "rn_log10_amplitude": rng.uniform(-14.5, -13.0, npsr),
+        "rn_gamma": rng.uniform(2.0, 5.0, npsr),
+        "orf_cholesky": np.linalg.cholesky(np.asarray(orf)),
+    }
     recipe = Recipe(
-        efac=jnp.asarray(rng.uniform(0.9, 1.3, (npsr, nbackend))),
-        log10_equad=jnp.asarray(rng.uniform(-7.5, -6.0, (npsr, nbackend))),
-        log10_ecorr=jnp.asarray(rng.uniform(-7.5, -6.3, (npsr, nbackend))),
-        rn_log10_amplitude=jnp.asarray(rng.uniform(-14.5, -13.0, npsr)),
-        rn_gamma=jnp.asarray(rng.uniform(2.0, 5.0, npsr)),
+        efac=jnp.asarray(draws["efac"]),
+        log10_equad=jnp.asarray(draws["log10_equad"]),
+        log10_ecorr=jnp.asarray(draws["log10_ecorr"]),
+        rn_log10_amplitude=jnp.asarray(draws["rn_log10_amplitude"]),
+        rn_gamma=jnp.asarray(draws["rn_gamma"]),
         gwb_log10_amplitude=jnp.asarray(-14.0),
         gwb_gamma=jnp.asarray(4.33),
-        orf_cholesky=jnp.asarray(np.linalg.cholesky(np.asarray(orf))),
-        cgw_params=jnp.asarray(cat),
+        orf_cholesky=jnp.asarray(draws["orf_cholesky"]),
+        cgw_params=jnp.asarray(draws["cgw_params"]),
         gwb_npts=600,
         gwb_howml=10.0,
         cgw_chunk=100,
@@ -241,7 +265,22 @@ def build_workload(npsr=68, ntoa=7758, nbackend=4, ncw=100):
         gwb_synthesis_precision=os.environ.get("BENCH_SYNTH_PRECISION")
         or None,
     )
-    return batch, recipe
+    if not with_fingerprint:
+        return batch, recipe
+
+    import hashlib
+
+    from pta_replicator_tpu.models.batched import STREAM_VERSION
+
+    h = hashlib.sha256()
+    h.update(
+        f"npsr={npsr};ntoa={ntoa};nbackend={nbackend};ncw={ncw};"
+        f"seed=0;stream={STREAM_VERSION}".encode()
+    )
+    for name in sorted(draws):
+        h.update(name.encode())
+        h.update(np.ascontiguousarray(draws[name]).tobytes())
+    return batch, recipe, h.hexdigest()[:16]
 
 
 def _bench():
@@ -445,6 +484,61 @@ def _bench():
     # (they are key-independent data); their one-time cost is reported
     # separately as stages.cgw_catalog_once
     extra["cgw_static_amortized"] = True
+
+    # ---- pipelined sweep A/B: the checkpointed-sweep executor's overlap
+    # (parallel.pipeline, PR 2) measured on the bench workload — depth 1
+    # (synchronous dispatch->fence->write) vs depth 2 (double-buffered).
+    # Small (3 chunks, reduced readback) so it cannot eat the window;
+    # the per-stage dispatch/drain/io_write spans land in the telemetry
+    # block below. BENCH_SWEEP_PIPELINE=0 skips.
+    if os.environ.get("BENCH_SWEEP_PIPELINE", "1") == "1":
+        try:
+            import shutil
+            import tempfile
+
+            from pta_replicator_tpu.utils.sweep import sweep as _sweep
+
+            sp_chunk = min(chunk, 200)
+            sp_nchunks = 3
+            sp = {"chunk": sp_chunk, "nchunks": sp_nchunks,
+                  "reduce": "rms"}
+            # warm the sweep's realize engine at this chunk shape first:
+            # the depth-1 arm runs first and must not absorb the compile
+            from pta_replicator_tpu.models.batched import realize as _rlz
+
+            np.asarray(_rlz(jax.random.PRNGKey(122), batch, recipe,
+                            nreal=sp_chunk, static=static))
+            # depth 2 FIRST: its drain deadline bounds a wedged tunnel
+            # (the depth-1 synchronous loop has no deadline — its fence
+            # would block until the child's BENCH_TIMEOUT kill), so a
+            # slow/stuck depth-2 arm skips the unbounded one entirely
+            for depth_ in (2, 1):
+                d_ = tempfile.mkdtemp(prefix="bench_sweeppipe_")
+                try:
+                    with obs.span("sweep_ab", depth=depth_):
+                        t0 = time.perf_counter()
+                        _sweep(
+                            jax.random.PRNGKey(123), batch, recipe,
+                            nreal=sp_chunk * sp_nchunks, chunk=sp_chunk,
+                            checkpoint_path=os.path.join(d_, "s.npz"),
+                            pipeline_depth=depth_,
+                            drain_timeout_s=300.0,
+                        )
+                        sp[f"depth{depth_}_s"] = round(
+                            time.perf_counter() - t0, 3
+                        )
+                finally:
+                    shutil.rmtree(d_, ignore_errors=True)
+                if time.perf_counter() - t0 > 300:
+                    sp["aborted"] = "depth arm exceeded 300s"
+                    break
+            if "depth2_s" in sp and "depth1_s" in sp:
+                sp["speedup_depth2_vs_depth1"] = round(
+                    sp["depth1_s"] / sp["depth2_s"], 3
+                )
+            extra["sweep_pipeline"] = sp
+        except Exception as exc:
+            extra["sweep_pipeline_error"] = repr(exc)[:200]
 
     # ---- CPU-oracle baseline (VERDICT r3 item 8): one honest measured
     # speedup ratio replacing the soft north-star multiple. ~20 s of

@@ -24,12 +24,16 @@ from bench import build_workload  # noqa: E402
 from pta_replicator_tpu.models.batched import deterministic_delays  # noqa: E402
 
 t = time.time()
-batch, recipe = build_workload(ncw=100)
+# the fingerprint binds the cache to THIS workload definition (build
+# params, host draw bytes, STREAM_VERSION): fast_capture verifies it
+# before reuse, so a plane serialized from an older workload can never
+# silently substitute different static data (ADVICE.md r5)
+batch, recipe, fp = build_workload(ncw=100, with_fingerprint=True)
 static = np.asarray(deterministic_delays(batch, recipe))
 # atomic write: a reader (fast_capture mid-window) must never see a
 # truncated file
 tmp = "/tmp/workload.tmp.npz"  # np.savez appends .npz to other suffixes
-np.savez(tmp, static=static)
+np.savez(tmp, static=static, fingerprint=np.array(fp))
 os.replace(tmp, "/tmp/workload.npz")
 print(f"wrote /tmp/workload.npz {static.shape} {static.dtype} "
-      f"in {time.time()-t:.1f}s")
+      f"fp={fp} in {time.time()-t:.1f}s")

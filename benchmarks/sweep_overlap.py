@@ -1,0 +1,183 @@
+"""A/B microbench for the pipelined sweep executor: identical sweeps at
+pipeline_depth=1 (the synchronous reference loop) and depth>=2 (the
+double-buffered executor), on the CPU backend with ``reduce_fn=None`` —
+the I/O-heavy configuration where every chunk hauls a full
+(chunk, Np, Nt) residual cube through host readback and a .npy
+checkpoint write, i.e. exactly the latency the pipeline exists to hide.
+
+Prints one JSON line::
+
+    {"depth1_s": ..., "depth2_s": ..., "reduction_pct": ...,
+     "bit_identical": true, "telemetry": {"depth1": {...}, "depth2": {...}}}
+
+``reduction_pct`` is the headline: wall-time saved by depth 2 vs depth 1
+(acceptance floor: >= 20%). The per-arm ``telemetry`` blocks carry the
+span aggregates that evidence the overlap — at depth 1 the chunk wall is
+the SUM of compute + ``readback_fence`` + write; at depth 2 the
+``drain`` + ``io_write`` totals overlap the dispatch stream, so
+``sweep_pipeline`` wall approaches max(compute, drain+io) instead of the
+sum. ``bit_identical`` confirms the two arms produced byte-equal
+consolidated checkpoints (the executor's core contract).
+
+Usage: python benchmarks/sweep_overlap.py [nreal] [chunk] [depth]
+  defaults 2048 x 256, depth 2; SWEEP_OVERLAP_NPSR / _NTOA / _NREP
+  reshape the workload (defaults 8 x 8192, 5 reps, median-of-reps —
+  arms interleaved, each rep on cold files).
+"""
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from pta_replicator_tpu import obs  # noqa: E402
+from pta_replicator_tpu.batch import synthetic_batch  # noqa: E402
+from pta_replicator_tpu.models.batched import Recipe  # noqa: E402
+from pta_replicator_tpu.utils.sweep import sweep  # noqa: E402
+
+
+def _pipeline_spans(summary: dict) -> dict:
+    """The sweep-relevant span aggregates from an obs summary (path
+    suffix match: worker-thread spans nest under the sweep span)."""
+    keep = (
+        "sweep_chunk", "readback_fence", "sweep_pipeline", "dispatch",
+        "drain", "io_write",
+    )
+    out = {}
+    for path, agg in summary.items():
+        leaf = path.rsplit("/", 1)[-1]
+        if leaf in keep:
+            out[leaf] = {
+                "calls": agg["calls"],
+                "total_s": round(agg["total_s"], 4),
+            }
+    return out
+
+
+def run_arm(depth, key, batch, recipe, nreal, chunk, workdir):
+    """One sweep at ``depth`` into a fresh checkpoint; returns
+    (wall_s, telemetry, sha256 of the consolidated npz).
+
+    A FRESH subdirectory per invocation: re-writing the same chunk
+    filenames would hit warm page-cache/9p entries on later reps,
+    silently deleting the I/O cost the pipeline exists to hide (a real
+    sweep writes every chunk file exactly once). Cold files for every
+    arm, every rep, keeps the A/B honest."""
+    arm_dir = tempfile.mkdtemp(prefix=f"arm_d{depth}_", dir=workdir)
+    ckpt = os.path.join(arm_dir, f"sweep_d{depth}.npz")
+    obs.reset_all()
+    t0 = time.perf_counter()
+    # durable=True: fsync-backed checkpoint writes. This is the honest
+    # I/O-heavy configuration — the fsync is a kernel-side disk wait
+    # with no CPU cost, so the depth-1 arm pays it serially per chunk
+    # while the depth>=2 arm hides it behind device compute. (Plain
+    # page-cache writes are mostly memcpy, which on a CPU-only host
+    # competes with XLA for the same cores and cannot be overlapped
+    # away.)
+    sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+          checkpoint_path=ckpt, reduce_fn=None, pipeline_depth=depth,
+          durable=True)
+    wall = time.perf_counter() - t0
+    telem = _pipeline_spans(obs.TRACER.summary())
+    # streaming digest, not raw bytes: at the default config each
+    # consolidated npz is ~0.5 GiB — holding both arms' archives
+    # resident would pressure the page cache of the very host the A/B
+    # is timing
+    h = hashlib.sha256()
+    with open(ckpt, "rb") as fh:
+        for piece in iter(lambda: fh.read(1 << 22), b""):
+            h.update(piece)
+    shutil.rmtree(arm_dir, ignore_errors=True)
+    return wall, telem, h.hexdigest()
+
+
+def main():
+    nreal = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    chunk = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    depth = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+    npsr = int(os.environ.get("SWEEP_OVERLAP_NPSR", "8"))
+    ntoa = int(os.environ.get("SWEEP_OVERLAP_NTOA", "8192"))
+    nrep = int(os.environ.get("SWEEP_OVERLAP_NREP", "5"))
+
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=0)
+    # white noise + 150-mode red noise: device compute per chunk sized
+    # to (slightly exceed) the writer thread's full per-chunk burden —
+    # durable 64 MB cube writes + the incremental npz consolidation —
+    # so the pipeline hides the WHOLE I/O side and the A/B measures the
+    # overlap rather than trading one serial bottleneck for another
+    recipe = Recipe(
+        efac=jnp.ones(npsr, batch.toas_s.dtype),
+        rn_log10_amplitude=jnp.full(npsr, -14.0, batch.toas_s.dtype),
+        rn_gamma=jnp.full(npsr, 4.0, batch.toas_s.dtype),
+        rn_nmodes=150,
+    )
+    key = jax.random.PRNGKey(7)
+    d = tempfile.mkdtemp(prefix="sweep_overlap_")
+    try:
+        # warm-up: compile the realize engine + touch the filesystem once
+        run_arm(1, key, batch, recipe, chunk, chunk, d)
+
+        results = {1: [], depth: []}
+        telem = {}
+        digests = {}
+        # interleave arms so filesystem-cache drift hits both equally
+        for _ in range(nrep):
+            for dep in (1, depth):
+                wall, t, digest = run_arm(
+                    dep, key, batch, recipe, nreal, chunk, d
+                )
+                results[dep].append(wall)
+                if dep not in telem or wall <= min(results[dep]):
+                    telem[dep] = t  # keep the best rep's span profile
+                digests[dep] = digest
+
+        # median over interleaved reps: the shared-host 9p filesystem and
+        # vCPU load both swing ~2x between reps, and a min-of-reps pairs a
+        # lucky cheap-write depth-1 rep against a typical depth-2 one;
+        # the median compares typical against typical
+        med = lambda xs: float(np.median(xs))  # noqa: E731
+        t1, t2 = med(results[1]), med(results[depth])
+        chunk_nbytes = chunk * npsr * ntoa * np.dtype(
+            batch.toas_s.dtype
+        ).itemsize
+        rec = {
+            "bench": "sweep_overlap",
+            "platform": jax.default_backend(),
+            "nreal": nreal, "chunk": chunk, "npsr": npsr, "ntoa": ntoa,
+            "nchunks": nreal // chunk, "pipeline_depth": depth,
+            "reduce_fn": None, "durable_writes": True, "nrep": nrep,
+            "chunk_result_mb": round(chunk_nbytes / 2**20, 1),
+            "depth1_s": round(t1, 3),
+            f"depth{depth}_s": round(t2, 3),
+            "depth1_all_s": [round(x, 3) for x in results[1]],
+            f"depth{depth}_all_s": [round(x, 3) for x in results[depth]],
+            "speedup": round(t1 / t2, 3),
+            "reduction_pct": round(100.0 * (1.0 - t2 / t1), 1),
+            "bit_identical": digests[1] == digests[depth],
+            "telemetry": {
+                "depth1": telem[1],
+                f"depth{depth}": telem[depth],
+            },
+            "timestamp": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+        print(json.dumps(rec))
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

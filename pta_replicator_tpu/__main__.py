@@ -117,6 +117,20 @@ def main(argv=None):
     p.add_argument("--checkpoint", default=None,
                    help="resumable sweep checkpoint path (chunked)")
     p.add_argument("--chunk", type=int, default=256)
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="chunks in flight for a checkpointed sweep: 2 "
+                        "(default) overlaps device compute with host "
+                        "readback and checkpoint I/O (double buffering; "
+                        "device memory bound = depth x chunk result "
+                        "size); 1 runs the synchronous debug loop. "
+                        "Results are identical at every depth.")
+    p.add_argument("--drain-timeout", type=float, default=900.0,
+                   metavar="S",
+                   help="fail a pipelined sweep when a single chunk "
+                        "readback or checkpoint write exceeds S seconds "
+                        "(wedged tunnel/filesystem). Raise it for "
+                        "legitimately slow large-chunk readbacks; "
+                        "<= 0 disables the deadline")
     p.add_argument("--write-partim", default=None, metavar="DIR",
                    help="also materialize realizations as par/tim datasets "
                         "under DIR/real{r:05d}/ (pre-fit injected delays, "
@@ -222,6 +236,10 @@ def _run_command(args):
             out = sweep(key, batch, recipe, nreal=args.nreal,
                         checkpoint_path=args.checkpoint, chunk=chunk,
                         reduce_fn=None, fit=args.fit, mesh=mesh,
+                        pipeline_depth=args.pipeline_depth,
+                        drain_timeout_s=(args.drain_timeout
+                                         if args.drain_timeout > 0
+                                         else None),
                         progress=lambda d, t: print(f"chunk {d}/{t}",
                                                     file=sys.stderr))
         elif args.sharded:

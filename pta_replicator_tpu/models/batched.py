@@ -13,6 +13,7 @@ equivalent of the reference's string-flag loops
 """
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -1359,6 +1360,69 @@ def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
     return total
 
 
+def realize_block(
+    keys, batch: PulsarBatch, recipe: Recipe, fit: bool, rows=None,
+    static=None,
+):
+    """The per-block realization pipeline: vmap of
+    ``realization_delays + static -> finalize_residuals`` over a key
+    block. The ONE implementation shared by the single-device engine
+    below and every mesh engine (parallel.mesh), so the per-realization
+    pipeline cannot silently diverge between paths.
+
+    ``rows=(npsr_global, row_start)`` makes every stochastic draw an
+    exact row window of the global stream (pulsar-sharded shard_map)."""
+    if static is None:
+        static = deterministic_delays(batch, recipe)
+
+    def one(k):
+        d = realization_delays(k, batch, recipe, rows=rows) + static
+        return finalize_residuals(d, batch, recipe, fit)
+
+    return jax.vmap(one)(keys)
+
+
+def donate_keys_argnums(platform: str) -> tuple:
+    """``donate_argnums`` for an engine's per-chunk key block: keys are
+    split fresh per call and never reused, so donating them is always
+    safe. The shared ``static`` delays and the batch are deliberately
+    NOT donated — the same arrays feed every chunk of a sweep. CPU
+    doesn't implement donation (and warns per compile), so it opts out.
+    The ONE policy shared by the single-device and mesh engines.
+
+    Best-effort by design: XLA honors a donation only when the buffer
+    can alias an output, and the tiny key block rarely can — expect a
+    one-time "donated buffers were not usable" note per engine compile
+    on donation-capable backends, not a guaranteed saving. Donating the
+    *safe* inputs anyway keeps the engines ready to alias if a future
+    output layout permits it, and documents which inputs never may
+    (``static``)."""
+    return () if platform == "cpu" else (0,)
+
+
+@functools.lru_cache(maxsize=None)
+def _realize_engine(fit: bool, donate_keys: bool):
+    """Jitted single-device realization engine, cached per (fit, donate)
+    so repeated chunked calls (utils.sweep) hit jax's compile cache
+    instead of re-dispatching the op graph eagerly every chunk.
+
+    ``donate_keys``: see :func:`donate_keys_argnums` (keys are fresh per
+    call, so donation is safe; ``static`` is reused every chunk and is
+    never donated).
+    """
+    from ..obs import instrumented_jit
+
+    def run(keys, batch, recipe, static):
+        return realize_block(keys, batch, recipe, fit, static=static)
+
+    return instrumented_jit(
+        run,
+        name="batched.realize_engine",
+        retrace_warn=32,
+        donate_argnums=(0,) if donate_keys else (),
+    )
+
+
 def realize(
     key,
     batch: PulsarBatch,
@@ -1370,21 +1434,23 @@ def realize(
     """Batch of independent realizations: (R, Np, Nt) residuals.
 
     vmap over PRNG keys gives the realization axis; shard it across
-    devices with parallel.sharded_realize.
+    devices with parallel.sharded_realize. Returns the UN-FETCHED output
+    of a cached jitted engine: dispatch is asynchronous, so a pipelined
+    caller (parallel.pipeline via utils.sweep) can queue the next chunk
+    and fence this one later with a host readback.
 
     ``static``: precomputed :func:`deterministic_delays` result. The
     deterministic delays (CW catalog, bursts, memory) depend only on
     (batch, recipe), so a caller invoking ``realize`` repeatedly — a
     chunked sweep — should compute them once and pass them in; rebuilding
-    the CW catalog inside every jitted call costs ~10 ms/call at the
-    bench workload, which dominates a 100-realization chunk.
+    the CW catalog per chunk costs ~10 ms/call at the bench workload,
+    which dominates a 100-realization chunk (and the eager precompute is
+    also what keeps the CW planes at f64 host accuracy — static is
+    computed OUTSIDE the engine's jit boundary here for that reason,
+    see parallel.mesh.static_delays).
     """
     keys = jax.random.split(key, nreal)
     if static is None:
         static = deterministic_delays(batch, recipe)
-
-    def one(k):
-        d = realization_delays(k, batch, recipe) + static
-        return finalize_residuals(d, batch, recipe, fit)
-
-    return jax.vmap(one)(keys)
+    donate = bool(donate_keys_argnums(jax.default_backend()))
+    return _realize_engine(fit, donate)(keys, batch, recipe, static)

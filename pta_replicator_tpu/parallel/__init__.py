@@ -1,4 +1,4 @@
-from . import distributed
+from . import distributed, pipeline
 from .mesh import (
     make_mesh,
     shard_batch,
@@ -6,12 +6,16 @@ from .mesh import (
     shardmap_realize,
     static_delays,
 )
+from .pipeline import DrainTimeout, run_pipelined
 
 __all__ = [
     "distributed",
+    "pipeline",
     "make_mesh",
     "shard_batch",
     "sharded_realize",
     "shardmap_realize",
     "static_delays",
+    "DrainTimeout",
+    "run_pipelined",
 ]

@@ -29,8 +29,8 @@ from ..batch import PulsarBatch
 from ..models.batched import (
     Recipe,
     deterministic_delays,
-    finalize_residuals,
-    realization_delays,
+    donate_keys_argnums,
+    realize_block as _realize_block,
 )
 from ..obs import gauge, instrumented_jit, record_transfer, span, tree_nbytes
 
@@ -94,6 +94,9 @@ def sharded_realize(
 
     Returns a jitted, committed global array; per-device shards hold
     R/n_real realizations of Np/n_psr pulsars. nreal must divide evenly.
+    The array is UN-FETCHED (dispatch is asynchronous): a pipelined
+    caller (parallel.pipeline via utils.sweep) queues the next chunk
+    immediately and fences this one later with a host readback.
 
     ``static``: precomputed deterministic (CW/burst/memory) delays for
     this (batch, recipe) — see :func:`static_delays`. Callers issuing
@@ -147,21 +150,10 @@ def static_delays(batch: PulsarBatch, recipe: Recipe, mesh: Optional[Mesh] = Non
         return out
 
 
-def _realize_block(
-    keys, batch: PulsarBatch, recipe: Recipe, fit: bool, rows=None, static=None
-):
-    """The per-block realization pipeline shared by both mesh engines.
-
-    ``rows=(npsr_global, row_start)`` makes every stochastic draw an
-    exact row window of the global stream (pulsar-sharded shard_map)."""
-    if static is None:
-        static = deterministic_delays(batch, recipe)
-
-    def one(k):
-        d = realization_delays(k, batch, recipe, rows=rows) + static
-        return finalize_residuals(d, batch, recipe, fit)
-
-    return jax.vmap(one)(keys)
+def _donate_keys(mesh: Mesh) -> tuple:
+    """The shared key-donation policy (models.batched.donate_keys_argnums)
+    applied to this mesh's platform."""
+    return donate_keys_argnums(mesh.devices.flat[0].platform)
 
 
 @functools.lru_cache(maxsize=64)
@@ -178,7 +170,9 @@ def _constraint_engine(mesh: Mesh, fit: bool):
     # instrumented_jit: each retrace/recompile of the engine is counted
     # in jax.trace_count{fn=...} and warns past the threshold (a fresh
     # mesh or fit flag per call would silently recompile minutes of XLA)
-    return instrumented_jit(run, name="mesh.constraint_engine", retrace_warn=32)
+    return instrumented_jit(run, name="mesh.constraint_engine",
+                            retrace_warn=32,
+                            donate_argnums=_donate_keys(mesh))
 
 
 def _shard_map():
@@ -207,6 +201,7 @@ def _shardmap_engine(mesh: Mesh, fit: bool):
         ),
         name="mesh.shardmap_engine",
         retrace_warn=32,
+        donate_argnums=_donate_keys(mesh),
     )
 
 
@@ -245,6 +240,7 @@ def _shardmap_psr_engine(mesh: Mesh, fit: bool, recipe_treedef, recipe_specs):
         ),
         name="mesh.shardmap_psr_engine",
         retrace_warn=32,
+        donate_argnums=_donate_keys(mesh),
     )
 
 

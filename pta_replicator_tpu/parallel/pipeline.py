@@ -1,0 +1,302 @@
+"""Asynchronous double-buffered chunk executor: overlap device compute
+with host readback and checkpoint I/O.
+
+The synchronous sweep loop (utils/sweep.py before this module existed)
+serialized three stages per chunk:
+
+    dispatch chunk i -> block on host readback -> write .npy + sidecar
+
+so the device idled for the full readback + reduction + disk latency of
+every chunk — on the tunneled TPU backend that latency dominates the
+per-chunk cost (PR 1 telemetry: the ``readback_fence`` span).
+:func:`run_pipelined` splits the stages onto three actors:
+
+* the **caller's thread** dispatches chunks back-to-back. JAX dispatch is
+  asynchronous, so ``dispatch(i)`` returns an *un-fetched* device array
+  and the device starts chunk *i+1* while chunk *i* is still draining;
+* a single **reader thread** fetches results back to host (the readback
+  IS the device-sync fence on the tunneled backend — see bench.py), in
+  dispatch order;
+* a single **writer thread** runs ``write(i, block)`` — the checkpoint
+  chunk file + ``done`` sidecar — strictly in chunk order, preserving
+  the crash-safety contract (chunk file lands before the sidecar that
+  marks it done, and chunk *i*'s files land before chunk *i+1*'s).
+
+The in-flight window is bounded by ``depth`` (default 2, classic double
+buffering): at most ``depth`` un-fetched chunk results exist at once, so
+device memory use is bounded by ``depth x chunk_result_nbytes`` no matter
+how far the dispatcher could run ahead.  A hung readback (wedged tunnel)
+fails fast: when no fetch completes within ``drain_timeout_s`` the run
+raises :class:`DrainTimeout` instead of blocking forever (the wedged
+reader thread is a daemon, so process exit is never held hostage).
+
+Determinism: the executor changes *when* results are fetched and
+written, never *what* is computed — same dispatch order, one reader, one
+writer, FIFO queues — so a pipelined sweep is byte-identical to the
+synchronous loop (tests/test_pipeline.py proves it on the checkpoint
+files themselves).
+
+Telemetry: ``dispatch`` / ``drain`` / ``io_write`` spans per chunk (the
+reader and writer adopt the caller's span ancestry, so they nest under
+the sweep span in the report tree) and the ``sweep.inflight_chunks``
+gauge. Overlap shows up in a captured report as
+``sum(drain) + sum(io_write)`` approaching ``sum(dispatch..wall)``
+instead of adding to it — docs/performance.md shows a worked reading.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from ..obs import gauge, span
+from ..obs.trace import TRACER
+
+
+class DrainTimeout(RuntimeError):
+    """A host readback or checkpoint write stalled past
+    ``drain_timeout_s`` — the backend (tunnel) or the checkpoint
+    filesystem is wedged mid-operation."""
+
+
+_STOP = object()  # queue sentinel: no more chunks
+
+
+def run_pipelined(
+    indices: Iterable[int],
+    dispatch: Callable[[int], object],
+    write: Callable[[int, np.ndarray], None],
+    *,
+    depth: int = 2,
+    fetch: Callable[[object], np.ndarray] = np.asarray,
+    drain_timeout_s: Optional[float] = 900.0,
+) -> dict:
+    """Run ``dispatch -> fetch -> write`` over ``indices`` with a bounded
+    in-flight window of ``depth`` chunks.
+
+    ``dispatch(i)`` must return an un-fetched device value (a jitted
+    engine's output); ``fetch`` pulls it to host (``np.asarray`` fences
+    queued device work, including collectives); ``write(i, block)`` runs
+    on the single writer thread, strictly in ``indices`` order.
+
+    Returns a stats dict (``chunks``, ``wall_s``, ``max_inflight``,
+    ``drain_wait_s`` — time the dispatcher spent blocked on the full
+    window, i.e. how much *further* ahead it could have run).
+
+    A failing stage stops the pipeline and its exception re-raises on
+    the caller's thread UNCHANGED (exactly what the synchronous loop
+    would raise — a ``progress`` callback aborting a sweep sees the same
+    exception type at any depth); a fetch exceeding ``drain_timeout_s``
+    raises :class:`DrainTimeout` (``None`` disables the deadline). On
+    error, files already written are valid completed chunks — the
+    crash-safety ordering means a resume recomputes only chunks whose
+    sidecar never landed.
+    """
+    if depth < 2:
+        raise ValueError(
+            f"pipeline depth must be >= 2 (got {depth}); depth 1 is the "
+            "synchronous loop — run it inline, there is nothing to overlap"
+        )
+
+    # the window semaphore is the memory bound: a slot is taken BEFORE a
+    # chunk is dispatched and released when its fetch completes, so at
+    # most ``depth`` un-fetched device results exist at any instant (the
+    # queues themselves then never hold more than depth entries)
+    window = threading.Semaphore(depth)
+    drain_q: queue.Queue = queue.Queue()
+    io_q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    errors: list = []  # [(stage, exc)] — first entry wins
+    stack = TRACER.current_stack()  # nest worker spans under the caller's
+
+    # stage heartbeats for the deadline: monotonic start time of the
+    # fetch / write currently in flight, None while that worker is
+    # between items. Both are covered — a checkpoint directory on a
+    # hung mount wedges the WRITER first (io_q then fills and the
+    # reader parks between fetches), and must trip the same deadline
+    # a wedged readback does.
+    fetch_started = [None]
+    write_started = [None]
+    inflight = [0]  # dispatched - drained, under lock
+    lock = threading.Lock()
+    stats = {"chunks": 0, "max_inflight": 0, "drain_wait_s": 0.0}
+
+    def _fail(stage: str, exc: BaseException) -> None:
+        with lock:
+            errors.append((stage, exc))
+        stop.set()
+
+    def _bump(delta: int) -> None:
+        with lock:
+            inflight[0] += delta
+            stats["max_inflight"] = max(stats["max_inflight"], inflight[0])
+            gauge("sweep.inflight_chunks").set(inflight[0])
+
+    def _put(q: queue.Queue, item) -> bool:
+        """Put that stays responsive to stop (io_q is bounded). Returns
+        False when the pipeline is stopping."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                pass
+        return False
+
+    def _check_deadline() -> None:
+        if drain_timeout_s is None:
+            return
+        for stage, started, what in (
+            ("drain", fetch_started, "host readback"),
+            ("io_write", write_started, "checkpoint write"),
+        ):
+            t0 = started[0]
+            if t0 is not None and time.monotonic() - t0 > drain_timeout_s:
+                _fail(
+                    stage,
+                    DrainTimeout(
+                        f"{what} exceeded {drain_timeout_s:.0f}s — "
+                        "backend or filesystem wedged"
+                    ),
+                )
+
+    def _reader() -> None:
+        with TRACER.inherit(stack):
+            while True:
+                item = drain_q.get()
+                if item is _STOP or stop.is_set():
+                    break
+                i, dev = item
+                try:
+                    fetch_started[0] = time.monotonic()
+                    with span("drain", chunk=i):
+                        block = fetch(dev)
+                    fetch_started[0] = None
+                    if stop.is_set():
+                        # abandoned run: a DrainTimeout already raised on
+                        # the caller's thread and a RETRY sweep may be
+                        # live — a late-unwedging fetch must not mutate
+                        # the shared gauge/window under the retry's feet
+                        break
+                    _bump(-1)
+                    window.release()
+                except BaseException as exc:  # noqa: BLE001 — must not die silently
+                    fetch_started[0] = None
+                    _fail("drain", exc)
+                    break
+                if not _put(io_q, (i, block)):
+                    break
+            _put(io_q, _STOP)
+            # unblock a writer waiting on an empty queue even if the
+            # stop-aware put above bailed out
+            if stop.is_set():
+                try:
+                    io_q.put_nowait(_STOP)
+                except queue.Full:
+                    pass
+
+    def _writer() -> None:
+        with TRACER.inherit(stack):
+            while True:
+                item = io_q.get()
+                if item is _STOP or stop.is_set():
+                    break
+                i, block = item
+                try:
+                    write_started[0] = time.monotonic()
+                    with span("io_write", chunk=i, nbytes=int(block.nbytes)):
+                        write(i, block)
+                    write_started[0] = None
+                    with lock:
+                        stats["chunks"] += 1
+                except BaseException as exc:  # noqa: BLE001
+                    write_started[0] = None
+                    _fail("io_write", exc)
+                    break
+
+    reader = threading.Thread(target=_reader, name="sweep-drain", daemon=True)
+    writer = threading.Thread(target=_writer, name="sweep-io", daemon=True)
+    t_start = time.monotonic()
+    reader.start()
+    writer.start()
+
+    try:
+        for i in indices:
+            # take a window slot BEFORE dispatching: this is where the
+            # dispatcher blocks when the device is ``depth`` chunks
+            # ahead (drain_wait_s), and where a wedged drain surfaces
+            t_wait = time.monotonic()
+            while not window.acquire(timeout=0.1):
+                _check_deadline()
+                if stop.is_set():
+                    break
+            stats["drain_wait_s"] += time.monotonic() - t_wait
+            if stop.is_set():
+                break
+            try:
+                with span("dispatch", chunk=i):
+                    dev = dispatch(i)
+            except BaseException as exc:  # noqa: BLE001
+                _fail("dispatch", exc)
+                break
+            _bump(+1)
+            if not _put(drain_q, (i, dev)):
+                break
+    finally:
+        def _emergency_sentinels() -> None:
+            # a wedged reader never forwards the sentinel, so wake a
+            # writer blocked on an empty queue ourselves (a full queue
+            # means the writer has items — it re-checks stop per item),
+            # and unblock a reader parked on an empty drain_q
+            for q in (drain_q, io_q):
+                try:
+                    q.put_nowait(_STOP)
+                except queue.Full:
+                    pass
+
+        # orderly shutdown on success; on error the workers see stop
+        _put(drain_q, _STOP)
+        sentinels_sent = stop.is_set()
+        if sentinels_sent:
+            _emergency_sentinels()
+        # join with a heartbeat so a wedged fetch still hits the deadline
+        quiesce_deadline = None
+        while reader.is_alive() or writer.is_alive():
+            reader.join(timeout=0.2)
+            writer.join(timeout=0.2)
+            _check_deadline()
+            if stop.is_set() and not sentinels_sent:
+                # the deadline fired INSIDE this loop (late wedge, after
+                # all chunks were dispatched): wake the workers now or
+                # the idle writer would sit in io_q.get() for another
+                # full quiesce window before we could raise
+                sentinels_sent = True
+                _emergency_sentinels()
+            if stop.is_set() and errors:
+                # failure path: the reader may be wedged inside a dead
+                # fetch (daemon — abandoned), but the WRITER must
+                # quiesce before we raise: the caller may retry the
+                # sweep immediately, and a still-running writer would
+                # race the retry's checkpoint files. The writer always
+                # exits once its in-flight write returns; bound the
+                # wait only against a wedged write syscall.
+                if not writer.is_alive():
+                    break
+                if quiesce_deadline is None:
+                    quiesce_deadline = time.monotonic() + (
+                        drain_timeout_s if drain_timeout_s is not None
+                        else 900.0
+                    )
+                elif time.monotonic() > quiesce_deadline:
+                    break
+        gauge("sweep.inflight_chunks").set(0)
+
+    if errors:
+        _stage, exc = errors[0]
+        raise exc
+    stats["wall_s"] = time.monotonic() - t_start
+    stats["drain_wait_s"] = round(stats["drain_wait_s"], 6)
+    return stats

@@ -19,6 +19,15 @@ fingerprint (key, sizes, and a content hash of batch+recipe, so resuming
 with different physics raises instead of mixing results). When the sweep
 finishes, chunks consolidate into the single ``checkpoint_path`` npz and
 the per-chunk files are removed.
+
+Execution is pipelined by default (``pipeline_depth=2``): chunk ``i+1``
+is dispatched while chunk ``i``'s result drains to host on a reader
+thread and earlier chunks' files are written by a single writer thread
+(parallel.pipeline.run_pipelined), so the device never idles on the
+readback + disk latency. The pipeline changes scheduling only — keys,
+reductions, file contents, and the write ordering (chunk file before
+sidecar, in chunk order) are identical to the synchronous loop, which
+``pipeline_depth=1`` still runs verbatim for debugging.
 """
 from __future__ import annotations
 
@@ -26,6 +35,7 @@ import hashlib
 import json
 import os
 import tempfile
+import zipfile
 from typing import Callable, Optional
 
 import numpy as np
@@ -106,25 +116,158 @@ def _chunk_path(checkpoint_path: str, i: int) -> str:
     return f"{checkpoint_path}.chunk{i:06d}.npy"
 
 
+def _partial_path(checkpoint_path: str) -> str:
+    """The pipelined path's in-progress consolidated archive (renamed to
+    ``checkpoint_path`` on completion; see _IncrementalNpz)."""
+    return checkpoint_path + ".partial"
+
+
+def _npy_bytes(arr: np.ndarray):
+    """The exact ``np.save`` serialization of ``arr`` as an in-memory
+    buffer (identical bytes to a ``.npy`` file AND to an ``np.savez``
+    member, which is how the pipelined path serializes each block once
+    and feeds both the chunk file and the incremental npz)."""
+    import io
+
+    bio = io.BytesIO()
+    np.save(bio, arr, allow_pickle=False)
+    return bio.getbuffer()
+
+
+def _write_npy(path: str, arr: np.ndarray, buf=None) -> None:
+    """Chunk-file write, byte-identical on both paths.
+
+    The pipelined writer thread passes ``buf`` (a :func:`_npy_bytes`
+    serialization it reuses for the npz member): ``np.save(path, ...)``
+    takes numpy's ``tofile`` fast path, which holds the GIL for the
+    whole write and would serialize the I/O thread against the reader's
+    readback and the dispatcher, erasing the overlap (measured:
+    near-zero overlap via np.save vs full overlap via plain file
+    writes, whose ``fh.write`` releases the GIL around the syscall).
+    The synchronous depth-1 path passes no ``buf`` and keeps the direct
+    ``np.save`` — single-threaded, the GIL doesn't matter and the
+    in-memory serialization would just be an extra chunk-sized copy.
+    """
+    if buf is None:
+        np.save(path, arr, allow_pickle=False)
+    else:
+        with open(path, "wb") as fh:
+            fh.write(buf)
+
+
 def _cleanup_chunks(checkpoint_path: str, nchunks: int) -> None:
     for i in range(nchunks):
         try:
             os.remove(_chunk_path(checkpoint_path, i))
         except FileNotFoundError:
             pass
+    # reap a partial consolidated archive orphaned by a killed
+    # pipelined sweep (the rename into place never happened)
+    try:
+        os.remove(_partial_path(checkpoint_path))
+    except FileNotFoundError:
+        pass
 
 
-def _atomic_write(write_fn, final_path: str, suffix: str):
-    fd, tmp = tempfile.mkstemp(
-        suffix=suffix, dir=os.path.dirname(final_path) or "."
-    )
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _durable_replace(tmp: str, final_path: str, durable: bool) -> None:
+    """Rename ``tmp`` into place; ``durable`` fsyncs the file before the
+    rename and the directory after it. The ONE implementation of the
+    durability sequence, shared by _atomic_write and _IncrementalNpz so
+    the two checkpoint artifacts can never drift to different
+    guarantees."""
+    if durable:
+        _fsync_path(tmp)
+    os.replace(tmp, final_path)
+    if durable:
+        _fsync_path(os.path.dirname(final_path) or ".")
+
+
+def _atomic_write(write_fn, final_path: str, suffix: str,
+                  durable: bool = False):
+    """Write-to-temp + rename. ``durable`` additionally fsyncs the file
+    before the rename and the directory after it, so the completed chunk
+    survives power loss, not just process death (rename-only atomicity
+    can reorder against data blocks on some filesystems). Off by default:
+    the fsync is a real blocking disk wait per chunk, and process-crash
+    resume (the common preemption case) doesn't need it."""
+    dirname = os.path.dirname(final_path) or "."
+    fd, tmp = tempfile.mkstemp(suffix=suffix, dir=dirname)
     os.close(fd)
     try:
         write_fn(tmp)
-        os.replace(tmp, final_path)
+        _durable_replace(tmp, final_path, durable)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
+
+
+class _IncrementalNpz:
+    """Consolidated-npz builder that appends members one at a time.
+
+    The synchronous loop consolidates by rewriting every block into the
+    final npz after the last chunk — a serial O(total bytes) tail. The
+    pipelined path instead folds each block into the npz on the writer
+    thread the moment its chunk files land, so consolidation overlaps
+    device compute and the end-of-sweep cost collapses to close+rename.
+    Byte-identical to ``np.savez`` over the same blocks (ZIP_STORED
+    members ``chunk{j}.npy`` in order — tests/test_pipeline.py compares
+    the files), and crash-safe the same way: built in a temp file,
+    renamed into place only when complete.
+    """
+
+    def __init__(self, final_path: str, durable: bool = False):
+        self._final = final_path
+        self._durable = durable
+        # deterministic name, NOT mkstemp: a SIGKILLed sweep (the
+        # preemption case) orphans the partial archive at full size, and
+        # a random name could never be reaped — with a fixed name the
+        # next run truncates/overwrites it, bounding the leak to one
+        # file (which _partial_path lets finished sweeps remove too)
+        self._tmp = _partial_path(final_path)
+        self._zf = zipfile.ZipFile(
+            self._tmp, "w", zipfile.ZIP_STORED, allowZip64=True
+        )
+
+    def append(self, j: int, block, buf=None) -> None:
+        """Append ``chunk{j}``; ``buf`` (a :func:`_npy_bytes` result for
+        ``block``) skips re-serializing — an npz member's bytes ARE the
+        npy serialization, so the writer thread reuses one buffer for
+        both the chunk file and the member.
+
+        ``durable`` fsyncs the growing archive after each member: the
+        disk flush of the consolidated artifact then rides the overlap
+        window chunk by chunk instead of landing as one big serial
+        flush in :meth:`finish` (the synchronous path's shape)."""
+        with self._zf.open(f"chunk{j}.npy", "w", force_zip64=True) as fh:
+            if buf is not None:
+                fh.write(buf)
+            else:
+                np.lib.format.write_array(
+                    fh, np.asanyarray(block), allow_pickle=False
+                )
+        if self._durable:
+            self._zf.fp.flush()
+            os.fsync(self._zf.fp.fileno())
+
+    def finish(self) -> None:
+        self._zf.close()
+        _durable_replace(self._tmp, self._final, self._durable)
+
+    def abort(self) -> None:
+        try:
+            self._zf.close()
+        except Exception:
+            pass
+        if os.path.exists(self._tmp):
+            os.remove(self._tmp)
 
 
 def sweep(
@@ -138,6 +281,9 @@ def sweep(
     fit: bool = False,
     mesh=None,
     progress: Optional[Callable[[int, int], None]] = None,
+    pipeline_depth: int = 2,
+    drain_timeout_s: Optional[float] = 900.0,
+    durable: bool = False,
 ) -> np.ndarray:
     """Run ``nreal`` realizations in resumable chunks.
 
@@ -145,6 +291,21 @@ def sweep(
     the same arguments resumes after the last completed chunk; a finished
     sweep returns instantly from the consolidated checkpoint; mismatched
     arguments (including different batch/recipe contents) raise.
+
+    ``pipeline_depth`` bounds the chunks in flight (device results not
+    yet drained): the default 2 double-buffers — dispatch chunk ``i+1``
+    while chunk ``i`` drains on a reader thread and its files are
+    written by an I/O thread (parallel.pipeline). ``1`` runs the plain
+    synchronous loop (dispatch, fence, write — the debugging reference
+    the pipeline is validated against). Results and on-disk layout are
+    identical at every depth, so the depth is — like the mesh —
+    deliberately NOT part of the resume fingerprint: a sweep may resume
+    at a different depth. A drain stalled past ``drain_timeout_s``
+    (wedged tunnel) raises instead of hanging (None disables).
+    ``durable`` fsyncs every checkpoint write (file + directory) so
+    completed chunks survive power loss, not just process death — at
+    depth >= 2 the extra disk wait rides the I/O thread, overlapped with
+    device compute (benchmarks/sweep_overlap.py measures exactly this).
     """
     import jax
 
@@ -206,31 +367,31 @@ def sweep(
 
     from ..obs import counter, span
 
-    for i in range(done, nchunks):
+    def dispatch_chunk(i: int):
+        """Dispatch chunk ``i`` and its on-device reduction; returns the
+        UN-FETCHED device array (the pipeline's reader thread fences it
+        later — both engines return un-fetched jit outputs)."""
         k = jax.random.fold_in(key, i)
-        with span("sweep_chunk", chunk=i, nreal=chunk):
-            if mesh is not None:
-                res = sharded_realize(
-                    k, batch, recipe, nreal=chunk, mesh=mesh, fit=fit,
-                    static=static,
-                )
-            else:
-                res = realize(k, batch, recipe, nreal=chunk, fit=fit,
-                              static=static)
-            out = reduce_fn(res, batch) if reduce_fn is not None else res
-            # the host readback is the device-sync fence: this span is
-            # where queued device work (incl. collectives) actually drains
-            with span("readback_fence"):
-                block = np.asarray(out)
-            counter("sweep.realizations").inc(chunk)
-        blocks.append(block)
+        if mesh is not None:
+            res = sharded_realize(
+                k, batch, recipe, nreal=chunk, mesh=mesh, fit=fit,
+                static=static,
+            )
+        else:
+            res = realize(k, batch, recipe, nreal=chunk, fit=fit,
+                          static=static)
+        return reduce_fn(res, batch) if reduce_fn is not None else res
 
-        # chunk file first, sidecar last: a crash between the two only
-        # recomputes this chunk on resume
+    def write_chunk(i: int, block: np.ndarray, buf=None) -> None:
+        """Persist chunk ``i``: chunk file first, sidecar last — a crash
+        between the two only recomputes this chunk on resume. Runs on
+        the caller's thread at depth 1, on the single-writer I/O thread
+        otherwise (in chunk order either way)."""
         _atomic_write(
-            lambda p: np.save(p, block, allow_pickle=False),
+            lambda p: _write_npy(p, block, buf=buf),
             _chunk_path(checkpoint_path, i),
             ".npy",
+            durable=durable,
         )
         payload = json.dumps({**meta, "done": i + 1})
 
@@ -238,15 +399,100 @@ def sweep(
             with open(p, "w") as fh:
                 fh.write(payload)
 
-        _atomic_write(write_meta, meta_path, ".json")
+        _atomic_write(write_meta, meta_path, ".json", durable=durable)
+        counter("sweep.realizations").inc(chunk)
         if progress is not None:
             progress(i + 1, nchunks)
 
-    # consolidate into the single advertised npz, then drop chunk files
+    if pipeline_depth <= 1:
+        # the synchronous reference loop: dispatch, fence, write — the
+        # behavior every pipelined run must reproduce byte-for-byte
+        for i in range(done, nchunks):
+            with span("sweep_chunk", chunk=i, nreal=chunk):
+                out = dispatch_chunk(i)
+                # the host readback is the device-sync fence: this span
+                # is where queued device work (incl. collectives) drains
+                with span("readback_fence"):
+                    block = np.asarray(out)
+            write_chunk(i, block)
+            blocks.append(block)
+    elif done < nchunks:
+        from ..parallel.pipeline import run_pipelined
+
+        # consolidation and result assembly ride the writer thread too:
+        # each block is appended to the final npz and copied into the
+        # preallocated result the moment its chunk files land, so the
+        # end-of-sweep rewrite + concatenate passes vanish from the
+        # critical path (npz bytes identical to the np.savez below)
+        inc = _IncrementalNpz(checkpoint_path, durable=durable)
+        preloaded = list(blocks)  # resume: completed chunks from disk
+        result = [None]  # allocated on first block (shape known then)
+        # a reduce_fn need not keep the realization axis (e.g. a
+        # per-chunk keepdims summary): only blocks with a `chunk`-sized
+        # leading axis take the preallocated fast path; anything else
+        # falls back to the synchronous path's list+concatenate so the
+        # result is identical at every depth. None = undecided.
+        prealloc = [None]
+
+        def place(i: int, block: np.ndarray) -> None:
+            if prealloc[0] is None:
+                prealloc[0] = block.shape[0] == chunk
+                if prealloc[0]:
+                    result[0] = np.empty(
+                        (nreal,) + block.shape[1:], block.dtype
+                    )
+                    for j, b in enumerate(preloaded):
+                        result[0][j * chunk:(j + 1) * chunk] = b
+            if prealloc[0]:
+                result[0][i * chunk:(i + 1) * chunk] = block
+            else:
+                blocks.append(block)  # single writer: in chunk order
+
+        # resume catch-up runs on the WRITER thread (first callback),
+        # not here: re-appending hundreds of completed chunks into the
+        # partial npz is exactly the serial I/O the executor hides, so
+        # it overlaps the first new dispatches. Member order holds —
+        # the single writer runs callbacks in chunk order.
+        catchup_done = [False]
+
+        def write_and_consolidate(i: int, block: np.ndarray) -> None:
+            if not catchup_done[0]:
+                catchup_done[0] = True
+                for j, b in enumerate(preloaded):
+                    inc.append(j, b)
+            buf = _npy_bytes(block)  # one serialize feeds both sinks
+            write_chunk(i, block, buf=buf)
+            inc.append(i, block, buf=buf)
+            place(i, block)
+
+        try:
+            with span("sweep_pipeline", depth=pipeline_depth,
+                      chunks=nchunks - done) as sp:
+                stats = run_pipelined(
+                    range(done, nchunks),
+                    dispatch_chunk,
+                    write_and_consolidate,
+                    depth=pipeline_depth,
+                    drain_timeout_s=drain_timeout_s,
+                )
+                sp.update(stats)
+        except BaseException:
+            inc.abort()  # chunk files + sidecar carry the resume state
+            raise
+        inc.finish()
+        _cleanup_chunks(checkpoint_path, nchunks)
+        if prealloc[0]:
+            return result[0]
+        return np.concatenate(blocks, axis=0)
+
+    # consolidate into the single advertised npz
     _atomic_write(
-        lambda p: np.savez(p, **{f"chunk{j}": b for j, b in enumerate(blocks)}),
+        lambda p: np.savez(
+            p, **{f"chunk{j}": b for j, b in enumerate(blocks)}
+        ),
         checkpoint_path,
         ".npz",
+        durable=durable,
     )
     _cleanup_chunks(checkpoint_path, nchunks)
     return np.concatenate(blocks, axis=0)

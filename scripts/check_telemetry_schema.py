@@ -49,6 +49,13 @@ INSTRUMENTED_ENTRYPOINTS = [
     ("pta_replicator_tpu/parallel/mesh.py", 'span("shardmap_realize"'),
     ("pta_replicator_tpu/parallel/mesh.py", 'name="mesh.constraint_engine"'),
     ("pta_replicator_tpu/utils/sweep.py", 'span("sweep_chunk"'),
+    ("pta_replicator_tpu/utils/sweep.py", 'span("readback_fence"'),
+    ("pta_replicator_tpu/utils/sweep.py", 'span("sweep_pipeline"'),
+    ("pta_replicator_tpu/parallel/pipeline.py", 'span("dispatch"'),
+    ("pta_replicator_tpu/parallel/pipeline.py", 'span("drain"'),
+    ("pta_replicator_tpu/parallel/pipeline.py", 'span("io_write"'),
+    ("pta_replicator_tpu/parallel/pipeline.py",
+     'gauge("sweep.inflight_chunks")'),
     ("pta_replicator_tpu/__main__.py", 'span("compute"'),
     ("pta_replicator_tpu/__main__.py", 'span("ingest"'),
     ("bench.py", 'obs.span("measure"'),

@@ -376,7 +376,9 @@ def test_schema_checker_passes_on_capture(tmp_path, fabricated_partim,
 
 def test_sweep_and_sharded_paths_record_spans(tmp_path, fabricated_partim,
                                               capsys):
-    """The mesh + sweep engines leave their spans and transfer counters."""
+    """The mesh + sweep engines leave their spans and transfer counters —
+    the pipelined executor's dispatch/drain/io_write set by default, the
+    synchronous sweep_chunk/readback_fence set at --pipeline-depth 1."""
     from pta_replicator_tpu.__main__ import main
 
     pardir, timdir = fabricated_partim
@@ -392,13 +394,35 @@ def test_sweep_and_sharded_paths_record_spans(tmp_path, fabricated_partim,
     from pta_replicator_tpu.obs.report import aggregate_spans, load_telemetry
 
     agg = aggregate_spans(load_telemetry(str(tdir))["events"])
-    chunk_paths = [p for p in agg if p.endswith("sweep_chunk")]
-    assert chunk_paths and agg[chunk_paths[0]]["calls"] == 2
+    pipe_paths = [p for p in agg if p.endswith("sweep_pipeline")]
+    assert pipe_paths and agg[pipe_paths[0]]["calls"] == 1
+    for leaf in ("drain", "io_write"):
+        paths = [p for p in agg if p.endswith(leaf)]
+        assert paths, f"missing {leaf} spans"
+        assert sum(agg[p]["calls"] for p in paths) == 2
+        # worker threads inherit the sweep ancestry: the spans nest
+        # under the pipeline phase, not at the root
+        assert all("sweep_pipeline" in p for p in paths)
     assert any("sharded_realize" in p for p in agg)
-    assert any(p.endswith("readback_fence") for p in agg)
     metrics = load_telemetry(str(tdir))["metrics"]
     assert metrics["jax.transfer.h2d_bytes"][0]["value"] > 0
     assert metrics["sweep.realizations"][0]["value"] == 16
+
+    # depth 1: the synchronous loop's spans, unchanged from PR 1
+    tdir1 = tmp_path / "telemetry_d1"
+    main(["realize", "--pardir", pardir, "--timdir", timdir,
+          "--recipe", str(recipe), "--nreal", "16", "--sharded",
+          "--chunk", "8", "--pipeline-depth", "1",
+          "--checkpoint", str(tmp_path / "ck1.npz"),
+          "--out", str(tmp_path / "res1.npz"), "--telemetry", str(tdir1)])
+    capsys.readouterr()
+    agg1 = aggregate_spans(load_telemetry(str(tdir1))["events"])
+    chunk_paths = [p for p in agg1 if p.endswith("sweep_chunk")]
+    assert chunk_paths and agg1[chunk_paths[0]]["calls"] == 2
+    assert any(p.endswith("readback_fence") for p in agg1)
+    # identical physics: the two checkpoints must agree byte-for-byte
+    assert (tmp_path / "ck.npz").read_bytes() == (
+        tmp_path / "ck1.npz").read_bytes()
 
 
 # ------------------------------------------------------- bench summary

@@ -1,0 +1,358 @@
+"""Pipelined sweep executor: byte-identical checkpoints at every depth,
+crash-injection resume, bounded in-flight window, drain deadline, and
+the sweep edge cases the executor must preserve (resume across a mesh
+change, reduce_fn=None full cubes, _fn_id stability)."""
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import importlib
+
+# the package attribute `utils.sweep` (the function) shadows the
+# submodule on plain attribute import; resolve the MODULE explicitly
+sweep_mod = importlib.import_module("pta_replicator_tpu.utils.sweep")
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.parallel.pipeline import DrainTimeout, run_pipelined
+from pta_replicator_tpu.utils.sweep import _fn_id, sweep
+
+
+@pytest.fixture()
+def small_sweep():
+    b = synthetic_batch(npsr=3, ntoa=64, seed=2)
+    recipe = Recipe(
+        efac=jnp.ones(3),
+        rn_log10_amplitude=jnp.full(3, -14.0),
+        rn_gamma=jnp.full(3, 4.0),
+    )
+    return b, recipe, jax.random.PRNGKey(5)
+
+
+# ------------------------------------------------------------- executor
+
+def test_run_pipelined_orders_and_bounds():
+    """Writes happen strictly in index order; the in-flight window never
+    exceeds depth; stats account every chunk."""
+    written = []
+    inflight = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def dispatch(i):
+        with lock:
+            inflight[0] += 1
+            peak[0] = max(peak[0], inflight[0])
+        return i
+
+    def fetch(v):
+        time.sleep(0.01)  # make the dispatcher run ahead
+        with lock:
+            inflight[0] -= 1
+        return np.asarray([v])
+
+    stats = run_pipelined(
+        range(12), dispatch, lambda i, b: written.append(i),
+        depth=3, fetch=fetch, drain_timeout_s=30.0,
+    )
+    assert written == list(range(12))
+    assert stats["chunks"] == 12
+    assert peak[0] <= 3
+    assert stats["max_inflight"] <= 3
+
+
+def test_run_pipelined_depth1_rejected():
+    with pytest.raises(ValueError, match="depth"):
+        run_pipelined(range(2), lambda i: i, lambda i, b: None, depth=1)
+
+
+def test_run_pipelined_propagates_stage_exceptions_unchanged():
+    class Boom(Exception):
+        pass
+
+    def bad_write(i, block):
+        if i == 2:
+            raise Boom("write failed")
+
+    with pytest.raises(Boom):
+        run_pipelined(
+            range(6), lambda i: i, bad_write,
+            depth=2, fetch=lambda v: np.asarray([v]),
+        )
+
+    def bad_dispatch(i):
+        if i == 1:
+            raise Boom("dispatch failed")
+        return i
+
+    with pytest.raises(Boom):
+        run_pipelined(
+            range(6), bad_dispatch, lambda i, b: None,
+            depth=2, fetch=lambda v: np.asarray([v]),
+        )
+
+
+def test_run_pipelined_drain_timeout():
+    """A wedged fetch (hung tunnel) raises DrainTimeout fast instead of
+    blocking the sweep forever."""
+    hang = threading.Event()
+
+    def fetch(v):
+        hang.wait(20.0)  # never set: simulated wedge
+        return np.asarray([v])
+
+    t0 = time.monotonic()
+    with pytest.raises(DrainTimeout):
+        run_pipelined(
+            range(4), lambda i: i, lambda i, b: None,
+            depth=2, fetch=fetch, drain_timeout_s=0.4,
+        )
+    assert time.monotonic() - t0 < 10.0
+    hang.set()  # release the daemon thread
+
+
+def test_run_pipelined_write_timeout():
+    """A wedged checkpoint WRITE (hung filesystem) trips the same
+    deadline as a wedged readback — the io_q back-pressure must not
+    turn a dead mount into an unbounded hang."""
+    hang = threading.Event()
+
+    def write(i, block):
+        hang.wait(20.0)  # never set: simulated dead mount
+
+    t0 = time.monotonic()
+    with pytest.raises(DrainTimeout):
+        run_pipelined(
+            range(6), lambda i: i, write,
+            depth=2, fetch=lambda v: np.asarray([v]),
+            drain_timeout_s=0.4,
+        )
+    assert time.monotonic() - t0 < 10.0
+    hang.set()
+
+
+# ------------------------------------------------- sweep byte-identity
+
+def test_pipelined_sweep_checkpoints_byte_identical(tmp_path, small_sweep):
+    """Depth 2 and depth 4 sweeps produce consolidated checkpoints (and
+    meta sidecars) byte-for-byte equal to the synchronous depth-1 loop."""
+    b, recipe, key = small_sweep
+    paths = {}
+    results = {}
+    for depth in (1, 2, 4):
+        ck = str(tmp_path / f"d{depth}.npz")
+        results[depth] = sweep(
+            key, b, recipe, nreal=32, chunk=4, checkpoint_path=ck,
+            pipeline_depth=depth,
+        )
+        paths[depth] = ck
+    ref_npz = open(paths[1], "rb").read()
+    ref_meta = open(paths[1] + ".meta.json", "rb").read()
+    for depth in (2, 4):
+        assert open(paths[depth], "rb").read() == ref_npz
+        assert open(paths[depth] + ".meta.json", "rb").read() == ref_meta
+        np.testing.assert_array_equal(results[depth], results[1])
+        # chunk files consolidated away at every depth
+        assert glob.glob(paths[depth] + ".chunk*") == []
+
+
+def test_pipelined_sweep_durable_writes_identical(tmp_path, small_sweep):
+    """durable=True (fsync-backed writes) changes durability only, never
+    file contents."""
+    b, recipe, key = small_sweep
+    ck1 = str(tmp_path / "plain.npz")
+    ck2 = str(tmp_path / "durable.npz")
+    sweep(key, b, recipe, nreal=8, chunk=4, checkpoint_path=ck1)
+    sweep(key, b, recipe, nreal=8, chunk=4, checkpoint_path=ck2,
+          durable=True)
+    assert open(ck1, "rb").read() == open(ck2, "rb").read()
+
+
+# ---------------------------------------------------- crash injection
+
+class _KillSim(BaseException):
+    """Out-of-band 'process died here' marker (BaseException so no
+    library except-Exception handler can swallow it — like SIGKILL)."""
+
+
+def _bomb_atomic_write(monkeypatch, nth_sidecar: int):
+    """Kill the sweep between chunk-file write and sidecar write number
+    ``nth_sidecar`` (1-based) — the exact window the crash-safety
+    ordering exists for."""
+    orig = sweep_mod._atomic_write
+    seen = {"json": 0}
+
+    def bombed(write_fn, final_path, suffix, durable=False):
+        if suffix == ".json":
+            seen["json"] += 1
+            if seen["json"] == nth_sidecar:
+                raise _KillSim()
+        return orig(write_fn, final_path, suffix, durable=durable)
+
+    monkeypatch.setattr(sweep_mod, "_atomic_write", bombed)
+
+
+def test_crash_between_chunk_and_sidecar_resumes(
+    tmp_path, small_sweep, monkeypatch
+):
+    """Kill after chunk 2's file landed but before its sidecar: resume
+    must recompute ONLY chunks 2..end and still match the uninterrupted
+    run byte-for-byte."""
+    b, recipe, key = small_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ref_ck)
+
+    ck = str(tmp_path / "crash.npz")
+    _bomb_atomic_write(monkeypatch, nth_sidecar=3)  # chunk index 2's sidecar
+    with pytest.raises(_KillSim):
+        sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck,
+              pipeline_depth=2)
+    monkeypatch.undo()
+
+    # the crash window: chunk 2's file exists, its sidecar says done=2
+    assert os.path.exists(ck + ".chunk000002.npy")
+    calls = []
+    out = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck,
+                pipeline_depth=2, progress=lambda d, t: calls.append(d))
+    assert calls == [3, 4]  # chunks 0,1 NOT recomputed
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+def test_crash_with_chunks_in_flight_resumes(
+    tmp_path, small_sweep, monkeypatch
+):
+    """Kill at the FIRST sidecar of a depth-4 sweep — several chunks are
+    dispatched/drained but unrecorded. Resume recomputes every chunk
+    whose sidecar never landed and matches the reference bitwise."""
+    b, recipe, key = small_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=32, chunk=4, checkpoint_path=ref_ck)
+
+    ck = str(tmp_path / "crash.npz")
+    _bomb_atomic_write(monkeypatch, nth_sidecar=2)
+    with pytest.raises(_KillSim):
+        sweep(key, b, recipe, nreal=32, chunk=4, checkpoint_path=ck,
+              pipeline_depth=4)
+    monkeypatch.undo()
+
+    calls = []
+    out = sweep(key, b, recipe, nreal=32, chunk=4, checkpoint_path=ck,
+                pipeline_depth=4, progress=lambda d, t: calls.append(d))
+    assert calls == list(range(2, 9))  # chunk 0 survived; 1..7 recomputed
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+# ------------------------------------------------- sweep edge cases
+
+def test_stale_partial_archive_reaped(tmp_path, small_sweep):
+    """A SIGKILLed pipelined sweep orphans `<ckpt>.partial` (the rename
+    into place never ran); the next sweep over the same checkpoint must
+    reuse/remove it rather than leak full-size archives per kill."""
+    b, recipe, key = small_sweep
+    ck = str(tmp_path / "s.npz")
+    open(ck + ".partial", "wb").write(b"stale-partial-from-a-killed-run")
+    out = sweep(key, b, recipe, nreal=8, chunk=4, checkpoint_path=ck,
+                pipeline_depth=2)
+    assert out.shape == (8, 3)
+    assert not os.path.exists(ck + ".partial")
+    # the finished checkpoint is intact (not the stale bytes)
+    with np.load(ck) as z:
+        assert set(z.files) == {"chunk0", "chunk1"}
+
+
+def test_sweep_resume_after_mesh_change(tmp_path, small_sweep):
+    """A sweep started without a mesh resumes on a 2-device mesh (the
+    preemption case: a new slice rarely matches the old topology). The
+    fingerprint deliberately excludes the mesh, and on a collective-free
+    recipe the cross-topology resume stays bit-identical."""
+    from pta_replicator_tpu.parallel import make_mesh
+
+    b, recipe, key = small_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ref_ck)
+
+    ck = str(tmp_path / "mesh.npz")
+
+    class Stop(Exception):
+        pass
+
+    def bomb(done, total):
+        if done == 2:
+            raise Stop
+
+    with pytest.raises(Stop):
+        sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck,
+              progress=bomb)
+    mesh = make_mesh(2, 1)
+    calls = []
+    out = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck,
+                mesh=mesh, progress=lambda d, t: calls.append(d))
+    assert calls == [3, 4]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_sweep_reduce_none_full_cube(tmp_path, small_sweep):
+    """reduce_fn=None keeps full (chunk, Np, Nt) residual cubes; the
+    pipelined path must preserve the layout, order, and resume."""
+    b, recipe, key = small_sweep
+    ck1 = str(tmp_path / "cube1.npz")
+    ck2 = str(tmp_path / "cube2.npz")
+    full = sweep(key, b, recipe, nreal=8, chunk=4, checkpoint_path=ck1,
+                 reduce_fn=None, pipeline_depth=1)
+    piped = sweep(key, b, recipe, nreal=8, chunk=4, checkpoint_path=ck2,
+                  reduce_fn=None, pipeline_depth=2)
+    assert full.shape == (8, 3, 64)
+    np.testing.assert_array_equal(piped, full)
+    assert open(ck1, "rb").read() == open(ck2, "rb").read()
+    with np.load(ck1) as z:
+        assert set(z.files) == {"chunk0", "chunk1"}
+        assert z["chunk0"].shape == (4, 3, 64)
+
+
+def test_sweep_chunk_summary_reduce_matches_sync(tmp_path, small_sweep):
+    """A reduce_fn that collapses the realization axis (per-chunk
+    keepdims summary) must produce the same result at every depth: the
+    pipelined path falls back to list+concatenate instead of broadcast-
+    assigning into a (nreal, ...) preallocation."""
+    import jax.numpy as jnp
+
+    b, recipe, key = small_sweep
+
+    def summarize(res, batch):
+        return jnp.mean(res, axis=0, keepdims=True)  # (1, Np, Nt) / chunk
+
+    ck1 = str(tmp_path / "sum1.npz")
+    ck2 = str(tmp_path / "sum2.npz")
+    sync = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck1,
+                 reduce_fn=summarize, pipeline_depth=1)
+    piped = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck2,
+                  reduce_fn=summarize, pipeline_depth=2)
+    assert sync.shape == (4, 3, 64)  # one row per CHUNK, not per real
+    np.testing.assert_array_equal(piped, sync)
+    assert open(ck1, "rb").read() == open(ck2, "rb").read()
+
+
+def test_fn_id_stable_for_device_array_closures():
+    """_fn_id hashes closure-captured device arrays by VALUE: equal
+    arrays -> equal ids (across separately constructed closures and
+    repeated calls), different values -> different ids. Guards the
+    resume fingerprint against id()/repr() instability across process
+    restarts."""
+    w1 = jnp.asarray([1.0, 2.0, 3.0])
+    w2 = jnp.asarray([1.0, 2.0, 3.0])
+    w3 = jnp.asarray([1.0, 2.0, 4.0])
+
+    mk = lambda w: (lambda res, batch: res * w)  # noqa: E731
+    a, b, c = mk(w1), mk(w2), mk(w3)
+    assert _fn_id(a) == _fn_id(a)  # stable across calls
+    assert _fn_id(a) == _fn_id(b)  # value-equal captures
+    assert _fn_id(a) != _fn_id(c)  # different captured values
+    assert _fn_id(None) is None
