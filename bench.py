@@ -44,6 +44,40 @@ _METRIC = (
 )
 _NORTH_STAR_RATE = 1000.0 / 60.0  # v5e-8 whole-slice target
 
+#: bench-JSON schema version, consumed by the bench-diff regression gate
+#: (pta_replicator_tpu.obs.regress). Bump when a metric's NAME keeps its
+#: spelling but changes meaning/units — bench-diff refuses files stamped
+#: newer than it knows rather than mis-aligning them. v2 = the first
+#: stamped version (adds schema_version / git_rev / platform).
+BENCH_SCHEMA_VERSION = 2
+
+
+def _provenance() -> dict:
+    """Self-describing stamp on every bench JSON (success AND failure):
+    schema version, git revision, and the host/runtime platform — what
+    bench-diff needs to refuse or annotate cross-round comparisons."""
+    import platform as _plat
+
+    prov = {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "platform": {
+            "python": _plat.python_version(),
+            "os": _plat.platform(),
+            "machine": _plat.machine(),
+        },
+    }
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            prov["git_rev"] = r.stdout.strip()
+    except Exception:
+        pass  # provenance is best-effort, never a bench failure
+    return prov
+
 _PROBE_SRC = (
     "import os, numpy as np, jax, jax.numpy as jnp;"
     "p = os.environ.get('BENCH_PLATFORM');"
@@ -64,6 +98,7 @@ def _fail(error: str):
         "unit": "realizations/s",
         "vs_baseline": 0.0,
         "error": error,
+        **_provenance(),
     }
     here = os.path.dirname(os.path.abspath(__file__))
     backups = sorted(
@@ -310,10 +345,19 @@ def _bench():
 
     # structured telemetry: jax compile accounting + per-section spans,
     # embedded into the bench JSON as the "telemetry" block so future
-    # rounds carry per-stage evidence (obs.telemetry_summary below)
+    # rounds carry per-stage evidence (obs.telemetry_summary below).
+    # BENCH_TELEMETRY=DIR upgrades this to a full capture with a flight
+    # recorder: `python -m pta_replicator_tpu watch DIR` then shows the
+    # bench's live heartbeat (which section it is in, compile counters),
+    # and a killed/timed-out bench leaves DIR/postmortem.json naming the
+    # section it died in — benchmarks/recovery_watch.sh uses exactly this.
     from pta_replicator_tpu import obs
 
-    obs.install_jax_hooks()
+    bench_telemetry = os.environ.get("BENCH_TELEMETRY")
+    if bench_telemetry:
+        obs.start_capture(bench_telemetry)
+    else:
+        obs.install_jax_hooks()
 
     prng = os.environ.get("BENCH_PRNG", "threefry")
     if prng not in ("threefry", "rbg"):
@@ -598,15 +642,30 @@ def _bench():
                 "value": round(rate, 3),
                 "unit": "realizations/s",
                 "vs_baseline": round(rate / _NORTH_STAR_RATE, 3),
+                **_provenance(),
                 **extra,
             }
         )
     )
+    if bench_telemetry:
+        obs.finish_capture(context={"bench": True, "chunk": chunk})
 
 
 def main():
     if os.environ.get("BENCH_CHILD") == "1":
-        _bench()
+        try:
+            _bench()
+        except BaseException:
+            # SystemExit (env-validation raises) never reaches
+            # sys.excepthook, and on other failures the excepthook only
+            # writes the postmortem: finish_capture inside the except
+            # flushes postmortem AND metrics/meta, so a BENCH_TELEMETRY
+            # dir never reads as a SIGKILLed run after a config typo.
+            # No-op when BENCH_TELEMETRY is unset (no capture started).
+            from pta_replicator_tpu import obs
+
+            obs.finish_capture()
+            raise
         return
 
     tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))

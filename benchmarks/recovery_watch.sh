@@ -1,21 +1,116 @@
 #!/bin/bash
+# Tunnel-recovery watcher, consolidated from the per-round
+# recovery_watch_r0*.sh copies: probe the TPU tunnel on a fixed cadence;
+# on recovery run the hardware-evidence battery in priority order,
+# writing self-timestamped JSONs into the repo root (a mid-battery
+# tunnel drop still leaves the highest-priority artifacts).
+#
+# Observability (the reason the per-round copies could be retired): the
+# bench runs under BENCH_TELEMETRY, so its flight recorder heartbeats
+# into $TDIR/progress.json — a background
+#   python -m pta_replicator_tpu watch $TDIR
+# tails that into the log (one line per heartbeat change: current
+# section, compile counters, stall warnings), and a killed/wedged bench
+# leaves $TDIR/postmortem.json (`python -m pta_replicator_tpu
+# postmortem $TDIR`). After the bench step, the bench-trajectory gate
+# diffs the fresh preview against the last promoted BENCH_r*.json.
+#
+# Usage: recovery_watch.sh [ROUND] [TRIES] [SLEEP_S]
+#   ROUND    artifact-name label              (default: r06)
+#   TRIES    probe attempts before giving up  (default: 230)
+#   SLEEP_S  seconds between probes           (default: 180)
+# Env:
+#   RW_STEPS  space-separated subset/order of:
+#             bench gls validate ablation vpu cw6 sweep cw7
+#             (default: all, in that order)
+set -u
+ROUND=${1:-r06}
+TRIES=${2:-230}
+SLEEP_S=${3:-180}
+STEPS=${RW_STEPS:-"bench gls validate ablation vpu cw6 sweep cw7"}
+LOG=/tmp/recovery_log_${ROUND}.txt
+TDIR=/tmp/recovery_telemetry_${ROUND}
+
 cd /root/repo
-for i in $(seq 1 200); do
+log() { date -u +"%H:%M:%SZ $*" >> "$LOG"; }
+
+WATCH_PID=
+start_watch() {
+  # supervised heartbeat tail, armed only while the (captured) bench
+  # step runs: `watch` exits whenever a run finishes or leaves a
+  # postmortem — the bench driver's OOM retry ladder does both — so a
+  # restart loop keeps tailing across retries; each retry's
+  # start_capture clears the stale artifacts the previous child left
+  ( while :; do
+      python -m pta_replicator_tpu watch "$TDIR" --interval 30 \
+        >> "$LOG" 2>/dev/null
+      sleep 10
+    done ) &
+  WATCH_PID=$!
+}
+stop_watch() {
+  if [ -n "$WATCH_PID" ]; then
+    pkill -P "$WATCH_PID" 2>/dev/null
+    kill "$WATCH_PID" 2>/dev/null
+    WATCH_PID=
+  fi
+}
+
+run_step() {  # run_step <step-name>
+  case "$1" in
+    bench)    t=1600; out=BENCH_PREVIEW_${ROUND}.json
+              cmd=(env BENCH_TELEMETRY="$TDIR" python bench.py) ;;
+    gls)      t=1600; out=BENCH_GLS_${ROUND}.json
+              cmd=(env BENCH_FIT=gls python bench.py) ;;
+    validate) t=900;  out=VALIDATE_DEVICE_${ROUND}.json
+              cmd=(python benchmarks/validate_device.py 2000) ;;
+    ablation) t=900;  out=ABLATION_${ROUND}.json
+              cmd=(python benchmarks/fused_ablation.py 800 5) ;;
+    vpu)      t=600;  out=VPU_CEILING_${ROUND}.json
+              cmd=(python benchmarks/vpu_ceiling.py) ;;
+    cw6)      t=2400; out=CW_SCALING_${ROUND}.json
+              cmd=(python benchmarks/cw_scaling.py 6 both) ;;
+    sweep)    t=3000; out=SWEEP_RESUME_${ROUND}.json
+              cmd=(python benchmarks/sweep_kill_resume.py 1000000 800) ;;
+    cw7)      t=3000; out=CW_SCALING_1E7_${ROUND}.json
+              cmd=(python benchmarks/cw_scaling.py 7 both) ;;
+    *)        log "unknown step '$1' skipped"; return ;;
+  esac
+  [ "$1" = bench ] && start_watch
+  timeout "$t" "${cmd[@]}" > "/root/repo/$out" 2>"/tmp/${1}_${ROUND}.err"
+  step_rc=$?
+  [ "$1" = bench ] && stop_watch
+  log "$1 done rc=$step_rc -> $out"
+  if [ "$1" = bench ]; then
+    # bench-trajectory gate: fresh preview vs the last promoted round
+    # (BENCH_r*.json, not r0*: the glob must keep matching past r09)
+    last=$(ls /root/repo/BENCH_r[0-9]*.json 2>/dev/null | tail -1)
+    if [ -n "$last" ]; then
+      python -m pta_replicator_tpu bench-diff "$last" \
+        "/root/repo/$out" --threshold 0.10 >> "$LOG" 2>&1
+      diff_rc=$?  # captured before any substitution can clobber $?
+      log "bench-diff vs $(basename "$last") rc=$diff_rc"
+    fi
+  fi
+}
+
+for i in $(seq 1 "$TRIES"); do
   if timeout 90 python -c "
 import jax, jax.numpy as jnp, numpy as np
 float(np.asarray(jnp.ones((128,128)) @ jnp.ones((128,128))).sum())
 " >/dev/null 2>&1; then
-    date -u +"%H:%M:%SZ tunnel up, starting battery" >> /tmp/recovery_log.txt
-    timeout 1600 python bench.py > /root/repo/BENCH_RECOVERY_r03.json 2>/tmp/bench_recovery.err
-    date -u +"%H:%M:%SZ bench done rc=$?" >> /tmp/recovery_log.txt
-    timeout 900 python benchmarks/validate_device.py 2000 > /root/repo/VALIDATE_DEVICE_r03.json 2>/tmp/validate_recovery.err
-    date -u +"%H:%M:%SZ validate done rc=$?" >> /tmp/recovery_log.txt
-    timeout 900 python benchmarks/fused_ablation.py 800 5 > /root/repo/ABLATION_r03.json 2>/tmp/ablation_recovery.err
-    date -u +"%H:%M:%SZ ablation done rc=$?" >> /tmp/recovery_log.txt
-    timeout 1200 python benchmarks/cw_scaling.py 5 both > /root/repo/CW_SCALING_r03.json 2>/tmp/cwscale_recovery.err
-    date -u +"%H:%M:%SZ cw_scaling done rc=$?" >> /tmp/recovery_log.txt
+    log "tunnel up, starting $ROUND battery (steps: $STEPS)"
+    mkdir -p "$TDIR"
+    # a previous same-ROUND run's final heartbeat/postmortem would make
+    # the watcher exit before the new bench even starts capturing
+    rm -f "$TDIR/progress.json" "$TDIR/postmortem.json"
+    for step in $STEPS; do
+      run_step "$step"
+    done
+    stop_watch
+    log "battery complete"
     exit 0
   fi
-  sleep 180
+  sleep "$SLEEP_S"
 done
-date -u +"%H:%M:%SZ gave up waiting" >> /tmp/recovery_log.txt
+log "gave up waiting"

@@ -96,6 +96,30 @@ def main(argv=None):
                    help="machine-readable aggregate instead of the tree")
     p.add_argument("--min-ms", type=float, default=0.0,
                    help="hide span paths with total wall below this")
+    p = sub.add_parser(
+        "watch", help="tail a live run's progress.json heartbeat (one "
+                      "line per tick; exits when the run finishes or "
+                      "leaves a postmortem)")
+    p.add_argument("dir", help="the run's --telemetry directory")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="poll period in seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print the current heartbeat and exit (for "
+                        "scripts/cron: exit 3 when there is none)")
+    p = sub.add_parser(
+        "postmortem", help="render the black box a killed/crashed run "
+                           "left in its telemetry directory")
+    p.add_argument("dir", help="the run's --telemetry directory")
+    p = sub.add_parser(
+        "bench-diff", help="diff bench.py JSONs (oldest first): delta "
+                           "table with pass/warn/fail verdicts; exits "
+                           "nonzero on a regression past --threshold")
+    p.add_argument("files", nargs="+", metavar="BENCH_JSON",
+                   help="two or more bench JSONs (raw bench.py output "
+                        "or the wrapped BENCH_r*.json series)")
+    p.add_argument("--threshold", type=float, default=0.10,
+                   help="relative regression gate (default 0.10 = 10%%; "
+                        "half of it is the warn band)")
     p = sub.choices["realize"]
     p.add_argument("--recipe", required=True, help="JSON recipe file")
     p.add_argument("--nreal", type=int, default=100)
@@ -150,6 +174,38 @@ def main(argv=None):
         from .obs.report import print_report
 
         print_report(args.dir, min_ms=args.min_ms, as_json=args.json)
+        return
+    if args.cmd == "watch":
+        from .obs.report import watch_progress
+
+        rc = watch_progress(args.dir, interval=args.interval,
+                            once=args.once)
+        if rc:
+            raise SystemExit(rc)
+        return
+    if args.cmd == "postmortem":
+        from .obs.report import print_postmortem
+
+        print_postmortem(args.dir)
+        return
+    if args.cmd == "bench-diff":
+        if len(args.files) < 2:
+            print("bench-diff needs at least two files", file=sys.stderr)
+            raise SystemExit(2)  # usage error, not "regressed" (rc 1)
+        from .obs.regress import SchemaMismatch, bench_diff
+
+        try:
+            table, _summary, rc = bench_diff(
+                args.files, threshold=args.threshold
+            )
+        except SchemaMismatch as exc:
+            # exit 2 (unusable inputs), NOT 1: rc 1 is reserved for "a
+            # metric regressed" and CI keys on that distinction
+            print(f"bench-diff: {exc}", file=sys.stderr)
+            raise SystemExit(2)
+        print(table)
+        if rc:
+            raise SystemExit(rc)
         return
 
     if args.platform:

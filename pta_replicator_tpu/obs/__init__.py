@@ -26,7 +26,8 @@ from __future__ import annotations
 import sys
 import time
 
-from . import jaxhooks, metrics, report, trace
+from . import flightrec, jaxhooks, metrics, regress, report, trace
+from .flightrec import FlightRecorder, StallWarning
 from .jaxhooks import (
     RetraceWarning,
     device_memory_snapshot,
@@ -46,11 +47,18 @@ __all__ = [
     "install_jax_hooks", "device_memory_snapshot", "record_transfer",
     "trace_count", "tree_nbytes", "start_capture", "finish_capture",
     "telemetry_summary", "reset_all", "metrics", "trace", "report",
-    "jaxhooks",
+    "jaxhooks", "flightrec", "regress", "FlightRecorder", "StallWarning",
 ]
 
 
-def start_capture(directory: str) -> None:
+def start_capture(
+    directory: str,
+    *,
+    flight_recorder: bool = True,
+    heartbeat_interval_s: float = 1.0,
+    stall_timeout_s: float = 300.0,
+    crash_hooks: bool = True,
+) -> None:
     """Begin streaming telemetry to ``directory`` and install the JAX
     compile-accounting hooks. Safe to call early (before jax init).
 
@@ -58,23 +66,67 @@ def start_capture(directory: str) -> None:
     registry are reset so the directory describes exactly one run — the
     same contract under which ``configure`` truncates events.jsonl
     (otherwise a second capture in one process would write metrics.json /
-    chrome_trace.json still carrying the first run's counts)."""
+    chrome_trace.json still carrying the first run's counts).
+
+    ``flight_recorder`` (default on) also starts the live-health sampler
+    (obs.flightrec): a ``progress.json`` heartbeat every
+    ``heartbeat_interval_s``, a :class:`StallWarning` watchdog at
+    ``stall_timeout_s`` (None disables just the watchdog), and — when
+    ``crash_hooks`` and running on the main thread — SIGTERM/SIGINT +
+    excepthook chaining that flushes ``postmortem.json`` before the
+    process dies. ``finish_capture`` stops it."""
+    stale = flightrec.active()
+    if stale is not None:
+        # back-to-back captures without finish_capture: the previous
+        # recorder must not keep heartbeating into the old directory
+        stale.stop(finished=False)
     TRACER.reset()
     REGISTRY.reset()
     trace.configure(directory)
+    # one capture dir describes ONE run: configure() truncated
+    # events.jsonl, and a previous run's black box must go too, or a
+    # rerun into the dir (bench.py's OOM retry ladder, a resumed sweep)
+    # reads as dead to watch/report while it is running fine
+    import os as _os
+
+    for stale_artifact in ("progress.json", "postmortem.json"):
+        try:
+            _os.remove(_os.path.join(directory, stale_artifact))
+        except OSError:
+            pass
     jaxhooks.install()
+    if flight_recorder:
+        flightrec.FlightRecorder(
+            directory,
+            interval_s=heartbeat_interval_s,
+            stall_timeout_s=stall_timeout_s,
+        ).start()
+        if crash_hooks:
+            flightrec.install_crash_hooks()
 
 
 def finish_capture(context: dict = None) -> None:
     """Write the remaining artifacts of the configured telemetry dir:
     metrics.json / metrics.prom / chrome_trace.json / meta.json. The
-    events.jsonl stream was written live; this just flushes it."""
+    events.jsonl stream was written live; this just flushes it.
+
+    Without a prior ``start_capture`` this is a documented no-op (there
+    is no directory to write into), so teardown paths may call it
+    unconditionally. When called while an exception is propagating
+    (e.g. from a ``finally``), the flight recorder's ``postmortem.json``
+    is flushed first so the failed run leaves its black box."""
     import json
     import os
 
     directory = TRACER.directory
     if directory is None:
         return
+    rec = flightrec.active()
+    if rec is not None:
+        exc = sys.exc_info()[1]
+        if exc is not None:
+            rec.write_postmortem("exception", exc=exc)
+        rec.stop(finished=exc is None)
     TRACER.flush()
     with open(os.path.join(directory, "metrics.json"), "w") as fh:
         json.dump(REGISTRY.to_json(), fh, indent=1, sort_keys=True)
@@ -132,6 +184,10 @@ def telemetry_summary() -> dict:
 
 
 def reset_all() -> None:
-    """Clear the global tracer buffers and metrics registry (tests)."""
+    """Clear the global tracer buffers and metrics registry, and stop any
+    flight recorder still sampling (tests)."""
+    rec = flightrec.active()
+    if rec is not None:
+        rec.stop(finished=False)
     TRACER.reset()
     REGISTRY.reset()

@@ -1,0 +1,474 @@
+"""Flight recorder: live run health for hours-long captures.
+
+A capture (``obs.start_capture``) so far only left evidence *after* the
+run: events.jsonl streams spans as they complete, but a wedged sweep is
+indistinguishable from a slow one until it ends, and a SIGKILLed run
+leaves no summary at all. The flight recorder closes that gap with a
+daemon sampler thread that, for the life of a capture:
+
+* **heartbeat** — atomically replaces ``<dir>/progress.json`` every
+  ``interval_s`` with the run's current health: every thread's open
+  span stack, sweep chunk progress + ETA (from the ``sweep.chunks_*``
+  gauges fed by utils/sweep.py, rate-smoothed with an EWMA), the
+  ``sweep.inflight_chunks`` window, device-memory watermark, and the
+  JAX compile/retrace counters. ``python -m pta_replicator_tpu watch
+  DIR`` tails it; because the file is written via temp + ``os.replace``
+  a reader can never observe a torn JSON document.
+* **ring buffer** — the last ``ring_size`` completed span/event records
+  (a tracer listener), so the black box always holds the run's final
+  moments even when events.jsonl has grown to millions of lines.
+* **watchdog** — when no span opens or closes for ``stall_timeout_s``
+  the recorder warns with :class:`StallWarning`, bumps the
+  ``flightrec.stalls`` counter, and records a ``flightrec.stall``
+  tracer event (once per stall episode; re-arms on the next span).
+  This *complements* the pipeline's ``DrainTimeout``: the executor's
+  deadline hard-fails one wedged fetch/write after ``drain_timeout_s``
+  (default 900 s), while the watchdog fires earlier (default 300 s),
+  covers every phase of a run — compile, ingest, host reductions — and
+  never kills anything. A pipelined sweep keeps the watchdog fed
+  through its per-chunk ``dispatch``/``drain``/``io_write`` spans, so
+  a wedged tunnel trips the watchdog warning first and the executor's
+  ``DrainTimeout`` (counted in ``pipeline.drain_timeouts``) later.
+* **postmortem** — on SIGTERM/SIGINT, on an unhandled fatal exception,
+  or explicitly via :meth:`FlightRecorder.write_postmortem`, flushes
+  ``<dir>/postmortem.json``: the ring buffer, the final heartbeat, and
+  a full metrics snapshot. A killed multi-hour sweep then leaves a
+  readable black box (``python -m pta_replicator_tpu postmortem DIR``)
+  instead of just a truncated event stream.
+
+Signal/excepthook installation is a process-global chain: handlers are
+installed once, consult the *currently active* recorder, and always
+defer to whatever handler was installed before them — so a library
+embedding the recorder never steals SIGINT semantics from its host.
+
+jax-free by design (device memory comes through
+``jaxhooks.device_memory_snapshot``, which returns [] unless the
+process already imported jax), so the recorder — like the report and
+regression tooling — works in CPU-only and tooling contexts.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import traceback
+import warnings
+from typing import Optional
+
+from .jaxhooks import device_memory_snapshot
+from .metrics import REGISTRY
+from .trace import TRACER
+
+PROGRESS_SCHEMA_VERSION = 1
+
+#: Required fields (and JSON types) of progress.json — the heartbeat
+#: contract consumed by the ``watch`` subcommand and validated by
+#: scripts/check_telemetry_schema.py. Extend together with _heartbeat().
+PROGRESS_SCHEMA = {
+    "schema": int,          # PROGRESS_SCHEMA_VERSION
+    "pid": int,
+    "written_at": str,      # UTC ISO-8601
+    "uptime_s": float,      # since recorder start
+    "last_span_age_s": float,  # seconds since any span opened/closed
+    "open_spans": dict,     # {tid: ["realize", "compute", ...]}
+    "sweep": dict,          # chunks_done/chunks_total/inflight/rate/eta_s
+    "jax": dict,            # compiles / traces counters
+    "stalls": float,        # flightrec.stalls counter
+    "finished": bool,       # True only in the final heartbeat
+}
+
+POSTMORTEM_SCHEMA = {
+    "schema": int,
+    "reason": str,          # "SIGTERM" | "SIGINT" | "exception" | caller's
+    "written_at": str,
+    "heartbeat": dict,      # final heartbeat (PROGRESS_SCHEMA shape)
+    "ring": list,           # last N span/event records (EVENT_SCHEMA)
+    "metrics": dict,        # MetricsRegistry.to_json() snapshot
+}
+
+
+class StallWarning(UserWarning):
+    """No span opened or closed within the flight recorder's deadline —
+    the run is likely wedged (hung backend, deadlocked host stage), or
+    legitimately inside one very long uninstrumented computation."""
+
+
+def _atomic_json(path: str, payload: dict) -> None:
+    """Write ``payload`` as JSON via temp-file + rename so a concurrent
+    reader (the watch CLI, a shell watcher) can never see a torn file."""
+    dirname = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(suffix=".json", dir=dirname)
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=True, default=repr)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class FlightRecorder:
+    """Daemon sampler writing heartbeats and crash black boxes.
+
+    One instance per capture; :func:`obs.start_capture` manages the
+    process-wide one (:func:`active`). Constructing does nothing until
+    :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        interval_s: float = 1.0,
+        ring_size: int = 256,
+        stall_timeout_s: Optional[float] = 300.0,
+    ):
+        self.directory = directory
+        self.interval_s = float(interval_s)
+        self.stall_timeout_s = (
+            None if stall_timeout_s is None else float(stall_timeout_s)
+        )
+        self.ring = collections.deque(maxlen=int(ring_size))
+        self._thread: Optional[threading.Thread] = None
+        self._lifecycle_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._t_start = time.monotonic()
+        self._stalled = False  # current episode already warned
+        self._postmortem_written = False
+        self._pm_lock = threading.Lock()
+        # chunk-rate EWMA state: (monotonic time, chunks_done) at the
+        # last sample that saw progress
+        self._rate_ewma: Optional[float] = None
+        self._last_progress: Optional[tuple] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "FlightRecorder":
+        with self._lifecycle_lock:
+            if self._thread is not None:
+                return self
+            thread = self._thread = threading.Thread(
+                target=self._run, name="flightrec", daemon=True
+            )
+        os.makedirs(self.directory, exist_ok=True)
+        self._t_start = time.monotonic()
+        TRACER.add_listener(self._on_record)
+        _set_active(self)
+        self._stop.clear()
+        thread.start()
+        return self
+
+    def stop(self, finished: bool = True) -> None:
+        """Stop sampling and write the final heartbeat (``finished``
+        marks a run that completed rather than one being abandoned).
+        Safe under concurrent calls — a SIGTERM flush thread can race
+        ``finish_capture``'s teardown; exactly one joins the sampler."""
+        with self._lifecycle_lock:
+            thread, self._thread = self._thread, None
+        if thread is None:
+            return
+        self._stop.set()
+        try:
+            thread.join(timeout=max(2.0, 2 * self.interval_s))
+        except RuntimeError:
+            pass  # lost a microsecond race with start(): never started
+        TRACER.remove_listener(self._on_record)
+        _clear_active(self)
+        try:
+            self.write_heartbeat(finished=finished)
+        except OSError:
+            pass  # capture dir deleted under us — nothing to record into
+
+    # -- tracer listener ------------------------------------------------
+    def _on_record(self, rec: dict) -> None:
+        self.ring.append(rec)
+
+    # -- sampler --------------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.write_heartbeat()
+            except OSError:
+                pass  # transient (dir deleted mid-run); keep sampling
+            self._check_watchdog()
+
+    def _sweep_block(self) -> dict:
+        snap = {}
+        for name, key in (
+            ("sweep.chunks_done", "chunks_done"),
+            ("sweep.chunks_total", "chunks_total"),
+            ("sweep.inflight_chunks", "inflight"),
+            ("sweep.last_dispatched_chunk", "last_dispatched"),
+            ("sweep.realizations", "realizations"),
+            ("pipeline.drain_timeouts", "drain_timeouts"),
+        ):
+            val = _metric_value(name)
+            if val is not None:
+                snap[key] = val
+        done, total = snap.get("chunks_done"), snap.get("chunks_total")
+        if done is not None:
+            now = time.monotonic()
+            if self._last_progress is None:
+                self._last_progress = (now, done)
+            else:
+                t_prev, d_prev = self._last_progress
+                if done > d_prev and now > t_prev:
+                    inst = (done - d_prev) / (now - t_prev)
+                    # EWMA over completions, not ticks: idle ticks carry
+                    # no rate information, they just widen the gap the
+                    # next completed chunk is averaged over
+                    self._rate_ewma = (
+                        inst if self._rate_ewma is None
+                        else 0.3 * inst + 0.7 * self._rate_ewma
+                    )
+                    self._last_progress = (now, done)
+            if self._rate_ewma:
+                snap["chunk_rate_per_s"] = round(self._rate_ewma, 4)
+                if total and total > done:
+                    snap["eta_s"] = round(
+                        (total - done) / self._rate_ewma, 1
+                    )
+        return snap
+
+    def _last_activity(self) -> float:
+        # clamp to recorder start: a process that imported the library
+        # long before capturing must not read as "quiet for an hour"
+        # (and instantly trip the watchdog) before its first span
+        return max(TRACER.last_activity, self._t_start)
+
+    def _heartbeat(self, finished: bool = False) -> dict:
+        hb = {
+            "schema": PROGRESS_SCHEMA_VERSION,
+            "pid": os.getpid(),
+            "written_at": _utc_now(),
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "last_span_age_s": round(
+                time.monotonic() - self._last_activity(), 3
+            ),
+            "open_spans": {
+                str(tid): stack
+                for tid, stack in TRACER.open_spans().items()
+            },
+            "sweep": self._sweep_block(),
+            "jax": {
+                name.split(".", 1)[1]: val
+                for name in ("jax.compiles", "jax.traces")
+                if (val := _metric_value(name)) is not None
+            },
+            "stalls": _metric_value("flightrec.stalls") or 0.0,
+            "finished": bool(finished),
+        }
+        mem = device_memory_snapshot()
+        watermark = [
+            {k: m[k] for k in ("device", "bytes_in_use", "peak_bytes_in_use")
+             if k in m}
+            for m in mem if "bytes_in_use" in m
+        ]
+        if watermark:
+            hb["device_memory"] = watermark
+        return hb
+
+    def write_heartbeat(self, finished: bool = False) -> dict:
+        hb = self._heartbeat(finished=finished)
+        _atomic_json(os.path.join(self.directory, "progress.json"), hb)
+        return hb
+
+    def _check_watchdog(self) -> None:
+        if self.stall_timeout_s is None:
+            return
+        age = time.monotonic() - self._last_activity()
+        if age <= self.stall_timeout_s:
+            self._stalled = False  # activity resumed: re-arm
+            return
+        if self._stalled:
+            return  # already warned for this episode
+        self._stalled = True
+        REGISTRY.counter("flightrec.stalls").inc()
+        open_now = TRACER.open_spans()
+        desc = "; ".join(
+            "/".join(stack) for stack in open_now.values()
+        ) or "(no open spans)"
+        # the event feeds events.jsonl AND the ring buffer, so the
+        # stall is visible in the postmortem of a later kill
+        TRACER.event(
+            "flightrec.stall", age_s=round(age, 1), open=desc,
+        )
+        warnings.warn(
+            f"no span opened or closed for {age:.1f}s "
+            f"(deadline {self.stall_timeout_s:.1f}s); open: {desc}",
+            StallWarning,
+            stacklevel=2,
+        )
+
+    # -- postmortem -----------------------------------------------------
+    def write_postmortem(self, reason: str, exc: BaseException = None) -> str:
+        """Flush the black box. Idempotent per recorder: only the first
+        call writes (a SIGTERM racing the excepthook must not overwrite
+        the more specific report with the less specific one)."""
+        with self._pm_lock:
+            if self._postmortem_written:
+                return os.path.join(self.directory, "postmortem.json")
+            self._postmortem_written = True
+        pm = {
+            "schema": PROGRESS_SCHEMA_VERSION,
+            "reason": reason,
+            "written_at": _utc_now(),
+            "heartbeat": self._heartbeat(finished=False),
+            "ring": list(self.ring),
+            "metrics": REGISTRY.to_json(),
+        }
+        if exc is not None:
+            pm["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": traceback.format_exception(
+                    type(exc), exc, exc.__traceback__
+                ),
+            }
+        path = os.path.join(self.directory, "postmortem.json")
+        os.makedirs(self.directory, exist_ok=True)
+        _atomic_json(path, pm)
+        TRACER.flush()  # events.jsonl should be complete alongside it
+        return path
+
+
+# -- process-global active recorder + crash hook chain -----------------
+_active_lock = threading.Lock()
+_ACTIVE: Optional[FlightRecorder] = None
+_hooks_installed = False
+_prev_handlers: dict = {}
+_prev_excepthook = None
+
+
+def active() -> Optional[FlightRecorder]:
+    """The recorder currently sampling (None outside a capture)."""
+    return _ACTIVE
+
+
+def _set_active(rec: FlightRecorder) -> None:
+    global _ACTIVE
+    with _active_lock:
+        _ACTIVE = rec
+
+
+def _clear_active(rec: FlightRecorder) -> None:
+    global _ACTIVE
+    with _active_lock:
+        if _ACTIVE is rec:
+            _ACTIVE = None
+
+
+def _metric_value(name: str) -> Optional[float]:
+    """Current value of a plain (unlabeled) counter/gauge, or None if it
+    was never registered — reading must not CREATE the metric, or the
+    heartbeat would pollute every later metrics.json snapshot."""
+    for m in REGISTRY.metrics():
+        if m.name == name and not m.labels and hasattr(m, "value"):
+            return m.value
+    return None
+
+
+def _flush_from_signal(rec: FlightRecorder, reason: str,
+                       deadline_s: float = 5.0) -> None:
+    """Write the postmortem from a signal handler WITHOUT deadlocking.
+
+    The handler runs on the main thread between bytecodes — the
+    interrupted frame may be holding the tracer/registry locks (e.g.
+    mid-``Tracer._record``), and ``write_postmortem`` needs those same
+    non-reentrant locks for its snapshots. Acquiring them directly in
+    the handler would deadlock the process exactly when the feature
+    matters (a busy sweep being SIGTERMed). So the flush runs on a side
+    thread, which can take the locks once the (suspended) main thread's
+    critical section is NOT the lock holder — the overwhelmingly common
+    case — and the handler waits at most ``deadline_s`` before giving
+    up and letting the process die postmortem-less but dead."""
+    done = threading.Event()
+
+    def flush():
+        try:
+            rec.write_postmortem(reason)
+            rec.stop(finished=False)
+        except Exception:
+            pass
+        finally:
+            done.set()
+
+    threading.Thread(target=flush, name="flightrec-flush",
+                     daemon=True).start()
+    done.wait(deadline_s)
+
+
+def _signal_handler(signum, frame):
+    rec = _ACTIVE
+    if rec is not None:
+        try:
+            _flush_from_signal(rec, signal.Signals(signum).name)
+        except Exception:
+            pass
+    prev = _prev_handlers.get(signum, signal.SIG_DFL)
+    if callable(prev):
+        prev(signum, frame)
+    elif prev != signal.SIG_IGN:
+        # SIG_DFL — and also None, which getsignal() returns for a
+        # handler installed from C: we cannot re-install what we cannot
+        # see, but swallowing the signal would leave the process
+        # undead under a supervisor's graceful shutdown, so re-deliver
+        # with the default disposition (correct kill wait status)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+    # SIG_IGN: swallow, matching the pre-existing disposition
+
+
+def _excepthook(exc_type, exc, tb):
+    rec = _ACTIVE
+    if rec is not None:
+        try:
+            exc = exc if isinstance(exc, BaseException) else exc_type(exc)
+            exc.__traceback__ = tb
+            rec.write_postmortem("exception", exc=exc)
+        except Exception:
+            pass
+    (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+
+def install_crash_hooks() -> bool:
+    """Chain SIGTERM/SIGINT handlers and ``sys.excepthook`` through the
+    active recorder (idempotent, once per process; previous handlers
+    always run after the postmortem flush). Returns False off the main
+    thread, where CPython forbids signal installation — captures started
+    from worker threads still heartbeat, they just rely on
+    ``finish_capture``'s exception path instead of signal coverage."""
+    global _hooks_installed, _prev_excepthook
+    with _active_lock:
+        if _hooks_installed:
+            return True
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        installed = []
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                _prev_handlers[signum] = signal.getsignal(signum)
+                signal.signal(signum, _signal_handler)
+                installed.append(signum)
+            except (ValueError, OSError):  # embedded interpreter quirks
+                # roll back: a half-installed chain would later record
+                # OUR handler as the "previous" one and recurse on it
+                for done in installed:
+                    signal.signal(done, _prev_handlers.pop(done))
+                _prev_handlers.pop(signum, None)
+                return False
+        _prev_excepthook = sys.excepthook
+        sys.excepthook = _excepthook
+        _hooks_installed = True
+        return True

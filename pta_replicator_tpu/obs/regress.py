@@ -1,0 +1,272 @@
+"""Bench-trajectory regression gate: diff two or more BENCH JSONs.
+
+The repo accumulates one ``BENCH_r*.json`` per round plus ad-hoc
+``bench.py`` outputs, but nothing consumed them — "did PR N make
+``realize`` slower?" required reading JSON by eye. :func:`bench_diff`
+ingests any mix of
+
+* raw ``bench.py`` stdout JSON (``{"metric": ..., "value": ...}``),
+* the driver's wrapper shape (``{"n": ..., "rc": ..., "parsed": {...}}``
+  — the historical ``BENCH_r*.json`` series; ``parsed`` may be null for
+  rounds where the chip was unreachable),
+
+flattens every numeric scalar into dotted metric names
+(``value``, ``telemetry.spans.measure.total_s``,
+``sweep_pipeline.depth2_s``, ...), aligns them by name between the
+FIRST and LAST file — with more than two files the intermediate rounds
+contribute provenance notes, not verdicts (the gate asks "did the
+endpoint regress?", and the rendered header says so explicitly) — and
+renders a delta table with a verdict per metric:
+
+* ``ok``        within half the threshold in the bad direction, or any
+  good-direction delta up to the threshold,
+* ``warn``      in the (threshold/2, threshold] band on the BAD side
+  only — a +7% throughput gain is ``ok``, never a near-regression,
+* ``regressed`` worse than threshold in the *bad* direction,
+* ``improved``  better than threshold in the *good* direction,
+* ``info``      direction unknown (no verdict, delta shown).
+
+Direction is classified from the metric name (rates/speedups are
+higher-better; ``*_s``/``*_ms`` durations are lower-better) —
+:func:`metric_direction`. The exit code is the gate: nonzero iff any
+metric regressed past ``threshold``.
+
+Schema handling: bench.py stamps ``schema_version`` (and git rev +
+platform block) since version 2. Files stamped with a *newer* major
+schema than this reader knows are refused (:class:`SchemaMismatch` —
+metric names may have been re-meaning-ed); unstamped historical files
+are treated as version 0 and compared best-effort with a downgrade
+note, which is exactly the alignment-by-name they were written under.
+
+jax-free, stdlib-only: usable anywhere the report CLI is.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional, Tuple
+
+#: highest bench-JSON schema_version this reader understands
+KNOWN_SCHEMA_VERSION = 2
+
+#: keys that are provenance/noise, not measurements — never diffed
+_SKIP_KEYS = {
+    "schema_version", "timestamp", "written_at", "git_rev", "n", "rc",
+    "seq", "pid",
+}
+_SKIP_PREFIXES = ("backup_", "platform.")
+
+_HIGHER_BETTER_TOKENS = (
+    "value", "rate", "per_s", "speedup", "vs_baseline", "mfu",
+    "tflops", "flops", "realizations",
+)
+_LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
+_LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts")
+
+
+class SchemaMismatch(RuntimeError):
+    """A bench JSON is stamped with a newer schema than this reader."""
+
+
+def load_bench(path: str) -> dict:
+    """Load one bench JSON, unwrapping the driver's ``{"parsed": ...}``
+    shape. Returns ``{}`` for a round whose ``parsed`` is null (bench
+    never produced a JSON line that round)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and "parsed" in doc and (
+        "cmd" in doc or "rc" in doc
+    ):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        return {}
+    version = doc.get("schema_version", 0)
+    if isinstance(version, int) and version > KNOWN_SCHEMA_VERSION:
+        raise SchemaMismatch(
+            f"{path}: schema_version {version} is newer than this "
+            f"reader (knows <= {KNOWN_SCHEMA_VERSION}) — upgrade before "
+            "diffing, metric meanings may have changed"
+        )
+    return doc
+
+
+def flatten_metrics(doc: dict, prefix: str = "") -> Dict[str, float]:
+    """Dotted-name -> value for every numeric scalar leaf (bools and
+    provenance keys skipped; lists skipped — per-rep sample arrays are
+    not alignable metrics)."""
+    out: Dict[str, float] = {}
+    for key, val in doc.items():
+        name = f"{prefix}{key}"
+        if key in _SKIP_KEYS or any(
+            name.startswith(p) for p in _SKIP_PREFIXES
+        ):
+            continue
+        if isinstance(val, bool):
+            continue
+        if isinstance(val, (int, float)):
+            if math.isfinite(val):
+                out[name] = float(val)
+        elif isinstance(val, dict):
+            out.update(flatten_metrics(val, prefix=name + "."))
+    return out
+
+
+def metric_direction(name: str) -> Optional[bool]:
+    """True = higher is better, False = lower is better, None = unknown.
+    Rate tokens are checked BEFORE the duration suffixes: a throughput
+    name like ``cpu_oracle_real_per_s`` ends in ``_s`` too, and reading
+    it as a duration would invert the gate's verdict for every
+    realizations/s metric."""
+    leaf = name.rsplit(".", 1)[-1].lower()
+    if any(t in leaf for t in _HIGHER_BETTER_TOKENS):
+        return True
+    if leaf.endswith(_LOWER_BETTER_SUFFIXES) or any(
+        t in leaf for t in _LOWER_BETTER_TOKENS
+    ):
+        return False
+    return None
+
+
+def classify(
+    old: float, new: float, direction: Optional[bool], threshold: float
+) -> Tuple[str, Optional[float]]:
+    """(verdict, relative delta). Relative delta is None when the old
+    value is 0 (a failed round) — verdicts degrade to info/improved."""
+    if old == new:
+        return ("ok" if direction is not None else "info"), 0.0
+    if old == 0.0:
+        if direction is None:
+            return "info", None
+        got_better = (new > 0) == direction
+        return ("improved" if got_better else "regressed"), None
+    rel = (new - old) / abs(old)
+    if direction is None:
+        return "info", rel
+    worse = rel < 0 if direction else rel > 0
+    mag = abs(rel)
+    if not worse:
+        # the warn band only exists on the BAD side — a +7% throughput
+        # gain must not be tallied as a near-regression
+        return ("improved" if mag > threshold else "ok"), rel
+    if mag <= threshold / 2:
+        return "ok", rel
+    if mag <= threshold:
+        return "warn", rel
+    return "regressed", rel
+
+
+def bench_diff(
+    paths: List[str], threshold: float = 0.10
+) -> Tuple[str, dict, int]:
+    """Diff ``paths`` (oldest first): returns (rendered table, summary
+    dict, exit code). Exit code 0 = no regression past threshold, 1 =
+    at least one, 2 = inputs unusable (schema refusal propagates as the
+    SchemaMismatch exception instead)."""
+    if len(paths) < 2:
+        raise ValueError("bench-diff needs at least two files")
+    docs = [load_bench(p) for p in paths]
+    labels = [os.path.basename(p) for p in paths]
+    flats = [flatten_metrics(d) for d in docs]
+
+    lines: List[str] = []
+    notes: List[str] = []
+    for label, doc, flat in zip(labels, docs, flats):
+        version = doc.get("schema_version", 0)
+        if version < KNOWN_SCHEMA_VERSION:
+            notes.append(
+                f"{label}: unstamped/older bench schema (v{version}) — "
+                "aligned by name, best effort"
+            )
+        if not flat:
+            notes.append(
+                f"{label}: no measurements"
+                + (f" (error: {doc['error']})" if doc.get("error") else
+                   " (parsed JSON empty — round never produced output)")
+            )
+        elif doc.get("error"):
+            notes.append(f"{label}: recorded an error: {doc['error']}")
+
+    base, head = flats[0], flats[-1]
+    if not base or not head:
+        lines.append(
+            f"bench-diff: {labels[0]} -> {labels[-1]}: nothing comparable"
+        )
+        lines.extend("  note: " + n for n in notes)
+        return "\n".join(lines), {"comparable": 0, "regressed": 0}, 2
+
+    names = sorted(set(base) & set(head))
+    only_old = sorted(set(base) - set(head))
+    only_new = sorted(set(head) - set(base))
+
+    verdicts: Dict[str, str] = {}
+    width = max((len(n) for n in names), default=10)
+    width = min(width, 52)
+    header = (
+        f"{'metric':<{width}} {labels[0][:18]:>18} {labels[-1][:18]:>18} "
+        f"{'delta':>9}  verdict"
+    )
+    rows = [header, "-" * len(header)]
+    order = {"regressed": 0, "warn": 1, "improved": 2, "ok": 3, "info": 4}
+    entries = []
+    for name in names:
+        verdict, rel = classify(
+            base[name], head[name], metric_direction(name), threshold
+        )
+        verdicts[name] = verdict
+        entries.append((order[verdict], name, base[name], head[name], rel,
+                        verdict))
+    entries.sort(key=lambda e: (e[0], e[1]))
+    for _, name, old, new, rel, verdict in entries:
+        delta = "n/a" if rel is None else f"{rel:+.1%}"
+        rows.append(
+            f"{name[:width]:<{width}} {_fmt(old):>18} {_fmt(new):>18} "
+            f"{delta:>9}  {verdict}"
+        )
+
+    n_reg = sum(1 for v in verdicts.values() if v == "regressed")
+    n_imp = sum(1 for v in verdicts.values() if v == "improved")
+    n_warn = sum(1 for v in verdicts.values() if v == "warn")
+    lines.append(
+        f"bench-diff: {labels[0]} -> {labels[-1]} "
+        f"({len(paths)} files, threshold {threshold:.0%})"
+    )
+    if len(paths) > 2:
+        lines.append(
+            f"  note: verdicts compare the endpoints only — "
+            f"{len(paths) - 2} intermediate file(s) "
+            f"({', '.join(labels[1:-1])}) are not diffed"
+        )
+    lines.extend("  note: " + n for n in notes)
+    lines.append("")
+    lines.extend(rows)
+    lines.append("")
+    if only_old:
+        lines.append(f"dropped metrics ({len(only_old)}): "
+                     + ", ".join(only_old[:8])
+                     + (" ..." if len(only_old) > 8 else ""))
+    if only_new:
+        lines.append(f"new metrics ({len(only_new)}): "
+                     + ", ".join(only_new[:8])
+                     + (" ..." if len(only_new) > 8 else ""))
+    lines.append(
+        f"{len(names)} aligned: {n_reg} regressed, {n_warn} warn, "
+        f"{n_imp} improved, "
+        f"{len(names) - n_reg - n_imp - n_warn} ok/info"
+    )
+    summary = {
+        "comparable": len(names),
+        "regressed": n_reg,
+        "improved": n_imp,
+        "warn": n_warn,
+        "verdicts": verdicts,
+    }
+    return "\n".join(lines), summary, (1 if n_reg else 0)
+
+
+def _fmt(v: float) -> str:
+    if v == 0:
+        return "0"
+    if abs(v) >= 1e5 or abs(v) < 1e-3:
+        return f"{v:.3e}"
+    return f"{v:.4g}"

@@ -8,6 +8,14 @@ A telemetry directory (written by ``--telemetry DIR`` on the CLI, or by
 * ``metrics.prom``   — the same registry in Prometheus text format
 * ``chrome_trace.json`` — Perfetto / chrome://tracing export of the spans
 * ``meta.json``      — run context (argv, backend, device memory, ...)
+* ``progress.json``  — the flight recorder's last heartbeat (live runs)
+* ``postmortem.json`` — black box flushed on SIGTERM/SIGINT/crash
+
+Every artifact is optional: a killed or still-running capture has only a
+subset, and a crash can truncate any of the JSON files mid-write — the
+loader degrades each missing/corrupt artifact to None (with a note in
+``data["problems"]``) instead of raising, and the report renders an
+explicit "no telemetry data" section when nothing is readable.
 
 This module is deliberately jax-free so reports can be read anywhere.
 """
@@ -35,16 +43,35 @@ def load_events(path: str) -> List[dict]:
 
 
 def load_telemetry(directory: str) -> dict:
-    """Read every artifact a telemetry dir may carry (missing ones -> None)."""
-    out = {"directory": directory, "events": [], "metrics": None, "meta": None}
+    """Read every artifact a telemetry dir may carry. Missing artifacts
+    load as None (events: []); a corrupt/truncated JSON artifact (killed
+    run caught mid-write) also loads as None, with a human-readable note
+    appended to ``["problems"]`` — loading never raises on bad data."""
+    out = {
+        "directory": directory, "events": [], "metrics": None,
+        "meta": None, "progress": None, "postmortem": None,
+        "problems": [],
+    }
+    if not os.path.isdir(directory):
+        out["problems"].append(f"{directory}: not a directory")
+        return out
     ev = os.path.join(directory, "events.jsonl")
     if os.path.exists(ev):
         out["events"] = load_events(ev)
-    for key, fname in (("metrics", "metrics.json"), ("meta", "meta.json")):
+    for key, fname in (
+        ("metrics", "metrics.json"),
+        ("meta", "meta.json"),
+        ("progress", "progress.json"),
+        ("postmortem", "postmortem.json"),
+    ):
         p = os.path.join(directory, fname)
-        if os.path.exists(p):
+        if not os.path.exists(p):
+            continue
+        try:
             with open(p) as fh:
                 out[key] = json.load(fh)
+        except (json.JSONDecodeError, OSError) as exc:
+            out["problems"].append(f"{fname}: unreadable ({exc})")
     return out
 
 
@@ -162,7 +189,10 @@ def render_report(
 
     if as_json:
         return json.dumps(
-            {"spans": agg, "metrics": metrics, "meta": data["meta"]},
+            {"spans": agg, "metrics": metrics, "meta": data["meta"],
+             "progress": data["progress"],
+             "postmortem": data["postmortem"],
+             "problems": data["problems"]},
             indent=1, sort_keys=True,
         )
 
@@ -175,6 +205,18 @@ def render_report(
         )
         if ctx:
             parts.append(ctx)
+    for problem in data["problems"]:
+        parts.append(f"  warning: {problem}")
+    if not data["events"] and not metrics and not data["progress"] and \
+            not data["postmortem"]:
+        parts.append("")
+        parts.append(
+            "no telemetry data: the directory carries no readable "
+            "events.jsonl, metrics.json, progress.json or "
+            "postmortem.json — either the capture never started "
+            "(--telemetry unset?) or the wrong path was given"
+        )
+        return "\n".join(parts)
     parts.append("")
     parts.append(render_span_tree(agg, min_ms=min_ms))
 
@@ -204,10 +246,249 @@ def render_report(
         parts.append("metrics:")
         parts.extend(other_rows)
 
+    stalls = _stall_count(metrics, data["progress"])
+    if stalls:
+        parts.append("")
+        parts.append(
+            f"STALLS: the watchdog fired {stalls} time(s) — the run went "
+            "quiet past its deadline (see flightrec.stall events above "
+            "and docs/observability.md)"
+        )
+    hb = data["progress"]
+    if hb is not None and not hb.get("finished"):
+        parts.append("")
+        parts.append(
+            "run did not finish cleanly — last heartbeat "
+            f"({hb.get('written_at', '?')}):"
+        )
+        parts.append("  " + render_heartbeat(hb))
+    if data["postmortem"] is not None:
+        pm = data["postmortem"]
+        parts.append("")
+        parts.append(
+            f"POSTMORTEM present (reason: {pm.get('reason', '?')}, "
+            f"written {pm.get('written_at', '?')}) — inspect with "
+            f"`python -m pta_replicator_tpu postmortem {directory}`"
+        )
+
     nspans = sum(a["calls"] for a in agg.values())
     parts.append("")
     parts.append(f"{len(agg)} distinct stages, {nspans} spans total")
     return "\n".join(parts)
+
+
+def _stall_count(metrics: dict, progress: Optional[dict]) -> int:
+    insts = (metrics or {}).get("flightrec.stalls") or []
+    for inst in insts:
+        if inst.get("value"):
+            return int(inst["value"])
+    if progress and progress.get("stalls"):
+        return int(progress["stalls"])
+    return 0
+
+
+def render_heartbeat(hb: dict) -> str:
+    """One-line human rendering of a progress.json heartbeat — the
+    ``watch`` subcommand prints one of these per tick (tail-friendly:
+    append to a log, read with tail -f)."""
+    parts = [hb.get("written_at", "?")]
+    sweep = hb.get("sweep") or {}
+    done, total = sweep.get("chunks_done"), sweep.get("chunks_total")
+    if done is not None and total:
+        pct = 100.0 * done / total
+        parts.append(f"chunks {int(done)}/{int(total)} ({pct:.1f}%)")
+        eta = sweep.get("eta_s")
+        if eta is not None:
+            parts.append(f"eta {_fmt_eta(eta)}")
+        rate = sweep.get("chunk_rate_per_s")
+        if rate:
+            parts.append(f"{rate:.3g} chunk/s")
+    if sweep.get("inflight"):
+        parts.append(f"inflight {int(sweep['inflight'])}")
+    open_spans = hb.get("open_spans") or {}
+    if open_spans:
+        deepest = max(open_spans.values(), key=len)
+        parts.append("in " + "/".join(deepest))
+    else:
+        parts.append("idle")
+    age = hb.get("last_span_age_s")
+    if age is not None and age > 30:
+        parts.append(f"last span {age:.0f}s ago")
+    jx = hb.get("jax") or {}
+    if jx.get("compiles"):
+        parts.append(f"compiles {int(jx['compiles'])}")
+    mem = hb.get("device_memory") or []
+    peak = max((m.get("peak_bytes_in_use", m.get("bytes_in_use", 0))
+                for m in mem), default=0)
+    if peak:
+        parts.append(f"mem {peak / 2**30:.2f} GiB")
+    if hb.get("stalls"):
+        parts.append(f"STALLS {int(hb['stalls'])}")
+    if hb.get("finished"):
+        parts.append("FINISHED")
+    return " | ".join(parts)
+
+
+def _fmt_eta(seconds: float) -> str:
+    seconds = int(seconds)
+    if seconds >= 3600:
+        return f"{seconds // 3600}h{(seconds % 3600) // 60:02d}m"
+    if seconds >= 60:
+        return f"{seconds // 60}m{seconds % 60:02d}s"
+    return f"{seconds}s"
+
+
+def render_postmortem(directory: str, last: int = 25) -> str:
+    """The ``postmortem`` CLI body: reason, final heartbeat, the tail of
+    the ring buffer (in-flight spans were never completed, so the open
+    stacks in the heartbeat ARE the in-flight work), key metrics."""
+    data = load_telemetry(directory)
+    pm = data["postmortem"]
+    parts = [f"postmortem: {directory}"]
+    for problem in data["problems"]:
+        parts.append(f"  warning: {problem}")
+    if pm is None:
+        parts.append(
+            "no postmortem.json — the run either finished cleanly, is "
+            "still alive (try `watch`), or died uncatchably (SIGKILL/"
+            "OOM-killer: see the last heartbeat below and events.jsonl)"
+        )
+        if data["progress"] is not None:
+            parts.append("")
+            parts.append("last heartbeat: " + render_heartbeat(
+                data["progress"]))
+        return "\n".join(parts)
+
+    parts.append(
+        f"reason: {pm.get('reason', '?')}  written: "
+        f"{pm.get('written_at', '?')}"
+    )
+    exc = pm.get("exception")
+    if exc:
+        parts.append(f"exception: {exc.get('type')}: {exc.get('message')}")
+        tb = exc.get("traceback") or []
+        parts.extend("  " + line.rstrip() for line in tb[-6:])
+    hb = pm.get("heartbeat") or {}
+    parts.append("")
+    parts.append("final heartbeat: " + render_heartbeat(hb))
+    for tid, stack in (hb.get("open_spans") or {}).items():
+        parts.append(f"  in flight (tid {tid}): " + "/".join(stack))
+
+    ring = pm.get("ring") or []
+    if ring:
+        parts.append("")
+        parts.append(f"last {min(last, len(ring))} of {len(ring)} "
+                     "buffered span/event records (oldest first):")
+        t_end = max((r.get("t0", 0.0) for r in ring), default=0.0)
+        for rec in ring[-last:]:
+            dt = rec.get("t0", 0.0) - t_end
+            if rec.get("type") == "span":
+                parts.append(
+                    f"  {dt:+9.3f}s  {rec.get('path', rec.get('name')):<44} "
+                    f"{_fmt_s(rec.get('wall_s', 0.0)):>10}"
+                )
+            else:
+                parts.append(
+                    f"  {dt:+9.3f}s  [{rec.get('type')}] "
+                    f"{rec.get('name')} {rec.get('attrs', '')}"
+                )
+    metrics = pm.get("metrics") or {}
+    interesting = {
+        k: v for k, v in metrics.items()
+        if k.startswith(("sweep.", "flightrec.", "pipeline."))
+    }
+    rows = _metric_rows(interesting)
+    if rows:
+        parts.append("")
+        parts.append("run counters at death:")
+        parts.extend(rows)
+    return "\n".join(parts)
+
+
+def print_postmortem(directory: str, file: Optional[TextIO] = None) -> None:
+    print(render_postmortem(directory), file=file)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        # atomic-replace writing means corrupt == mid-crash leftovers,
+        # not a torn write; either way the watcher just waits
+        return None
+
+
+def watch_progress(
+    directory: str,
+    interval: float = 2.0,
+    once: bool = False,
+    file: Optional[TextIO] = None,
+) -> int:
+    """The ``watch`` CLI body: tail ``directory/progress.json``, printing
+    one :func:`render_heartbeat` line whenever the heartbeat advances
+    (tail -f friendly — recovery watchers append this to their logs).
+
+    Returns 0 when the watched run finishes, 2 when a postmortem.json
+    appears (the run died — its summary is printed), 3 in ``--once``
+    mode when there is nothing to read. Ctrl-C just stops watching.
+    """
+    import time as _time
+
+    progress_path = os.path.join(directory, "progress.json")
+    pm_path = os.path.join(directory, "postmortem.json")
+    last_seen = None
+    waiting_said = False
+    stale_said = False
+    t_change = _time.monotonic()
+    stale_after = max(30.0, 10 * interval)
+    try:
+        while True:
+            hb = _read_json(progress_path)
+            # change detection compares the whole document, NOT
+            # written_at: that field has 1-second resolution and the
+            # final finished=True heartbeat often lands in the same
+            # second as the previous tick — it must still print and
+            # terminate the watch
+            if hb is not None and hb != last_seen:
+                last_seen = hb
+                t_change = _time.monotonic()
+                stale_said = False
+                print(render_heartbeat(hb), file=file, flush=True)
+                if hb.get("finished"):
+                    return 0
+            elif (
+                hb is not None and not stale_said
+                and _time.monotonic() - t_change > stale_after
+            ):
+                stale_said = True
+                print(
+                    f"(heartbeat stale for "
+                    f"{_time.monotonic() - t_change:.0f}s — run SIGKILLed "
+                    "or host wedged? events.jsonl holds what completed)",
+                    file=file, flush=True,
+                )
+            elif hb is None and (once or not waiting_said):
+                waiting_said = True
+                print(
+                    f"(no progress.json in {directory} yet — run not "
+                    "started, or started without a flight recorder)",
+                    file=file, flush=True,
+                )
+            if os.path.exists(pm_path):
+                pm = _read_json(pm_path) or {}
+                print(
+                    f"run died (postmortem reason: {pm.get('reason', '?')})"
+                    f" — `python -m pta_replicator_tpu postmortem "
+                    f"{directory}` for the black box",
+                    file=file, flush=True,
+                )
+                return 2
+            if once:
+                return 3 if hb is None else 0
+            _time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def print_report(

@@ -85,6 +85,14 @@ class Tracer:
         self._agg: Dict[str, dict] = {}
         self._dir: Optional[str] = None
         self._sink = None
+        self._listeners: list = []
+        # tid -> that thread's live span stack (the list _stack() mutates
+        # in place), so another thread can snapshot what is open NOW —
+        # the flight recorder's heartbeat reads this
+        self._thread_stacks: Dict[int, list] = {}
+        #: monotonic time of the last span open/close anywhere in the
+        #: process — the flight-recorder watchdog's liveness signal
+        self.last_activity = time.monotonic()
 
     # -- configuration -------------------------------------------------
     def configure(self, directory: Optional[str]) -> None:
@@ -121,6 +129,8 @@ class Tracer:
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
+            with self._lock:
+                self._thread_stacks[threading.get_ident()] = stack
         return stack
 
     #: event-buffer cap while NO sink is configured: enough for tests and
@@ -129,6 +139,11 @@ class Tracer:
     IDLE_MAX_EVENTS = 2000
 
     def _record(self, rec: dict) -> None:
+        if rec["type"] == "span":
+            # span completions (not instant events) feed the watchdog's
+            # liveness clock — the watchdog's own stall event must not
+            # reset the very stall it is reporting
+            self.last_activity = time.monotonic()
         # serialize outside the lock (racy sink check is benign: worst
         # case one wasted dumps, or a late serialize under the lock) so
         # concurrent pool-worker spans don't contend on JSON encoding
@@ -157,6 +172,15 @@ class Tracer:
                 self._sink.write(
                     line if line is not None else json.dumps(rec) + "\n"
                 )
+            listeners = list(self._listeners)
+        # outside the lock: a listener (the flight recorder's ring
+        # buffer) may itself take locks or do I/O, and must never be
+        # able to deadlock or throw through span recording
+        for fn in listeners:
+            try:
+                fn(rec)
+            except Exception:
+                pass
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
@@ -170,6 +194,7 @@ class Tracer:
         stack = self._stack()
         path = "/".join(stack + [name])
         stack.append(name)
+        self.last_activity = time.monotonic()
         attrs = dict(attrs)
         t0 = time.time()
         w0 = time.perf_counter()
@@ -194,6 +219,34 @@ class Tracer:
         """The calling thread's open-span ancestry (for :meth:`inherit`)."""
         return tuple(self._stack())
 
+    def open_spans(self) -> Dict[int, list]:
+        """Snapshot of every thread's currently-open span stack,
+        ``{tid: [name, ...]}``, threads with nothing open omitted. Reads
+        live per-thread lists, so a stack may be one push/pop stale —
+        fine for the heartbeat it feeds, never for accounting."""
+        alive = {t.ident for t in threading.enumerate()}
+        with self._lock:
+            for tid in [
+                t for t, s in self._thread_stacks.items()
+                if not s and t not in alive
+            ]:
+                del self._thread_stacks[tid]  # reap exited pool workers
+            items = list(self._thread_stacks.items())
+        return {tid: list(stack) for tid, stack in items if stack}
+
+    def add_listener(self, fn) -> None:
+        """Subscribe ``fn(record)`` to every completed span/event. The
+        callback runs on the recording thread, outside the tracer lock;
+        exceptions are swallowed."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
     @contextlib.contextmanager
     def inherit(self, stack: tuple):
         """Adopt ``stack`` (a :meth:`current_stack` snapshot from another
@@ -205,11 +258,17 @@ class Tracer:
         ingest span that dispatched them.
         """
         saved = getattr(self._local, "stack", None)
-        self._local.stack = list(stack)
+        adopted = self._local.stack = list(stack)
+        tid = threading.get_ident()
+        with self._lock:
+            self._thread_stacks[tid] = adopted
         try:
             yield
         finally:
-            self._local.stack = saved if saved is not None else []
+            restored = saved if saved is not None else []
+            self._local.stack = restored
+            with self._lock:
+                self._thread_stacks[tid] = restored
 
     def event(self, name: str, **attrs) -> None:
         """Record an instant (zero-duration) event."""

@@ -52,7 +52,7 @@ from typing import Callable, Iterable, Optional
 
 import numpy as np
 
-from ..obs import gauge, span
+from ..obs import counter, gauge, span
 from ..obs.trace import TRACER
 
 
@@ -155,6 +155,11 @@ def run_pipelined(
         ):
             t0 = started[0]
             if t0 is not None and time.monotonic() - t0 > drain_timeout_s:
+                # distinct from flightrec.stalls: the flight recorder's
+                # watchdog WARNS early on any quiet run; this deadline
+                # hard-fails one provably wedged fetch/write. Both land
+                # in the heartbeat so `watch` shows warning-then-kill.
+                counter("pipeline.drain_timeouts").inc()
                 _fail(
                     stage,
                     DrainTimeout(
@@ -242,6 +247,10 @@ def run_pipelined(
             except BaseException as exc:  # noqa: BLE001
                 _fail("dispatch", exc)
                 break
+            # heartbeat feed: how far ahead of the drained/written
+            # chunks the dispatcher is running (sweep.chunks_done lags
+            # this by the in-flight window)
+            gauge("sweep.last_dispatched_chunk").set(i)
             _bump(+1)
             if not _put(drain_q, (i, dev)):
                 break

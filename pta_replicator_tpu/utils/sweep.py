@@ -365,7 +365,13 @@ def sweep(
 
         static = static_delays(batch, recipe, mesh=mesh)
 
-    from ..obs import counter, span
+    from ..obs import counter, gauge, span
+
+    # chunk-progress gauges: the flight recorder's heartbeat derives
+    # "12/64 chunks, ETA 4m" from exactly these (obs/flightrec.py), so
+    # a resumed sweep must seed chunks_done with the resume offset
+    gauge("sweep.chunks_total").set(nchunks)
+    gauge("sweep.chunks_done").set(done)
 
     def dispatch_chunk(i: int):
         """Dispatch chunk ``i`` and its on-device reduction; returns the
@@ -401,6 +407,7 @@ def sweep(
 
         _atomic_write(write_meta, meta_path, ".json", durable=durable)
         counter("sweep.realizations").inc(chunk)
+        gauge("sweep.chunks_done").set(i + 1)
         if progress is not None:
             progress(i + 1, nchunks)
 

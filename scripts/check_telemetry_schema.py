@@ -51,14 +51,24 @@ INSTRUMENTED_ENTRYPOINTS = [
     ("pta_replicator_tpu/utils/sweep.py", 'span("sweep_chunk"'),
     ("pta_replicator_tpu/utils/sweep.py", 'span("readback_fence"'),
     ("pta_replicator_tpu/utils/sweep.py", 'span("sweep_pipeline"'),
+    ("pta_replicator_tpu/utils/sweep.py", 'gauge("sweep.chunks_total")'),
+    ("pta_replicator_tpu/utils/sweep.py", 'gauge("sweep.chunks_done")'),
     ("pta_replicator_tpu/parallel/pipeline.py", 'span("dispatch"'),
     ("pta_replicator_tpu/parallel/pipeline.py", 'span("drain"'),
     ("pta_replicator_tpu/parallel/pipeline.py", 'span("io_write"'),
     ("pta_replicator_tpu/parallel/pipeline.py",
      'gauge("sweep.inflight_chunks")'),
+    ("pta_replicator_tpu/parallel/pipeline.py",
+     'counter("pipeline.drain_timeouts")'),
+    ("pta_replicator_tpu/parallel/pipeline.py",
+     'gauge("sweep.last_dispatched_chunk")'),
+    ("pta_replicator_tpu/obs/flightrec.py",
+     'counter("flightrec.stalls")'),
+    ("pta_replicator_tpu/obs/flightrec.py", '"flightrec.stall"'),
     ("pta_replicator_tpu/__main__.py", 'span("compute"'),
     ("pta_replicator_tpu/__main__.py", 'span("ingest"'),
     ("bench.py", 'obs.span("measure"'),
+    ("bench.py", '"BENCH_TELEMETRY"'),
 ]
 
 
@@ -146,6 +156,85 @@ def generate_sample(directory: str) -> str:
     return os.path.join(directory, "events.jsonl")
 
 
+def _validate_shape(path: str, doc, schema: dict, kind: str) -> list:
+    """Field/type validation of one flight-recorder JSON document."""
+    problems = []
+    if not isinstance(doc, dict):
+        return [f"{path}: {kind} is not a JSON object"]
+    for field, ftype in schema.items():
+        if field not in doc:
+            problems.append(f"{path}: {kind} missing {field!r}")
+        elif ftype is float:
+            if not isinstance(doc[field], (int, float)) or isinstance(
+                doc[field], bool
+            ):
+                problems.append(f"{path}: {kind}.{field} not numeric")
+        elif not isinstance(doc[field], ftype) or (
+            ftype is int and isinstance(doc[field], bool)
+        ):
+            problems.append(
+                f"{path}: {kind}.{field} is "
+                f"{type(doc[field]).__name__}, expected {ftype.__name__}"
+            )
+    return problems
+
+
+def validate_flightrec_file(path: str, kind: str) -> list:
+    """Validate a progress.json (kind='progress') or postmortem.json
+    (kind='postmortem') against obs.flightrec's schema tables. The
+    postmortem's ring-buffer records are additionally checked against
+    EVENT_SCHEMA — they are the same records events.jsonl carries."""
+    from pta_replicator_tpu.obs.flightrec import (
+        POSTMORTEM_SCHEMA,
+        PROGRESS_SCHEMA,
+    )
+    from pta_replicator_tpu.obs.trace import EVENT_SCHEMA
+
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except json.JSONDecodeError as exc:
+        # unlike events.jsonl, these are atomic-replace artifacts: a
+        # torn/corrupt one is a writer bug, not a crash leftover
+        return [f"{path}: unparseable JSON ({exc})"]
+    if kind == "progress":
+        return _validate_shape(path, doc, PROGRESS_SCHEMA, kind)
+    problems = _validate_shape(path, doc, POSTMORTEM_SCHEMA, kind)
+    if isinstance(doc, dict):
+        problems += _validate_shape(
+            path, doc.get("heartbeat"), PROGRESS_SCHEMA,
+            "postmortem.heartbeat",
+        )
+        for i, rec in enumerate(doc.get("ring") or []):
+            rkind = rec.get("type") if isinstance(rec, dict) else None
+            schema = EVENT_SCHEMA.get(rkind)
+            if schema is None:
+                problems.append(
+                    f"{path}: ring[{i}] has unknown type {rkind!r}"
+                )
+                continue
+            problems += _validate_shape(
+                path, rec, schema, f"ring[{i}]({rkind})"
+            )
+    return problems
+
+
+def generate_flightrec_sample(directory: str) -> list:
+    """Exercise the flight recorder in-process (no sampler thread, no
+    jax): one heartbeat + one postmortem, returned as paths to check."""
+    from pta_replicator_tpu.obs.flightrec import FlightRecorder
+    from pta_replicator_tpu.obs.trace import TRACER
+
+    rec = FlightRecorder(directory, stall_timeout_s=None)
+    with TRACER.span("schema_probe"):
+        rec.write_heartbeat()
+    rec.write_postmortem("schema-check sample")
+    return [
+        (os.path.join(directory, "progress.json"), "progress"),
+        (os.path.join(directory, "postmortem.json"), "postmortem"),
+    ]
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     problems = check_entrypoints()
@@ -153,11 +242,21 @@ def main(argv=None) -> int:
     if argv:
         target = argv[0]
         if os.path.isdir(target):
+            # a capture directory: validate the stream plus whatever
+            # flight-recorder artifacts the run left behind
+            for fname, kind in (("progress.json", "progress"),
+                                ("postmortem.json", "postmortem")):
+                p = os.path.join(target, fname)
+                if os.path.exists(p):
+                    problems += validate_flightrec_file(p, kind)
             target = os.path.join(target, "events.jsonl")
         problems += validate_events(target)
     else:
         with tempfile.TemporaryDirectory() as d:
             problems += validate_events(generate_sample(d))
+        with tempfile.TemporaryDirectory() as d:
+            for path, kind in generate_flightrec_sample(d):
+                problems += validate_flightrec_file(path, kind)
 
     if problems:
         for p in problems:

@@ -1,0 +1,548 @@
+"""Flight recorder + bench-regression gate: watchdog, heartbeat
+atomicity, SIGTERM postmortems, ring-buffer bounding, bench-diff
+verdicts, and the degraded-capture report paths.
+
+CPU-only, fixture-free, and (except one subprocess test) jax-free.
+"""
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import warnings
+
+import pytest
+
+from pta_replicator_tpu import obs
+from pta_replicator_tpu.obs.flightrec import (
+    POSTMORTEM_SCHEMA,
+    PROGRESS_SCHEMA,
+    FlightRecorder,
+    StallWarning,
+)
+from pta_replicator_tpu.obs.regress import (
+    SchemaMismatch,
+    bench_diff,
+    flatten_metrics,
+    metric_direction,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_globals():
+    obs.reset_all()
+    yield
+    obs.configure(None)
+    obs.reset_all()
+
+
+def _wait_for(predicate, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------- heartbeat
+def test_heartbeat_written_and_schema_complete(tmp_path):
+    rec = FlightRecorder(str(tmp_path), interval_s=0.02,
+                         stall_timeout_s=None).start()
+    try:
+        obs.gauge("sweep.chunks_total").set(4)
+        with obs.span("outer"):
+            assert _wait_for(
+                lambda: (tmp_path / "progress.json").exists()
+            )
+            hb = json.loads((tmp_path / "progress.json").read_text())
+    finally:
+        rec.stop()
+    for field in PROGRESS_SCHEMA:
+        assert field in hb, f"heartbeat missing {field}"
+    assert hb["pid"] == os.getpid()
+    assert hb["sweep"]["chunks_total"] == 4
+    # final heartbeat after stop() is marked finished
+    hb = json.loads((tmp_path / "progress.json").read_text())
+    assert hb["finished"] is True
+
+
+def test_heartbeat_valid_json_under_concurrent_reads(tmp_path):
+    """Atomic-replace contract: a reader polling progress.json in a tight
+    loop while the sampler rewrites it at high frequency must never see
+    a torn/partial document."""
+    rec = FlightRecorder(str(tmp_path), interval_s=0.001,
+                         stall_timeout_s=None).start()
+    path = tmp_path / "progress.json"
+    assert _wait_for(path.exists)
+    failures = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                doc = json.loads(path.read_text())
+                if "written_at" not in doc:
+                    failures.append("incomplete doc")
+            except json.JSONDecodeError as exc:
+                failures.append(repr(exc))
+            except FileNotFoundError:
+                failures.append("file vanished")
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 1.0:
+        with obs.span("busy"):
+            pass
+    stop.set()
+    for t in threads:
+        t.join()
+    rec.stop()
+    assert not failures, failures[:5]
+
+
+def test_heartbeat_eta_from_chunk_progress(tmp_path):
+    rec = FlightRecorder(str(tmp_path), interval_s=0.01,
+                         stall_timeout_s=None).start()
+    try:
+        obs.gauge("sweep.chunks_total").set(100)
+        for i in range(5):
+            obs.gauge("sweep.chunks_done").set(i + 1)
+            time.sleep(0.03)
+
+        def has_eta():
+            try:
+                hb = json.loads((tmp_path / "progress.json").read_text())
+            except (FileNotFoundError, json.JSONDecodeError):
+                return False
+            return "eta_s" in hb["sweep"] and "chunk_rate_per_s" in hb["sweep"]
+
+        assert _wait_for(has_eta)
+        hb = json.loads((tmp_path / "progress.json").read_text())
+        assert hb["sweep"]["chunks_done"] == 5
+        assert hb["sweep"]["eta_s"] > 0
+    finally:
+        rec.stop()
+
+
+# ----------------------------------------------------------- watchdog
+def test_watchdog_fires_once_per_stall_episode(tmp_path):
+    rec = FlightRecorder(str(tmp_path), interval_s=0.02,
+                         stall_timeout_s=0.15).start()
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            with obs.span("wedged_stage"):
+                time.sleep(0.6)  # several watchdog periods past deadline
+        stalls = [w for w in caught
+                  if issubclass(w.category, StallWarning)]
+        assert len(stalls) == 1, [str(w.message) for w in stalls]
+        assert "wedged_stage" in str(stalls[0].message)
+        assert obs.counter("flightrec.stalls").value == 1
+        # the stall is also a tracer event (-> ring buffer + events.jsonl)
+        assert any(
+            r["type"] == "event" and r["name"] == "flightrec.stall"
+            for r in rec.ring
+        )
+        # activity re-arms the watchdog: a second quiet period warns again
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            with obs.span("alive_again"):
+                pass
+            time.sleep(0.45)
+        assert sum(
+            1 for w in caught if issubclass(w.category, StallWarning)
+        ) == 1
+        assert obs.counter("flightrec.stalls").value == 2
+    finally:
+        rec.stop()
+
+
+def test_no_stall_while_spans_flow(tmp_path):
+    rec = FlightRecorder(str(tmp_path), interval_s=0.02,
+                         stall_timeout_s=0.3).start()
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 0.7:
+                with obs.span("tick"):
+                    time.sleep(0.01)
+        assert not [w for w in caught
+                    if issubclass(w.category, StallWarning)]
+        assert obs.counter("flightrec.stalls").value == 0
+    finally:
+        rec.stop()
+
+
+# -------------------------------------------------------- ring buffer
+def test_ring_buffer_bounded_and_keeps_newest(tmp_path):
+    rec = FlightRecorder(str(tmp_path), interval_s=5.0, ring_size=16,
+                         stall_timeout_s=None).start()
+    try:
+        for i in range(100):
+            with obs.span("s", i=i):
+                pass
+    finally:
+        rec.stop()
+    assert len(rec.ring) == 16
+    assert [r["attrs"]["i"] for r in rec.ring] == list(range(84, 100))
+
+
+# --------------------------------------------------------- postmortem
+def test_postmortem_written_once_with_ring_and_metrics(tmp_path):
+    rec = FlightRecorder(str(tmp_path), interval_s=5.0, ring_size=8,
+                         stall_timeout_s=None).start()
+    try:
+        obs.counter("sweep.realizations").inc(64)
+        with obs.span("doomed"):
+            pass
+        path = rec.write_postmortem(
+            "exception", exc=RuntimeError("boom")
+        )
+        # second call must not overwrite the first report
+        before = open(path).read()
+        rec.write_postmortem("SIGTERM")
+        assert open(path).read() == before
+    finally:
+        rec.stop()
+    pm = json.loads((tmp_path / "postmortem.json").read_text())
+    for field in POSTMORTEM_SCHEMA:
+        assert field in pm
+    assert pm["reason"] == "exception"
+    assert pm["exception"]["type"] == "RuntimeError"
+    assert any(r.get("path") == "doomed" for r in pm["ring"])
+    assert pm["metrics"]["sweep.realizations"][0]["value"] == 64
+
+
+SIGTERM_CHILD = r"""
+import sys, time
+from pta_replicator_tpu import obs
+obs.start_capture(sys.argv[1], heartbeat_interval_s=0.02)
+with obs.span("realize"):
+    with obs.span("compute"):
+        obs.gauge("sweep.chunks_total").set(50)
+        for i in range(5000):
+            with obs.span("sweep_chunk", chunk=i):
+                time.sleep(0.005)
+            obs.gauge("sweep.chunks_done").set(i + 1)
+"""
+
+
+def test_postmortem_on_injected_sigterm(tmp_path):
+    """The acceptance rehearsal: SIGTERM a captured run mid-sweep, the
+    black box lands with the in-flight spans in ring + open stacks."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-c", SIGTERM_CHILD, str(tmp_path)],
+        env=env, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        assert _wait_for(
+            lambda: (tmp_path / "progress.json").exists(), timeout=30
+        ), child.stderr.read() if child.poll() is not None else "no heartbeat"
+        time.sleep(0.3)  # let some chunks land in the ring
+        child.send_signal(signal.SIGTERM)
+        rc = child.wait(timeout=15)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait()
+    assert rc == -signal.SIGTERM  # default disposition re-delivered
+    pm = json.loads((tmp_path / "postmortem.json").read_text())
+    assert pm["reason"] == "SIGTERM"
+    assert any(
+        r.get("path") == "realize/compute/sweep_chunk" for r in pm["ring"]
+    )
+    stacks = list(pm["heartbeat"]["open_spans"].values())
+    assert ["realize", "compute"] in [s[:2] for s in stacks]
+    # events.jsonl was flushed alongside the postmortem
+    assert "sweep_chunk" in (tmp_path / "events.jsonl").read_text()
+
+
+def test_finish_capture_writes_postmortem_on_exception(tmp_path):
+    with pytest.raises(RuntimeError):
+        obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)
+        try:
+            with obs.span("stage"):
+                raise RuntimeError("mid-run failure")
+        finally:
+            obs.finish_capture()
+    pm = json.loads((tmp_path / "postmortem.json").read_text())
+    assert pm["reason"] == "exception"
+    assert pm["exception"]["message"] == "mid-run failure"
+    # the normal capture artifacts were still written
+    assert (tmp_path / "metrics.json").exists()
+
+
+def test_recapture_clears_previous_runs_black_box(tmp_path):
+    """bench.py's OOM retry ladder reruns into the same telemetry dir:
+    the crashed attempt's postmortem/heartbeat must not make watch and
+    report misreport the healthy retry as dead."""
+    obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)
+    obs.flightrec.active().write_postmortem("exception",
+                                            exc=RuntimeError("oom"))
+    obs.finish_capture()
+    assert (tmp_path / "postmortem.json").exists()
+
+    obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)
+    assert not (tmp_path / "postmortem.json").exists()
+    assert not (tmp_path / "progress.json").exists()
+    from pta_replicator_tpu.obs.report import watch_progress
+
+    buf = io.StringIO()
+    assert watch_progress(str(tmp_path), once=True, file=buf) == 3
+    assert "postmortem" not in buf.getvalue()
+    obs.finish_capture()
+
+
+def test_clean_finish_leaves_no_postmortem(tmp_path):
+    obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)
+    with obs.span("stage"):
+        pass
+    obs.finish_capture()
+    assert not (tmp_path / "postmortem.json").exists()
+    hb = json.loads((tmp_path / "progress.json").read_text())
+    assert hb["finished"] is True
+
+
+# ------------------------------------------------- degraded report paths
+def test_report_no_data_and_corrupt_artifacts(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    main(["report", str(empty)])
+    out = capsys.readouterr().out
+    assert "no telemetry data" in out
+
+    # metrics.json truncated mid-write by a kill: degrade, don't raise
+    partial = tmp_path / "partial"
+    partial.mkdir()
+    (partial / "metrics.json").write_text('{"sweep.realizations": [{"k')
+    main(["report", str(partial)])
+    out = capsys.readouterr().out
+    assert "metrics.json: unreadable" in out
+
+    # metrics-only capture renders its metrics section
+    monly = tmp_path / "monly"
+    monly.mkdir()
+    (monly / "metrics.json").write_text(json.dumps(
+        {"sweep.realizations": [
+            {"kind": "counter", "labels": {}, "value": 5}
+        ]}
+    ))
+    main(["report", str(monly)])
+    assert "sweep.realizations = 5" in capsys.readouterr().out
+
+
+def test_finish_capture_without_start_is_noop():
+    assert obs.finish_capture() is None
+
+
+def test_postmortem_cli_without_postmortem(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    main(["postmortem", str(tmp_path)])
+    assert "no postmortem.json" in capsys.readouterr().out
+
+
+def test_watch_once_exit_codes(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+    from pta_replicator_tpu.obs.report import watch_progress
+
+    # nothing to read -> exit 3
+    with pytest.raises(SystemExit) as exc:
+        main(["watch", str(tmp_path), "--once"])
+    assert exc.value.code == 3
+    capsys.readouterr()
+
+    rec = FlightRecorder(str(tmp_path), interval_s=5.0,
+                         stall_timeout_s=None)
+    rec.write_heartbeat()
+    buf = io.StringIO()
+    assert watch_progress(str(tmp_path), once=True, file=buf) == 0
+    assert "idle" in buf.getvalue()
+
+    # a postmortem turns watch into exit 2 with a pointer
+    rec.write_postmortem("SIGTERM")
+    buf = io.StringIO()
+    assert watch_progress(str(tmp_path), once=True, file=buf) == 2
+    assert "postmortem" in buf.getvalue()
+
+
+def test_report_surfaces_stalls_and_postmortem(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    obs.start_capture(str(tmp_path), heartbeat_interval_s=0.02,
+                      stall_timeout_s=0.1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with obs.span("wedge"):
+            time.sleep(0.35)
+    rec = obs.flightrec.active()
+    rec.write_postmortem("SIGTERM")
+    rec.stop(finished=False)
+    obs.configure(None)
+
+    main(["report", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "STALLS" in out
+    assert "POSTMORTEM present" in out
+
+    main(["postmortem", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "reason: SIGTERM" in out
+    assert "final heartbeat" in out
+
+
+# ------------------------------------------------------ schema checker
+def test_schema_checker_validates_flightrec_artifacts(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+
+    # in-process sample generation (also runs in no-arg main())
+    for path, kind in checker.generate_flightrec_sample(str(tmp_path)):
+        assert checker.validate_flightrec_file(path, kind) == []
+
+    # a progress.json missing required fields is flagged
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "progress.json").write_text('{"schema": 1}')
+    (bad / "events.jsonl").write_text('{"type": "meta", "schema": 1, '
+                                      '"t0": 1.0}\n')
+    assert checker.main([str(bad)]) == 1
+
+
+# ----------------------------------------------------------- bench-diff
+def _bench_doc(value, elapsed, extra=None):
+    doc = {
+        "metric": "realizations/s", "value": value,
+        "unit": "realizations/s", "schema_version": 2,
+        "git_rev": "abc1234",
+        "platform": {"python": "3.11", "os": "linux"},
+        "measure_elapsed_s": elapsed,
+    }
+    doc.update(extra or {})
+    return doc
+
+
+def test_bench_diff_verdicts_on_synthetic_regression(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_doc(1000.0, 4.0)))
+    # value -30% (regression), elapsed +40% (regression for a duration)
+    b.write_text(json.dumps(_bench_doc(700.0, 5.6)))
+    table, summary, rc = bench_diff([str(a), str(b)], threshold=0.10)
+    assert rc == 1
+    assert summary["verdicts"]["value"] == "regressed"
+    assert summary["verdicts"]["measure_elapsed_s"] == "regressed"
+    assert "regressed" in table
+
+    # improvement: faster rate, shorter elapsed -> rc 0
+    b.write_text(json.dumps(_bench_doc(1500.0, 2.6)))
+    _table, summary, rc = bench_diff([str(a), str(b)], threshold=0.10)
+    assert rc == 0
+    assert summary["verdicts"]["value"] == "improved"
+    assert summary["verdicts"]["measure_elapsed_s"] == "improved"
+
+    # within the warn band (6% with threshold 10%): warn, still rc 0
+    b.write_text(json.dumps(_bench_doc(940.0, 4.0)))
+    _table, summary, rc = bench_diff([str(a), str(b)], threshold=0.10)
+    assert rc == 0
+    assert summary["verdicts"]["value"] == "warn"
+    assert summary["verdicts"]["measure_elapsed_s"] == "ok"
+
+
+def test_bench_diff_unwraps_driver_shape_and_null_parsed(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({
+        "n": 2, "cmd": "python bench.py", "rc": 0, "tail": "...",
+        "parsed": _bench_doc(1000.0, 4.0),
+    }))
+    b.write_text(json.dumps(_bench_doc(500.0, 8.0)))
+    _table, summary, rc = bench_diff([str(a), str(b)], threshold=0.10)
+    assert rc == 1 and summary["verdicts"]["value"] == "regressed"
+
+    # a round whose parsed is null (chip unreachable): degrade, exit 2
+    a.write_text(json.dumps({"n": 1, "cmd": "x", "rc": 1,
+                             "tail": "err", "parsed": None}))
+    table, summary, rc = bench_diff([str(a), str(b)])
+    assert rc == 2 and summary["comparable"] == 0
+    assert "nothing comparable" in table
+
+
+def test_bench_diff_refuses_newer_schema(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_doc(1.0, 1.0)))
+    b.write_text(json.dumps(
+        _bench_doc(1.0, 1.0, {"schema_version": 99})
+    ))
+    with pytest.raises(SchemaMismatch, match="schema_version 99"):
+        bench_diff([str(a), str(b)])
+
+
+def test_bench_diff_cli_exit_codes(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_bench_doc(1000.0, 4.0)))
+    b.write_text(json.dumps(_bench_doc(100.0, 40.0)))
+    with pytest.raises(SystemExit) as exc:
+        main(["bench-diff", str(a), str(b)])
+    assert exc.value.code == 1
+    assert "regressed" in capsys.readouterr().out
+
+    b.write_text(json.dumps(_bench_doc(1001.0, 3.99)))
+    main(["bench-diff", str(a), str(b)])  # no regression: returns None
+    assert "0 regressed" in capsys.readouterr().out
+
+
+def test_flatten_and_direction_classification():
+    flat = flatten_metrics({
+        "value": 2.0,
+        "schema_version": 2,            # provenance: skipped
+        "timestamp": "2026-01-01",      # skipped
+        "platform": {"python": "3.11"},  # skipped prefix
+        "ok_flag": True,                 # bool: skipped
+        "telemetry": {"spans": {"measure": {"total_s": 0.5, "calls": 1}}},
+    })
+    assert flat["value"] == 2.0
+    assert flat["telemetry.spans.measure.total_s"] == 0.5
+    assert "schema_version" not in flat
+    assert "platform.python" not in flat
+    assert "ok_flag" not in flat
+
+    assert metric_direction("value") is True
+    assert metric_direction("speedup_vs_cpu_oracle") is True
+    # throughput names end in _s too — they must NOT read as durations
+    # (that would invert the gate: a collapse would report "improved")
+    assert metric_direction("cpu_oracle_real_per_s") is True
+    assert metric_direction("achieved_tflops_per_s") is True
+    assert metric_direction("rate_real_per_s") is True
+    assert metric_direction("measure_elapsed_s") is False
+    assert metric_direction("cgw_scan_ms") is False
+    assert metric_direction("telemetry.spans.measure.total_s") is False
+    assert metric_direction("bench_chunk") is None
+
+    from pta_replicator_tpu.obs.regress import classify
+
+    # a halved throughput is a regression even though the name ends _s
+    verdict, rel = classify(10.0, 5.0,
+                            metric_direction("rate_real_per_s"), 0.10)
+    assert verdict == "regressed" and rel == -0.5
