@@ -6,10 +6,10 @@ import json, os, sys, threading, time
 import numpy as np
 
 OUT = "/root/repo/BENCH_CAPTURE_r05.jsonl"
-T0 = time.time()
+T0 = time.monotonic()
 
 def log(msg):
-    print(f"[{time.time()-T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+    print(f"[{time.monotonic()-T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 def emit(rec):
     """Append the timestamped record to the capture journal; returns the
@@ -29,20 +29,23 @@ def emit(rec):
 # every stage arms its own deadline; a wedged stage exits fast and the
 # outer loop re-probes on its short cadence instead of waiting out the
 # 2400 s kill
-_deadline = [time.time() + 180.0]
+_deadline = [time.monotonic() + 180.0]
 _exit_code = [3]
 def _watchdog():
     while True:
         time.sleep(5.0)
-        if time.time() > _deadline[0]:
+        if time.monotonic() > _deadline[0]:
             log(f"stage wedged past its deadline, exiting {_exit_code[0]}")
             os._exit(_exit_code[0])
 threading.Thread(target=_watchdog, daemon=True).start()
 
 def arm(seconds, code=5):
     """(Re)arm the watchdog for the next stage."""
-    _deadline[0] = time.time() + seconds
-    _exit_code[0] = code
+    # single-writer heartbeat: the main thread stores, the watchdog only
+    # reads, and the 5 s poll dwarfs any torn-read window (GIL-atomic
+    # list-item stores) — a lock here could itself wedge a dying stage
+    _deadline[0] = time.monotonic() + seconds  # graftlint: disable=thread-unlocked-global
+    _exit_code[0] = code  # graftlint: disable=thread-unlocked-global
 
 os.makedirs("/root/repo/.jax_cache", exist_ok=True)
 import jax
@@ -74,7 +77,7 @@ from pta_replicator_tpu.models.batched import (
     quadratic_fit_subtract, realization_delays,
 )
 
-t = time.time()
+t = time.monotonic()
 # with_fingerprint: hashed from the build's HOST numpy draws, so the
 # cache check below costs zero device readbacks through the tunnel
 batch, recipe, want_fp = build_workload(ncw=100, with_fingerprint=True)
@@ -104,9 +107,9 @@ if os.path.exists(_npz):
             log(f"stale workload cache {cand.shape}/{cand.dtype}, recomputing")
     except Exception as exc:  # truncated/corrupt file: fall back, don't die
         log(f"unreadable workload cache ({exc!r}), recomputing")
-log(f"workload built {time.time()-t:.1f}s (static cached: {static_np is not None})")
+log(f"workload built {time.monotonic()-t:.1f}s (static cached: {static_np is not None})")
 
-t = time.time()
+t = time.monotonic()
 batch = jax.device_put(batch)
 if static_np is not None:
     static = jax.device_put(jnp.asarray(static_np))
@@ -114,8 +117,8 @@ else:
     from pta_replicator_tpu.models.batched import deterministic_delays
     static = deterministic_delays(batch, recipe)
 np.asarray(static)
-log(f"static ready + fence {time.time()-t:.1f}s")
-emit({**META, "stage": "device_ready", "setup_s": round(time.time()-T0, 1)})
+log(f"static ready + fence {time.monotonic()-t:.1f}s")
+emit({**META, "stage": "device_ready", "setup_s": round(time.monotonic()-T0, 1)})
 
 
 def make_chunk_fn(chunk):
@@ -157,15 +160,15 @@ def write_preview(rec, path=_PREVIEW):
 
 def measure(chunk, nrep, tag, budget=600):
     arm(budget)
-    t = time.time()
+    t = time.monotonic()
     compiled = make_chunk_fn(chunk).lower(
         jax.random.PRNGKey(0), static).compile()
-    compile_s = time.time() - t
+    compile_s = time.monotonic() - t
     log(f"{tag}: compiled in {compile_s:.1f}s")
-    t = time.time()
+    t = time.monotonic()
     out = compiled(jax.random.PRNGKey(0), static)
     np.asarray(out)
-    warm_s = time.time() - t
+    warm_s = time.monotonic() - t
     t0 = time.perf_counter()
     for i in range(nrep):
         out = compiled(jax.random.PRNGKey(i + 1), static)
@@ -244,9 +247,9 @@ def measure_fit(chunk, nrep, mode, tag, kcols=166):
         return jnp.sqrt(jnp.sum(res**2 * batch.mask, axis=-1)
                         / jnp.sum(batch.mask, axis=-1))
 
-    t = time.time()
+    t = time.monotonic()
     compiled = run_chunk.lower(jax.random.PRNGKey(0), static).compile()
-    compile_s = time.time() - t
+    compile_s = time.monotonic() - t
     log(f"{tag}: compiled in {compile_s:.1f}s")
     out = compiled(jax.random.PRNGKey(0), static)
     np.asarray(out)
@@ -290,9 +293,9 @@ try:
     fn = jax.jit(lambda eps: B.cgw_catalog_delays(
         batch, *args8, chunk=recipe.cgw_chunk, backend="scan") + eps)
     zero = jnp.zeros((), batch.toas_s.dtype)
-    t = time.time()
+    t = time.monotonic()
     np.asarray(fn(zero))
-    log(f"cw scan compile+run {time.time()-t:.1f}s")
+    log(f"cw scan compile+run {time.monotonic()-t:.1f}s")
     t0 = time.perf_counter()
     for _ in range(10):
         out = fn(zero)

@@ -23,7 +23,7 @@ jax.config.update("jax_platforms", "cpu")
 from bench import build_workload  # noqa: E402
 from pta_replicator_tpu.models.batched import deterministic_delays  # noqa: E402
 
-t = time.time()
+t = time.monotonic()
 # the fingerprint binds the cache to THIS workload definition (build
 # params, host draw bytes, STREAM_VERSION): fast_capture verifies it
 # before reuse, so a plane serialized from an older workload can never
@@ -36,4 +36,4 @@ tmp = "/tmp/workload.tmp.npz"  # np.savez appends .npz to other suffixes
 np.savez(tmp, static=static, fingerprint=np.array(fp))
 os.replace(tmp, "/tmp/workload.npz")
 print(f"wrote /tmp/workload.npz {static.shape} {static.dtype} "
-      f"fp={fp} in {time.time()-t:.1f}s")
+      f"fp={fp} in {time.monotonic()-t:.1f}s")

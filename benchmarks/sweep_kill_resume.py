@@ -100,9 +100,9 @@ def main():
                              stderr=subprocess.STDOUT, text=True)
     # SIGKILL once >= 1/3 of the chunk files exist (and the run is
     # provably mid-flight, not finished)
-    deadline = time.time() + 3600
+    deadline = time.monotonic() + 3600
     killed_at = None
-    while time.time() < deadline:
+    while time.monotonic() < deadline:
         nfiles = len(glob.glob(ckpt_b + ".chunk*.npy"))
         if nfiles >= max(1, nchunks // 3) and nfiles < nchunks:
             child.send_signal(signal.SIGKILL)

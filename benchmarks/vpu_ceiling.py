@@ -121,7 +121,7 @@ def main():
     cosj = jnp.asarray(cosm, dtype)
     sinj = jnp.asarray(sinm, dtype)
     Bm = 16
-    re = jax.random.normal(key, (Bm, npsr, nf), dtype)
+    re = jax.random.normal(jax.random.fold_in(key, 1), (Bm, npsr, nf), dtype)
     im = jax.random.normal(jax.random.PRNGKey(2), (Bm, npsr, nf), dtype)
 
     @jax.jit
@@ -138,7 +138,7 @@ def main():
     # ---- interp gathers (GWB grid -> TOA times) -------------------------
     from pta_replicator_tpu.models.batched import uniform_grid_interp
 
-    series = jax.random.normal(key, (Bm, npsr, npts), dtype)
+    series = jax.random.normal(jax.random.fold_in(key, 2), (Bm, npsr, npts), dtype)
     tq = jnp.broadcast_to(batch.toas_s, (Bm, npsr, ntoa))
     interp = jax.jit(
         lambda s: uniform_grid_interp(

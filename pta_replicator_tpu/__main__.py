@@ -76,8 +76,22 @@ def _build_recipe(spec: dict, psrs):
 
 
 def main(argv=None):
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv[:1] == ["lint"]:
+        # graftlint is jax-free and must stay fast: bypass the argparse
+        # tree (and the --platform plumbing) entirely
+        from .analysis.cli import main as lint_main
+
+        rc = lint_main(argv[1:])
+        if rc:
+            raise SystemExit(rc)
+        return
+
     ap = argparse.ArgumentParser(prog="python -m pta_replicator_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser(
+        "lint", help="graftlint: static JAX/thread/telemetry invariant "
+                     "checker (see `lint --help`)")
 
     for name in ("realize", "info"):
         p = sub.add_parser(name)
@@ -233,9 +247,9 @@ def main(argv=None):
 
 def _run_command(args):
     from . import load_from_directories, make_ideal
-    from .obs import span
+    from .obs import names, span
 
-    with span("ingest", pardir=args.pardir):
+    with span(names.SPAN_INGEST, pardir=args.pardir):
         psrs = load_from_directories(args.pardir, args.timdir,
                                      num_psrs=args.num_psrs)
         for psr in psrs:
@@ -257,7 +271,7 @@ def _run_command(args):
 
     import jax
 
-    with span("build_recipe"), open(args.recipe) as fh:
+    with span(names.SPAN_BUILD_RECIPE), open(args.recipe) as fh:
         recipe = _build_recipe(json.load(fh), psrs)
     if args.gls_fit:
         args.full_fit = True
@@ -275,7 +289,7 @@ def _run_command(args):
         )
     key = jax.random.PRNGKey(args.seed)
 
-    with span("compute", nreal=args.nreal, fit=bool(args.fit)):
+    with span(names.SPAN_COMPUTE, nreal=args.nreal, fit=bool(args.fit)):
         if args.checkpoint:
             from .utils.sweep import sweep
 
@@ -311,7 +325,7 @@ def _run_command(args):
             out = np.asarray(realize(key, batch, recipe, nreal=args.nreal,
                                      fit=args.fit))
 
-    with span("write_output", out=args.out):
+    with span(names.SPAN_WRITE_OUTPUT, out=args.out):
         np.savez(args.out, residuals=out, mask=np.asarray(batch.mask),
                  names=np.array(batch.names))
     summary = {

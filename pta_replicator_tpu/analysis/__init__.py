@@ -1,0 +1,46 @@
+"""graftlint: static analysis of JAX/TPU, threading, and telemetry
+invariants.
+
+The codebase carries three classes of invariants that used to live only
+in reviewers' heads: JAX tracing/transfer discipline (no host syncs or
+f64 literals inside jit, no PRNG key reuse), thread/lock/clock
+discipline (locked mutation of shared state, monotonic clocks for
+durations, a recorded lock hierarchy), and telemetry naming (every
+span/metric name registered once in ``obs/names.py``). ``graftlint``
+enforces them on every PR:
+
+    python -m pta_replicator_tpu lint                 # whole tree
+    python -m pta_replicator_tpu lint --changed-only  # quick local loop
+    python -m pta_replicator_tpu lint --format json
+    python -m pta_replicator_tpu lint --update-baseline
+
+Layout: :mod:`.engine` (AST walk, findings, ``# graftlint:
+disable=<rule>`` suppressions, ``baseline.json`` ratchet),
+:mod:`.rules_jax`, :mod:`.rules_threads`, :mod:`.rules_telemetry` (the
+rule packs), :mod:`.cli` (the ``lint`` subcommand body). Everything is
+jax-free and import-cheap; the engine never imports the code it lints.
+
+Docs: docs/static-analysis.md (rule catalog with rationale, suppression
+and baseline workflow, how to add a rule).
+"""
+from __future__ import annotations
+
+from .engine import (
+    Finding,
+    Module,
+    Rule,
+    apply_baseline,
+    default_rules,
+    iter_python_files,
+    lint,
+    load_baseline,
+    parse_modules,
+    run_rules,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding", "Module", "Rule", "apply_baseline", "default_rules",
+    "iter_python_files", "lint", "load_baseline", "parse_modules",
+    "run_rules", "write_baseline",
+]

@@ -1,0 +1,147 @@
+"""The ``python -m pta_replicator_tpu lint`` subcommand body.
+
+Deliberately jax-free (the engine parses source, it never imports the
+linted code) so the lint gate stays fast enough for the tier-1 test
+path and pre-commit use. Exit codes: 0 clean (possibly with baselined/
+suppressed findings), 1 new findings, 2 usage/internal error.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from .engine import lint, write_baseline
+
+#: default lint targets, relative to the repo root (missing entries are
+#: skipped so an installed package without the repo harness still lints)
+DEFAULT_TARGETS = (
+    "pta_replicator_tpu",
+    "scripts",
+    "benchmarks",
+    "bench.py",
+)
+
+
+def repo_root() -> str:
+    """The directory containing the package (the repo checkout root)."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg_dir)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def run_lint(
+    paths: Sequence[str],
+    fmt: str = "text",
+    baseline: Optional[str] = None,
+    update_baseline: bool = False,
+    changed_only: bool = False,
+    root: Optional[str] = None,
+    out=None,
+) -> int:
+    out = out if out is not None else sys.stdout
+    if update_baseline and changed_only:
+        # a baseline written from a filtered file set would silently
+        # DROP every grandfathered entry for unchanged files
+        raise ValueError(
+            "--update-baseline needs the full finding set; it cannot be "
+            "combined with --changed-only"
+        )
+    root = root or repo_root()
+    if not paths:
+        paths = [p for p in DEFAULT_TARGETS
+                 if os.path.exists(os.path.join(root, p))]
+    baseline = baseline if baseline is not None else default_baseline_path()
+
+    result = lint(
+        paths, root, baseline_path=None if update_baseline else baseline,
+        changed_only=changed_only,
+    )
+
+    if update_baseline:
+        findings = result["new"]  # baseline was not applied: all active
+        write_baseline(baseline, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {baseline}", file=out
+        )
+        return 0
+
+    if fmt == "json":
+        json.dump({
+            "files": result["files"],
+            "new": [f.to_json() for f in result["new"]],
+            "baselined": [f.to_json() for f in result["baselined"]],
+            "suppressed": [f.to_json() for f in result["suppressed"]],
+            "stale_baseline": result["stale"],
+            "exit_code": result["exit_code"],
+        }, out, indent=1, sort_keys=True)
+        out.write("\n")
+        return result["exit_code"]
+
+    if result["note"]:
+        print(f"note: {result['note']}", file=out)
+    for f in result["new"]:
+        print(f.format(), file=out)
+    for f in result["baselined"]:
+        print(f"{f.format()}  (baselined)", file=out)
+    for entry in result["stale"]:
+        print(
+            f"stale baseline entry (finding fixed — remove it): "
+            f"{entry.get('rule')} {entry.get('path')}: "
+            f"{entry.get('message')}", file=out,
+        )
+    print(
+        f"graftlint: {result['files']} file(s), "
+        f"{len(result['new'])} new, "
+        f"{len(result['baselined'])} baselined, "
+        f"{len(result['suppressed'])} suppressed"
+        + (f", {len(result['stale'])} stale baseline entr"
+           f"{'y' if len(result['stale']) == 1 else 'ies'}"
+           if result["stale"] else ""),
+        file=out,
+    )
+    return result["exit_code"]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m pta_replicator_tpu lint",
+        description="graftlint: JAX/thread/telemetry invariant checker",
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to lint (default: the "
+                         "package, scripts/, benchmarks/, bench.py)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help="baseline JSON (default: "
+                         "pta_replicator_tpu/analysis/baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline with every current "
+                         "finding and exit 0 (use sparingly: the "
+                         "baseline is a ratchet, not a dumping ground)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only files differing from main "
+                         "(plus uncommitted work) for quick iteration")
+    args = ap.parse_args(argv)
+    try:
+        return run_lint(
+            args.paths,
+            fmt=args.format,
+            baseline=args.baseline,
+            update_baseline=args.update_baseline,
+            changed_only=args.changed_only,
+        )
+    except (OSError, ValueError) as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,392 @@
+"""graftlint rule engine: AST walk, findings, suppressions, baseline.
+
+The engine is deliberately dumb and fast: it parses every target file
+once (``ast`` + ``tokenize``, no imports of the linted code, no jax),
+hands each :class:`Module` to every rule, and post-processes the
+findings through two escape hatches:
+
+* **inline suppression** — a ``# graftlint: disable=<rule>[,<rule>...]``
+  comment suppresses findings of those rules *on that line* (``all``
+  suppresses every rule). Suppressions are for findings that are
+  *intentional* — the comment is the reviewer-visible record of why.
+* **baseline** — ``analysis/baseline.json`` holds fingerprints of
+  grandfathered findings so the gate starts green on a tree with known
+  debt and ratchets: a finding in the baseline is reported as
+  "baselined", a finding NOT in the baseline fails the run. Fingerprints
+  hash (rule, path, message) — not line numbers — so unrelated edits
+  above a grandfathered finding don't break the gate.
+
+Rules subclass :class:`Rule` and implement ``check_module`` (per-file)
+or ``check_project`` (cross-file, e.g. instrumentation coverage). Rule
+ids are kebab-case strings namespaced by pack (``jax-host-sync``,
+``thread-walltime-duration``, ``telemetry-unknown-name``).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+BASELINE_VERSION = 1
+
+_SUPPRESS_RE = re.compile(r"graftlint:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to ``path:line``."""
+
+    rule: str
+    severity: str
+    path: str  # posix relpath from the lint root
+    line: int
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        """Baseline identity: stable under edits that only move lines."""
+        key = f"{self.rule}|{self.path}|{self.message}"
+        return hashlib.sha256(key.encode()).hexdigest()[:16]
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.severity}] " \
+               f"{self.message}"
+
+    def to_json(self) -> dict:
+        return {**dataclasses.asdict(self), "fingerprint": self.fingerprint}
+
+
+class Module:
+    """A parsed lint target: source, AST, parent links, import aliases,
+    and the per-line suppression table."""
+
+    def __init__(self, path: str, root: str, source: Optional[str] = None):
+        self.path = os.path.abspath(path)
+        self.relpath = os.path.relpath(self.path, os.path.abspath(root))
+        self.relpath = self.relpath.replace(os.sep, "/")
+        if source is None:
+            with open(self.path, encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.relpath)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.imports = self._import_aliases()
+        self.suppressions = self._suppressions()
+
+    # -- suppressions ---------------------------------------------------
+    def _suppressions(self) -> Dict[int, set]:
+        table: Dict[int, set] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                    table.setdefault(tok.start[0], set()).update(rules)
+        except tokenize.TokenizeError:
+            pass
+        return table
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        rules = self.suppressions.get(finding.line)
+        return bool(rules) and (finding.rule in rules or "all" in rules)
+
+    # -- import / name resolution ---------------------------------------
+    def _import_aliases(self) -> Dict[str, str]:
+        """Local name -> dotted origin. Relative imports are resolved
+        with leading dots stripped (``from ..obs import span`` maps
+        ``span`` -> ``obs.span``) — rules match with suffix checks, so
+        the exact package prefix doesn't matter."""
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    origin = f"{base}.{a.name}" if base else a.name
+                    aliases[a.asname or a.name] = origin
+        return aliases
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Dotted source text of a Name/Attribute chain (``jax.random.split``),
+        or None for anything more dynamic."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """:meth:`qualname` with the head rewritten through the module's
+        import aliases: ``jnp.float64`` -> ``jax.numpy.float64``,
+        ``_traced`` -> ``obs.trace.traced``."""
+        qn = self.qualname(node)
+        if qn is None:
+            return None
+        head, _, rest = qn.partition(".")
+        origin = self.imports.get(head)
+        if origin is None:
+            return qn
+        return f"{origin}.{rest}" if rest else origin
+
+    def ancestors(self, node: ast.AST):
+        node = self.parents.get(node)
+        while node is not None:
+            yield node
+            node = self.parents.get(node)
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``severity``/``description`` and
+    override one of the check hooks."""
+
+    id: str = "abstract"
+    severity: str = SEVERITY_ERROR
+    description: str = ""
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, mod_or_path, line: int, message: str) -> Finding:
+        path = (
+            mod_or_path.relpath if isinstance(mod_or_path, Module)
+            else str(mod_or_path)
+        )
+        return Finding(self.id, self.severity, path, line, message)
+
+
+def default_rules() -> List[Rule]:
+    """The shipped rule packs (imported lazily to avoid cycles)."""
+    from . import rules_jax, rules_telemetry, rules_threads
+
+    return [
+        *rules_jax.RULES,
+        *rules_threads.RULES,
+        *rules_telemetry.RULES,
+    ]
+
+
+# ---------------------------------------------------------- file walking
+_SKIP_DIRS = {
+    ".git", "__pycache__", ".pytest_cache", "build", "dist", ".eggs",
+    "node_modules",
+}
+
+
+def iter_python_files(paths: Sequence[str], root: str) -> List[str]:
+    """Expand files/directories into a sorted, de-duplicated .py list."""
+    out = []
+    seen = set()
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p):
+            candidates = [p]
+        elif os.path.isdir(p):
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames if d not in _SKIP_DIRS
+                )
+                candidates.extend(
+                    os.path.join(dirpath, f)
+                    for f in sorted(filenames) if f.endswith(".py")
+                )
+        else:
+            continue
+        for c in candidates:
+            c = os.path.abspath(c)
+            if c.endswith(".py") and c not in seen:
+                seen.add(c)
+                out.append(c)
+    return out
+
+
+def parse_modules(
+    files: Sequence[str], root: str
+) -> Tuple[List[Module], List[Finding]]:
+    """Parse every file; a syntax error becomes a finding, not a crash
+    (the linter must be able to report on a broken tree)."""
+    mods, problems = [], []
+    for path in files:
+        try:
+            mods.append(Module(path, root))
+        except SyntaxError as exc:
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            problems.append(Finding(
+                "syntax-error", SEVERITY_ERROR, rel, exc.lineno or 1,
+                f"cannot parse: {exc.msg}",
+            ))
+    return mods, problems
+
+
+def run_rules(
+    mods: Sequence[Module], rules: Optional[Sequence[Rule]] = None
+) -> Tuple[List[Finding], List[Finding]]:
+    """Run every rule; returns (active findings, suppressed findings),
+    both sorted by (path, line, rule)."""
+    rules = list(rules) if rules is not None else default_rules()
+    by_rel = {m.relpath: m for m in mods}
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule in rules:
+        collected: List[Finding] = []
+        for mod in mods:
+            collected.extend(rule.check_module(mod))
+        collected.extend(rule.check_project(mods))
+        for f in collected:
+            mod = by_rel.get(f.path)
+            if mod is not None and mod.is_suppressed(f):
+                suppressed.append(f)
+            else:
+                active.append(f)
+    key = lambda f: (f.path, f.line, f.rule, f.message)  # noqa: E731
+    return sorted(active, key=key), sorted(suppressed, key=key)
+
+
+# -------------------------------------------------------------- baseline
+def load_baseline(path: Optional[str]) -> Dict[str, dict]:
+    """fingerprint -> baseline entry; {} for a missing/empty baseline."""
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: baseline version {doc.get('version')!r} != "
+            f"{BASELINE_VERSION} (regenerate with --update-baseline)"
+        )
+    return {e["fingerprint"]: e for e in doc.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    doc = {
+        "version": BASELINE_VERSION,
+        "comment": (
+            "grandfathered graftlint findings — keep SMALL; new code "
+            "must lint clean or carry an inline suppression with a "
+            "reason. Regenerate with: python -m pta_replicator_tpu "
+            "lint --update-baseline"
+        ),
+        "findings": [f.to_json() for f in findings],
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, dict]
+) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Split into (new, grandfathered) and report stale baseline entries
+    (fixed findings that should be dropped from the baseline — they are
+    a warning, not a failure, so fixing debt never blocks a PR)."""
+    new, old = [], []
+    seen = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            old.append(f)
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+    return new, old, stale
+
+
+# ----------------------------------------------------------- change scope
+def filter_changed(files: Sequence[str], changed: Sequence[str],
+                   root: str) -> List[str]:
+    """Restrict ``files`` to those named in ``changed`` (repo-relative
+    paths, as ``git diff --name-only`` prints them)."""
+    changed_abs = {
+        os.path.abspath(os.path.join(root, c)) for c in changed
+    }
+    return [f for f in files if os.path.abspath(f) in changed_abs]
+
+
+def git_changed_files(root: str, base: str = "main") -> Optional[List[str]]:
+    """Files differing from ``base`` plus uncommitted/untracked work.
+    None when git is unavailable (callers then lint everything)."""
+    import subprocess
+
+    def _git(*args):
+        return subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True,
+            timeout=30,
+        )
+
+    changed = set()
+    diff = _git("diff", "--name-only", f"{base}...HEAD")
+    if diff.returncode != 0:
+        # shallow clone or detached base: fall back to plain HEAD diff
+        diff = _git("diff", "--name-only", "HEAD")
+        if diff.returncode != 0:
+            return None
+    changed.update(line for line in diff.stdout.splitlines() if line)
+    status = _git("status", "--porcelain")
+    if status.returncode == 0:
+        for line in status.stdout.splitlines():
+            if len(line) > 3:
+                changed.add(line[3:].split(" -> ")[-1].strip())
+    return sorted(changed)
+
+
+# ------------------------------------------------------------- top level
+def lint(
+    paths: Sequence[str],
+    root: str,
+    rules: Optional[Sequence[Rule]] = None,
+    baseline_path: Optional[str] = None,
+    changed_only: bool = False,
+) -> dict:
+    """Run the engine end to end; returns a result dict with keys
+    ``new`` / ``baselined`` / ``suppressed`` (Finding lists), ``stale``
+    (baseline entries), ``files`` (count), and ``exit_code``."""
+    files = iter_python_files(paths, root)
+    note = None
+    if changed_only:
+        changed = git_changed_files(root)
+        if changed is None:
+            note = "--changed-only: git unavailable, linting everything"
+        else:
+            files = filter_changed(files, changed, root)
+    mods, parse_problems = parse_modules(files, root)
+    active, suppressed = run_rules(mods, rules)
+    active = parse_problems + active
+    baseline = load_baseline(baseline_path)
+    new, old, stale = apply_baseline(active, baseline)
+    return {
+        "new": new,
+        "baselined": old,
+        "suppressed": suppressed,
+        "stale": stale,
+        "files": len(files),
+        "note": note,
+        "exit_code": 1 if new else 0,
+    }

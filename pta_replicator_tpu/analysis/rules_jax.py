@@ -1,0 +1,360 @@
+"""graftlint rule pack: JAX tracing/transfer discipline.
+
+The invariants PR 1's ``instrumented_jit`` accounting and PR 2's
+pipelined sweep exist to protect, enforced statically:
+
+* ``jax-host-sync`` — a host-device synchronization primitive
+  (``.block_until_ready()``, ``np.asarray``/``np.array``, ``.item()``,
+  ``float(x)``) inside a jit-traced function. At trace time these either
+  fail outright (tracers aren't concrete) or silently fence the device
+  pipeline on every call — the exact stall the pipelined executor was
+  built to hide. Syncs belong on the host side of the jit boundary (the
+  reader thread's explicit ``readback_fence``/``drain``).
+* ``jax-f64-literal`` — a ``float64`` dtype literal in jit-traced code.
+  The device path is float32-disciplined (tests/test_f32.py); f64 host
+  *pre*computes are fine (and are why ``io/``/``timing/`` are exempt
+  wholesale), but an f64 literal inside a traced function doubles
+  memory/VPU cost on TPU or silently downcasts under x64-disabled jax.
+* ``jax-key-reuse`` — the same PRNG key variable consumed by two
+  ``jax.random`` calls with no intervening ``split``/``fold_in``
+  rebinding: the two draws are perfectly correlated. (The sweep's
+  fold_in-per-chunk key ledger depends on never reusing a key.)
+* ``jax-global-closure`` — a jit-traced function reads a module-level
+  mutable object. jit captures it by value AT TRACE TIME: later mutation
+  is silently ignored (stale constants baked into the executable) — or
+  worse, triggers retrace-per-call when used as a static argument.
+
+Detection of "jit-traced" covers decorator forms (``@jax.jit``,
+``@instrumented_jit(...)``, ``@partial(jax.jit, ...)``) and wrapper
+forms (``instrumented_jit(run, ...)``, ``jax.jit(traced)``, including a
+function passed through ``shard_map`` into a jit call) — the idioms
+models/batched.py and parallel/mesh.py actually use.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from .engine import Finding, Module, Rule
+
+#: callables that jit-compile their (first) argument / decorated function
+_JIT_NAMES = {"jit", "instrumented_jit"}
+
+#: jax.random functions that CONSUME a key argument
+_KEY_CONSUMERS_PREFIX = "jax.random."
+#: jax.random functions whose ASSIGNMENT refreshes a key variable
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "clone"}
+
+#: device-path exemptions for the f64 rule: host-precision subsystems
+#: where float64 is the point (par/tim parsing, timing-model oracles)
+_F64_EXEMPT_PARTS = ("/io/", "/timing/")
+
+
+def _terminal(mod: Module, func: ast.AST) -> str:
+    resolved = mod.resolve(func)
+    return resolved.rsplit(".", 1)[-1] if resolved else ""
+
+
+def _is_jitlike_callable(mod: Module, func: ast.AST) -> bool:
+    name = _terminal(mod, func)
+    if name in _JIT_NAMES:
+        return True
+    # functools.partial(jax.jit, ...) used as a decorator factory
+    if name == "partial":
+        return False  # handled at the Call level by _decorator_is_jit
+    return False
+
+
+def _decorator_is_jit(mod: Module, dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        if _is_jitlike_callable(mod, dec.func):
+            return True
+        if _terminal(mod, dec.func) == "partial" and dec.args:
+            return _is_jitlike_callable(mod, dec.args[0])
+        return False
+    return _is_jitlike_callable(mod, dec)
+
+
+def jit_function_nodes(mod: Module) -> List[ast.FunctionDef]:
+    """Every function def in the module that ends up jit-compiled:
+    decorated with a jit form, or passed (possibly through nested calls,
+    e.g. ``instrumented_jit(shard_map(local, ...))``) into a jit call."""
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    jitted: List[ast.FunctionDef] = []
+    seen: Set[ast.AST] = set()
+
+    def mark(fn: ast.FunctionDef) -> None:
+        if fn not in seen:
+            seen.add(fn)
+            jitted.append(fn)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_decorator_is_jit(mod, d) for d in node.decorator_list):
+                mark(node)
+        elif isinstance(node, ast.Call) and _is_jitlike_callable(
+            mod, node.func
+        ):
+            if not node.args:
+                continue
+            # names referenced anywhere inside the first argument: covers
+            # jax.jit(f), instrumented_jit(shard_map(f, ...), ...)
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Name) and sub.id in defs:
+                    for fn in defs[sub.id]:
+                        mark(fn)
+    return jitted
+
+
+def _module_level_mutables(mod: Module) -> Dict[str, int]:
+    """name -> lineno of module-level bindings to mutable containers."""
+    out: Dict[str, int] = {}
+    mutable_ctors = {
+        "list", "dict", "set", "defaultdict", "deque", "OrderedDict",
+        "Counter", "bytearray",
+    }
+    for stmt in mod.tree.body:
+        targets = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            continue
+        is_mutable = isinstance(
+            value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                    ast.DictComp, ast.SetComp)
+        ) or (
+            isinstance(value, ast.Call)
+            and _terminal(mod, value.func) in mutable_ctors
+        )
+        if not is_mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = stmt.lineno
+    return out
+
+
+class HostSyncInJit(Rule):
+    id = "jax-host-sync"
+    severity = "error"
+    description = (
+        "host-device sync (.block_until_ready()/np.asarray/.item()/"
+        "float()) inside a jit-traced function"
+    )
+
+    _SYNC_ATTRS = {"block_until_ready", "item"}
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        for fn in jit_function_nodes(mod):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if isinstance(func, ast.Attribute):
+                    if func.attr in self._SYNC_ATTRS:
+                        yield self.finding(
+                            mod, node.lineno,
+                            f".{func.attr}() inside jit-traced "
+                            f"{fn.name!r}: forces a host sync per call "
+                            "(fence outside the jit boundary instead)",
+                        )
+                        continue
+                    resolved = mod.resolve(func) or ""
+                    if resolved.startswith("numpy.") and func.attr in (
+                        "asarray", "array",
+                    ):
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"np.{func.attr}() inside jit-traced "
+                            f"{fn.name!r}: pulls the tracer to host "
+                            "(use jnp, or hoist the conversion out of "
+                            "the jit)",
+                        )
+                elif isinstance(func, ast.Name) and func.id == "float":
+                    if node.args and not isinstance(
+                        node.args[0], ast.Constant
+                    ):
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"float(...) inside jit-traced {fn.name!r}: "
+                            "concretizes a tracer (host sync); keep it "
+                            "an array or move the cast outside the jit",
+                        )
+
+
+class F64LiteralInJit(Rule):
+    id = "jax-f64-literal"
+    severity = "error"
+    description = (
+        "float64 dtype literal in jit-traced device code (f32 "
+        "discipline; io/ and timing/ host-precision modules exempt)"
+    )
+
+    def _exempt(self, mod: Module) -> bool:
+        rel = "/" + mod.relpath
+        return any(part in rel for part in _F64_EXEMPT_PARTS)
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if self._exempt(mod):
+            return
+        jit_fns = jit_function_nodes(mod)
+        in_jit = {id(n) for fn in jit_fns for n in ast.walk(fn)}
+        for fn in jit_fns:
+            for node in ast.walk(fn):
+                hit = None
+                if isinstance(node, ast.Attribute) and \
+                        node.attr == "float64":
+                    hit = (mod.qualname(node) or "float64")
+                elif isinstance(node, ast.Constant) and \
+                        node.value == "float64":
+                    hit = '"float64"'
+                if hit:
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"{hit} inside jit-traced {fn.name!r}: device "
+                        "code is float32-disciplined (tests/test_f32.py)"
+                        " — do f64 precomputes on host, outside the jit",
+                    )
+        # jnp.float64 anywhere in a device-path module is a smell even
+        # outside jit: jax arrays built f64 flow straight to device
+        # (jit bodies were already reported above — don't double-count)
+        for node in ast.walk(mod.tree):
+            if id(node) in in_jit:
+                continue
+            if isinstance(node, ast.Attribute) and node.attr == "float64":
+                resolved = mod.resolve(node) or ""
+                if resolved.startswith("jax."):
+                    yield self.finding(
+                        mod, node.lineno,
+                        "jnp.float64 literal in a device-path module: "
+                        "build f64 data with numpy on host, cast at the "
+                        "jit boundary",
+                    )
+
+
+class KeyReuse(Rule):
+    id = "jax-key-reuse"
+    severity = "error"
+    description = (
+        "PRNG key consumed by two jax.random calls without an "
+        "intervening split/fold_in"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_fn(mod, node)
+
+    def _check_fn(self, mod: Module, fn) -> Iterable[Finding]:
+        # key variables: names (re)bound from jax.random key makers
+        key_vars: set = set()
+        events = []  # (lineno, col, kind, name, node)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                value = node.value
+                resolved = (
+                    mod.resolve(value.func)
+                    if isinstance(value, ast.Call) else None
+                ) or ""
+                is_maker = (
+                    resolved.startswith(_KEY_CONSUMERS_PREFIX)
+                    and resolved.rsplit(".", 1)[-1] in _KEY_MAKERS
+                )
+                for t in node.targets:
+                    names = []
+                    if isinstance(t, ast.Name):
+                        names = [t.id]
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names = [
+                            e.id for e in t.elts if isinstance(e, ast.Name)
+                        ]
+                    for name in names:
+                        if is_maker:
+                            key_vars.add(name)
+                        events.append(
+                            (node.lineno, node.col_offset, "assign",
+                             name, node)
+                        )
+            elif isinstance(node, ast.Call):
+                resolved = mod.resolve(node.func) or ""
+                if (
+                    resolved.startswith(_KEY_CONSUMERS_PREFIX)
+                    # split/fold_in DERIVE independent streams — only a
+                    # sampler (normal, uniform, bits, ...) consumes
+                    and resolved.rsplit(".", 1)[-1] not in _KEY_MAKERS
+                    and node.args
+                ):
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name):
+                        events.append(
+                            (node.lineno, node.col_offset, "consume",
+                             arg.id, node)
+                        )
+        consumed: dict = {}
+        for lineno, _col, kind, name, _node in sorted(
+            events, key=lambda e: (e[0], e[1])
+        ):
+            if kind == "assign":
+                consumed[name] = 0
+            elif name in key_vars:
+                consumed[name] = consumed.get(name, 0) + 1
+                if consumed[name] == 2:
+                    yield self.finding(
+                        mod, lineno,
+                        f"key {name!r} consumed twice in {fn.name!r} "
+                        "with no intervening split/fold_in: the two "
+                        "draws are identical/correlated",
+                    )
+
+
+class GlobalClosureInJit(Rule):
+    id = "jax-global-closure"
+    severity = "warning"
+    description = (
+        "jit-traced function reads a module-level mutable object "
+        "(captured by value at trace time; later mutation is ignored)"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        mutables = _module_level_mutables(mod)
+        if not mutables:
+            return
+        for fn in jit_function_nodes(mod):
+            reported: set = set()
+            # names that are local to the function shadow the global
+            local_names = {
+                a.arg for a in (
+                    fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs
+                )
+            }
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Store
+                ):
+                    local_names.add(node.id)
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in mutables
+                    and node.id not in local_names
+                    and node.id not in reported
+                ):
+                    reported.add(node.id)
+                    yield self.finding(
+                        mod, node.lineno,
+                        f"jit-traced {fn.name!r} reads module-level "
+                        f"mutable {node.id!r} (bound at line "
+                        f"{mutables[node.id]}): jit bakes its trace-time "
+                        "value into the executable",
+                    )
+
+
+RULES = [HostSyncInJit(), F64LiteralInJit(), KeyReuse(), GlobalClosureInJit()]

@@ -1,0 +1,308 @@
+"""graftlint rule pack: telemetry name discipline.
+
+``obs/names.py`` is the single registry of span/metric/event names; this
+pack closes the loop statically:
+
+* ``telemetry-unknown-name`` — every *literal* name passed to a
+  telemetry producer call (``span("freeze")``, ``counter("io.tim.toas")``,
+  ``event(...)``, ``traced(...)``, ``instrumented_jit(name=...)``) must
+  be registered in obs/names.py; a name referenced *symbolically*
+  (``gauge(names.SWEEP_CHUNKS_DONE)``) is verified to point at a real
+  constant. Either way, a misspelled or renamed name is a lint error —
+  not silent drift between a producer, the report renderer, the flight
+  recorder and the schema checker.
+* ``telemetry-coverage`` — the public pipeline entrypoints the telemetry
+  subsystem promises to instrument (the table formerly duplicated as
+  grep markers in ``scripts/check_telemetry_schema.py``) still carry
+  their spans/counters. Stripping or renaming instrumentation fails the
+  lint instead of silently un-instrumenting the pipeline. The rule is
+  AST-based, so it keeps working whether a producer uses the literal or
+  the names.py constant.
+
+Both rules skip test files (tests exercise private tracers with ad-hoc
+names by design). The coverage rule arms itself only when the lint root
+actually contains the names registry (``pta_replicator_tpu/obs/names.py``)
+— fixture trees in unit tests aren't the real package and must not
+produce a wall of "file missing" findings.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .engine import Finding, Module, Rule
+
+#: producer callables -> the kind of name their first argument carries
+_PRODUCER_KINDS = {
+    "span": "span",
+    "traced": "span",
+    "event": "event",
+    "counter": "metric",
+    "gauge": "metric",
+    "histogram": "metric",
+    "instrumented_jit": "jit",
+}
+
+#: relpath of the registry module — also the coverage rule's arming anchor
+NAMES_RELPATH = "pta_replicator_tpu/obs/names.py"
+
+
+def load_registry() -> dict:
+    """The real obs/names.py registry, shaped for the rules: kind ->
+    frozenset of names, plus dynamic prefixes and the constant map used
+    to validate symbolic references."""
+    from ..obs import names
+
+    constants = {
+        k: v for k, v in vars(names).items()
+        if k.isupper() and isinstance(v, str)
+    }
+    return {
+        "span": names.SPANS,
+        "event": names.EVENTS,
+        "metric": names.METRICS,
+        "jit": names.JIT_LABELS,
+        "prefixes": tuple(names.METRIC_PREFIXES),
+        "constants": constants,
+    }
+
+
+def _is_test_file(relpath: str) -> bool:
+    base = os.path.basename(relpath)
+    return (
+        "tests/" in relpath
+        or "examples/" in relpath
+        or base.startswith("test_")
+        or base == "conftest.py"
+    )
+
+
+def _producer_kind(mod: Module, call: ast.Call) -> Optional[str]:
+    resolved = mod.resolve(call.func)
+    if not resolved:
+        return None
+    return _PRODUCER_KINDS.get(resolved.rsplit(".", 1)[-1])
+
+
+def _name_expr(call: ast.Call, kind: str) -> Optional[ast.AST]:
+    if kind == "jit":
+        for kw in call.keywords:
+            if kw.arg == "name":
+                return kw.value
+        return None
+    return call.args[0] if call.args else None
+
+
+def _symbolic_constant(mod: Module, expr: ast.AST) -> Optional[str]:
+    """The names.py constant name a symbolic reference points at
+    (``names.SWEEP_CHUNKS_DONE`` or an imported ``SWEEP_CHUNKS_DONE``),
+    else None."""
+    resolved = mod.resolve(expr)
+    if not resolved:
+        return None
+    parts = resolved.split(".")
+    if len(parts) >= 2 and parts[-2] == "names":
+        return parts[-1]
+    return None
+
+
+def extract_names(
+    mod: Module, registry: dict
+) -> Tuple[List[Tuple[str, str, int]], List[Finding]]:
+    """All telemetry names produced by ``mod``: [(kind, name, lineno)].
+
+    Literal names are returned as-is; symbolic references resolve
+    through the registry's constant map. A symbolic reference to a
+    constant that does not exist is returned as a problem Finding
+    template (rule id filled in by the caller)."""
+    out: List[Tuple[str, str, int]] = []
+    bad_constants: List[Tuple[int, str]] = []
+    constants = registry["constants"]
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _producer_kind(mod, node)
+        if kind is None:
+            continue
+        expr = _name_expr(node, kind)
+        if expr is None:
+            continue
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            out.append((kind, expr.value, node.lineno))
+            continue
+        const = _symbolic_constant(mod, expr)
+        if const is not None:
+            if const in constants:
+                out.append((kind, constants[const], node.lineno))
+            else:
+                bad_constants.append((node.lineno, const))
+        # anything else (f-string, variable) is not statically checkable
+    problems = [
+        Finding(
+            "telemetry-unknown-name", "error", mod.relpath, lineno,
+            f"names.{const} does not exist in obs/names.py",
+        )
+        for lineno, const in bad_constants
+    ]
+    return out, problems
+
+
+class UnknownTelemetryName(Rule):
+    id = "telemetry-unknown-name"
+    severity = "error"
+    description = (
+        "telemetry name at a producer call site is not registered in "
+        "obs/names.py"
+    )
+
+    def __init__(self, registry: Optional[dict] = None):
+        self._registry = registry
+
+    @property
+    def registry(self) -> dict:
+        if self._registry is None:
+            self._registry = load_registry()
+        return self._registry
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if _is_test_file(mod.relpath) or mod.relpath == NAMES_RELPATH:
+            return
+        names, problems = extract_names(mod, self.registry)
+        yield from problems
+        for kind, name, lineno in names:
+            table = self.registry[kind]
+            if name in table:
+                continue
+            if kind == "metric" and name.startswith(
+                self.registry["prefixes"]
+            ):
+                continue
+            yield self.finding(
+                mod, lineno,
+                f"{kind} name {name!r} is not registered in "
+                "obs/names.py (typo, or add it to the registry)",
+            )
+
+
+#: (relpath, kind, name) triples the instrumentation gate protects — the
+#: AST-checked successor of check_telemetry_schema.py's grep-marker
+#: list. kinds: span | event | metric | jit | text (plain substring).
+def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
+    from ..obs import names as n
+
+    pkg = "pta_replicator_tpu"
+    return (
+        (f"{pkg}/batch.py", "span", n.SPAN_FREEZE),
+        (f"{pkg}/simulate.py", "span", n.SPAN_MAKE_IDEAL),
+        (f"{pkg}/simulate.py", "span", n.SPAN_LOAD_PULSARS),
+        (f"{pkg}/simulate.py", "span", n.SPAN_ORACLE_FIT),
+        (f"{pkg}/io/par.py", "span", n.SPAN_READ_PAR),
+        (f"{pkg}/io/tim.py", "span", n.SPAN_READ_TIM),
+        (f"{pkg}/timing/fit.py", "span", n.SPAN_DESIGN_TENSOR),
+        (f"{pkg}/timing/fit.py", "span", n.SPAN_COVARIANCE_FROM_RECIPE),
+        (f"{pkg}/parallel/mesh.py", "span", n.SPAN_MAKE_MESH),
+        (f"{pkg}/parallel/mesh.py", "span", n.SPAN_SHARD_BATCH),
+        (f"{pkg}/parallel/mesh.py", "span", n.SPAN_STATIC_DELAYS),
+        (f"{pkg}/parallel/mesh.py", "span", n.SPAN_SHARDED_REALIZE),
+        (f"{pkg}/parallel/mesh.py", "span", n.SPAN_SHARDMAP_REALIZE),
+        (f"{pkg}/parallel/mesh.py", "jit", n.JIT_MESH_CONSTRAINT_ENGINE),
+        (f"{pkg}/models/batched.py", "jit", n.JIT_REALIZE_ENGINE),
+        (f"{pkg}/utils/sweep.py", "span", n.SPAN_SWEEP_CHUNK),
+        (f"{pkg}/utils/sweep.py", "span", n.SPAN_READBACK_FENCE),
+        (f"{pkg}/utils/sweep.py", "span", n.SPAN_SWEEP_PIPELINE),
+        (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_CHUNKS_TOTAL),
+        (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_CHUNKS_DONE),
+        (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_REALIZATIONS),
+        (f"{pkg}/parallel/pipeline.py", "span", n.SPAN_DISPATCH),
+        (f"{pkg}/parallel/pipeline.py", "span", n.SPAN_DRAIN),
+        (f"{pkg}/parallel/pipeline.py", "span", n.SPAN_IO_WRITE),
+        (f"{pkg}/parallel/pipeline.py", "metric", n.SWEEP_INFLIGHT_CHUNKS),
+        (f"{pkg}/parallel/pipeline.py", "metric",
+         n.PIPELINE_DRAIN_TIMEOUTS),
+        (f"{pkg}/parallel/pipeline.py", "metric",
+         n.SWEEP_LAST_DISPATCHED_CHUNK),
+        (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),
+        (f"{pkg}/obs/flightrec.py", "event", n.EVENT_FLIGHTREC_STALL),
+        (f"{pkg}/__main__.py", "span", n.SPAN_COMPUTE),
+        (f"{pkg}/__main__.py", "span", n.SPAN_INGEST),
+        ("bench.py", "span", n.SPAN_BENCH_MEASURE),
+        ("bench.py", "text", "BENCH_TELEMETRY"),
+    )
+
+
+class TelemetryCoverage(Rule):
+    id = "telemetry-coverage"
+    severity = "error"
+    description = (
+        "required pipeline instrumentation missing (span/metric removed "
+        "or renamed without updating the coverage table)"
+    )
+
+    def __init__(
+        self,
+        coverage: Optional[Sequence[Tuple[str, str, str]]] = None,
+        registry: Optional[dict] = None,
+        anchor: str = NAMES_RELPATH,
+        repo_marker: str = "pyproject.toml",
+    ):
+        self._coverage = coverage
+        self._registry = registry
+        self.anchor = anchor
+        #: "file missing" findings fire only when this file exists under
+        #: the lint root: a repo checkout has pyproject.toml, an
+        #: installed wheel (site-packages) does not — there bench.py et
+        #: al. are legitimately absent, not deleted
+        self.repo_marker = repo_marker
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        if not mods:
+            return
+        root = mods[0].path[: -len(mods[0].relpath)].rstrip(os.sep)
+        if self.anchor and not os.path.exists(
+            os.path.join(root, self.anchor)
+        ):
+            return  # not the real tree (fixture dir in a unit test)
+        coverage = (
+            self._coverage if self._coverage is not None
+            else default_coverage()
+        )
+        registry = (
+            self._registry if self._registry is not None else load_registry()
+        )
+        by_rel: Dict[str, Module] = {m.relpath: m for m in mods}
+        produced: Dict[str, set] = {}
+        for relpath, kind, name in coverage:
+            mod = by_rel.get(relpath)
+            if mod is None:
+                if not os.path.exists(os.path.join(root, relpath)) and \
+                        os.path.exists(os.path.join(root, self.repo_marker)):
+                    yield self.finding(
+                        relpath, 1,
+                        "file missing but still listed in the "
+                        "telemetry coverage table",
+                    )
+                continue  # file exists, just not in this (partial) run
+            if kind == "text":
+                if name not in mod.source:
+                    yield self.finding(
+                        mod, 1,
+                        f"required marker {name!r} not found "
+                        "(instrumentation removed or renamed without "
+                        "updating rules_telemetry.default_coverage)",
+                    )
+                continue
+            if relpath not in produced:
+                produced[relpath] = {
+                    (k, v) for k, v, _ in extract_names(mod, registry)[0]
+                }
+            if (kind, name) not in produced[relpath]:
+                yield self.finding(
+                    mod, 1,
+                    f"required {kind} instrumentation {name!r} not "
+                    "found (removed or renamed without updating "
+                    "rules_telemetry.default_coverage)",
+                )
+
+
+RULES = [UnknownTelemetryName(), TelemetryCoverage()]

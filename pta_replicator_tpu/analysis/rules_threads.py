@@ -1,0 +1,220 @@
+"""graftlint rule pack: thread/lock/clock discipline.
+
+The concurrency hazards PR 2's pipelined executor and PR 3's flight
+recorder work around by careful convention, enforced statically:
+
+* ``thread-unlocked-global`` — in a module that uses threads
+  (``threading.Thread``/``Lock``), module-level mutable state mutated at
+  function scope outside a ``with <lock>`` block. The flight recorder's
+  signal handler explicitly documents why this matters: an interrupted
+  thread may hold the lock the handler needs, and unprotected mutation
+  is a torn-state bug under exactly that interleaving.
+* ``thread-walltime-duration`` — ``time.time()`` used in +/- arithmetic
+  (durations, deadlines). Wall clock steps under NTP corrections and DST
+  — a backwards jump turns a watchdog deadline into an instant trip or a
+  span duration negative. Durations and deadlines use
+  ``time.monotonic()`` (or ``perf_counter``); ``time.time()`` is only
+  for *exported timestamps* (the ``t0`` fields in events.jsonl).
+* ``thread-lock-order`` — nested ``with`` acquisition of two known locks
+  in an order that inverts :data:`LOCK_HIERARCHY`. The hierarchy records
+  the tracer/flightrec discipline: the flight recorder's lifecycle and
+  active-recorder locks are OUTER locks; the tracer's and registry's
+  ``_lock`` is the innermost leaf — code holding it must never wait on
+  anything else (Tracer._record runs listeners outside it for exactly
+  this reason; ``_flush_from_signal`` exists because a suspended main
+  thread may hold it).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .engine import Finding, Module, Rule
+from .rules_jax import _module_level_mutables, _terminal
+
+#: lock acquisition order, outermost first. Acquiring a lock while
+#: holding one that appears LATER in this tuple is an inversion. The
+#: terminal identifier is matched (``self._pm_lock`` -> ``_pm_lock``), so
+#: the hierarchy is shared by the flightrec/tracer/registry instances
+#: that use these conventional names.
+LOCK_HIERARCHY: Tuple[str, ...] = (
+    "_active_lock",     # obs.flightrec: process-global active recorder
+    "_lifecycle_lock",  # obs.flightrec: sampler start/stop
+    "_pm_lock",         # obs.flightrec: postmortem write-once
+    "_install_lock",    # obs.jaxhooks: listener install-once
+    "_trace_lock",      # obs.jaxhooks: per-label trace counts
+    "_lock",            # obs.trace / obs.metrics: innermost leaf locks
+)
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "extend", "insert", "update",
+    "setdefault", "pop", "popleft", "popitem", "remove", "discard",
+    "clear",
+}
+
+
+def _lock_name(mod: Module, expr: ast.AST) -> Optional[str]:
+    """Terminal identifier of a lock-ish context expr, else None.
+    Matches names/attributes whose last component contains 'lock'
+    (``self._lock``, ``_active_lock``, ``tracer._lock``)."""
+    qn = mod.qualname(expr)
+    if qn is None and isinstance(expr, ast.Call):
+        # `with self._lock:` vs `with lock_factory():` — only direct
+        # name/attribute context exprs count as holding a named lock
+        return None
+    if qn is None:
+        return None
+    term = qn.rsplit(".", 1)[-1]
+    return term if "lock" in term.lower() else None
+
+
+def _held_locks(mod: Module, node: ast.AST) -> List[str]:
+    """Lock names held by enclosing ``with`` statements, outermost
+    first."""
+    held = []
+    for anc in mod.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                name = _lock_name(mod, item.context_expr)
+                if name:
+                    held.append(name)
+    held.reverse()
+    return held
+
+
+def _uses_threads(mod: Module) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            resolved = mod.resolve(node.func) or ""
+            if resolved in (
+                "threading.Thread", "threading.Lock", "threading.RLock",
+                "threading.Condition",
+            ):
+                return True
+    return False
+
+
+class UnlockedGlobalMutation(Rule):
+    id = "thread-unlocked-global"
+    severity = "error"
+    description = (
+        "module-level mutable state mutated outside a lock in a "
+        "module that uses threads"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not _uses_threads(mod):
+            return
+        mutables = _module_level_mutables(mod)
+        if not mutables:
+            return
+        for node in ast.walk(mod.tree):
+            name, verb = self._mutation(mod, node)
+            if name is None or name not in mutables:
+                continue
+            # module-level init / re-init is single-threaded import time
+            if not any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a in mod.ancestors(node)
+            ):
+                continue
+            if _held_locks(mod, node):
+                continue
+            yield self.finding(
+                mod, node.lineno,
+                f"{verb} of module-level mutable {name!r} outside a "
+                "'with <lock>:' block in a threaded module (torn state "
+                "under concurrent access / signal handlers)",
+            )
+
+    def _mutation(self, mod: Module, node: ast.AST):
+        """(name, verb) when ``node`` mutates a plain-Name container."""
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                if isinstance(t, ast.Subscript) and isinstance(
+                    t.value, ast.Name
+                ):
+                    return t.value.id, "item assignment"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and isinstance(
+                    t.value, ast.Name
+                ):
+                    return t.value.id, "item deletion"
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if node.func.attr in _MUTATOR_METHODS and isinstance(
+                node.func.value, ast.Name
+            ):
+                return node.func.value.id, f".{node.func.attr}()"
+        return None, None
+
+
+class WallTimeDuration(Rule):
+    id = "thread-walltime-duration"
+    severity = "error"
+    description = (
+        "time.time() used in duration/deadline arithmetic — wall clock "
+        "steps; use time.monotonic()"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.BinOp) or not isinstance(
+                node.op, (ast.Add, ast.Sub)
+            ):
+                continue
+            for side in (node.left, node.right):
+                if (
+                    isinstance(side, ast.Call)
+                    and (mod.resolve(side.func) or "") == "time.time"
+                ):
+                    yield self.finding(
+                        mod, node.lineno,
+                        "time.time() in +/- arithmetic: wall clock can "
+                        "step backwards (NTP) — use time.monotonic() "
+                        "for durations and deadlines; keep time.time() "
+                        "only for exported timestamps",
+                    )
+                    break
+
+
+class LockOrderInversion(Rule):
+    id = "thread-lock-order"
+    severity = "error"
+    description = (
+        "nested lock acquisition inverts the recorded tracer/flightrec "
+        "lock hierarchy (deadlock risk)"
+    )
+
+    def __init__(self, hierarchy: Tuple[str, ...] = LOCK_HIERARCHY):
+        self.rank: Dict[str, int] = {
+            name: i for i, name in enumerate(hierarchy)
+        }
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                inner = _lock_name(mod, item.context_expr)
+                if inner is None or inner not in self.rank:
+                    continue
+                for outer in _held_locks(mod, node):
+                    if outer == inner or outer not in self.rank:
+                        continue
+                    if self.rank[outer] > self.rank[inner]:
+                        yield self.finding(
+                            mod, node.lineno,
+                            f"acquiring {inner!r} while holding "
+                            f"{outer!r} inverts the lock hierarchy "
+                            f"({' > '.join(k for k in self.rank)}): "
+                            "another thread taking them in order "
+                            "deadlocks against this one",
+                        )
+RULES = [UnlockedGlobalMutation(), WallTimeDuration(), LockOrderInversion()]

@@ -1410,14 +1410,14 @@ def _realize_engine(fit: bool, donate_keys: bool):
     call, so donation is safe; ``static`` is reused every chunk and is
     never donated).
     """
-    from ..obs import instrumented_jit
+    from ..obs import instrumented_jit, names
 
     def run(keys, batch, recipe, static):
         return realize_block(keys, batch, recipe, fit, static=static)
 
     return instrumented_jit(
         run,
-        name="batched.realize_engine",
+        name=names.JIT_REALIZE_ENGINE,
         retrace_warn=32,
         donate_argnums=(0,) if donate_keys else (),
     )

@@ -26,7 +26,7 @@ from __future__ import annotations
 import sys
 import time
 
-from . import flightrec, jaxhooks, metrics, regress, report, trace
+from . import flightrec, jaxhooks, metrics, names, regress, report, trace
 from .flightrec import FlightRecorder, StallWarning
 from .jaxhooks import (
     RetraceWarning,
@@ -48,6 +48,7 @@ __all__ = [
     "trace_count", "tree_nbytes", "start_capture", "finish_capture",
     "telemetry_summary", "reset_all", "metrics", "trace", "report",
     "jaxhooks", "flightrec", "regress", "FlightRecorder", "StallWarning",
+    "names",
 ]
 
 
@@ -165,7 +166,7 @@ def telemetry_summary() -> dict:
     }
     jax_metrics = {}
     for name, insts in REGISTRY.to_json().items():
-        if not name.startswith("jax."):
+        if not name.startswith(names.JAX_PREFIX):
             continue
         for inst in insts:
             key = name + (

@@ -60,6 +60,7 @@ import traceback
 import warnings
 from typing import Optional
 
+from . import names
 from .jaxhooks import device_memory_snapshot
 from .metrics import REGISTRY
 from .trace import TRACER
@@ -206,12 +207,12 @@ class FlightRecorder:
     def _sweep_block(self) -> dict:
         snap = {}
         for name, key in (
-            ("sweep.chunks_done", "chunks_done"),
-            ("sweep.chunks_total", "chunks_total"),
-            ("sweep.inflight_chunks", "inflight"),
-            ("sweep.last_dispatched_chunk", "last_dispatched"),
-            ("sweep.realizations", "realizations"),
-            ("pipeline.drain_timeouts", "drain_timeouts"),
+            (names.SWEEP_CHUNKS_DONE, "chunks_done"),
+            (names.SWEEP_CHUNKS_TOTAL, "chunks_total"),
+            (names.SWEEP_INFLIGHT_CHUNKS, "inflight"),
+            (names.SWEEP_LAST_DISPATCHED_CHUNK, "last_dispatched"),
+            (names.SWEEP_REALIZATIONS, "realizations"),
+            (names.PIPELINE_DRAIN_TIMEOUTS, "drain_timeouts"),
         ):
             val = _metric_value(name)
             if val is not None:
@@ -263,10 +264,10 @@ class FlightRecorder:
             "sweep": self._sweep_block(),
             "jax": {
                 name.split(".", 1)[1]: val
-                for name in ("jax.compiles", "jax.traces")
+                for name in (names.JAX_COMPILES, names.JAX_TRACES)
                 if (val := _metric_value(name)) is not None
             },
-            "stalls": _metric_value("flightrec.stalls") or 0.0,
+            "stalls": _metric_value(names.FLIGHTREC_STALLS) or 0.0,
             "finished": bool(finished),
         }
         mem = device_memory_snapshot()
@@ -294,7 +295,7 @@ class FlightRecorder:
         if self._stalled:
             return  # already warned for this episode
         self._stalled = True
-        REGISTRY.counter("flightrec.stalls").inc()
+        REGISTRY.counter(names.FLIGHTREC_STALLS).inc()
         open_now = TRACER.open_spans()
         desc = "; ".join(
             "/".join(stack) for stack in open_now.values()
@@ -302,7 +303,7 @@ class FlightRecorder:
         # the event feeds events.jsonl AND the ring buffer, so the
         # stall is visible in the postmortem of a later kill
         TRACER.event(
-            "flightrec.stall", age_s=round(age, 1), open=desc,
+            names.EVENT_FLIGHTREC_STALL, age_s=round(age, 1), open=desc,
         )
         warnings.warn(
             f"no span opened or closed for {age:.1f}s "

@@ -31,6 +31,7 @@ import threading
 import warnings
 from typing import Dict, Optional
 
+from . import names
 from .metrics import REGISTRY
 
 _COMPILE_EVENT = "backend_compile_duration"
@@ -47,13 +48,13 @@ class RetraceWarning(UserWarning):
 
 def _duration_listener(event: str, duration_secs: float, **_kw) -> None:
     if event.endswith(_COMPILE_EVENT):
-        REGISTRY.counter("jax.compiles").inc()
-        REGISTRY.histogram("jax.compile_s").observe(duration_secs)
+        REGISTRY.counter(names.JAX_COMPILES).inc()
+        REGISTRY.histogram(names.JAX_COMPILE_S).observe(duration_secs)
     elif event.endswith(_TRACE_EVENT):
-        REGISTRY.counter("jax.traces").inc()
-        REGISTRY.histogram("jax.trace_s").observe(duration_secs)
+        REGISTRY.counter(names.JAX_TRACES).inc()
+        REGISTRY.histogram(names.JAX_TRACE_S).observe(duration_secs)
     elif event.endswith(_LOWER_EVENT):
-        REGISTRY.histogram("jax.lowering_s").observe(duration_secs)
+        REGISTRY.histogram(names.JAX_LOWERING_S).observe(duration_secs)
 
 
 def install() -> bool:
@@ -124,11 +125,14 @@ def instrumented_jit(
     def traced(*args, **kwargs):
         # this body executes exactly once per trace (cache hits bypass
         # Python entirely), so it IS the retrace probe
+        # the wrapper body runs only WHILE jax is tracing (never inside
+        # the compiled executable), so reading the mutable global here is
+        # the point — it is the retrace probe, guarded by _trace_lock
         with _trace_lock:
-            _TRACE_COUNTS[label] = _TRACE_COUNTS.get(label, 0) + 1
+            _TRACE_COUNTS[label] = _TRACE_COUNTS.get(label, 0) + 1  # graftlint: disable=jax-global-closure
             local_count[0] += 1
             n = local_count[0]
-        REGISTRY.counter("jax.trace_count", fn=label).inc()
+        REGISTRY.counter(names.JAX_TRACE_COUNT, fn=label).inc()
         if n > retrace_warn:
             warnings.warn(
                 f"jit function {label!r} traced {n} times "
@@ -171,15 +175,18 @@ def record_memory_gauges() -> None:
         dev = snap["device"]
         for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
             if key in snap:
-                REGISTRY.gauge(f"jax.memory.{key}", device=dev).set(snap[key])
+                REGISTRY.gauge(
+                    f"{names.JAX_MEMORY_PREFIX}{key}", device=dev
+                ).set(snap[key])
 
 
 def record_transfer(nbytes: int, direction: str = "h2d") -> None:
     """Account a host<->device transfer (direction 'h2d' or 'd2h')."""
     if direction not in ("h2d", "d2h"):
         raise ValueError(f"direction must be h2d|d2h, got {direction!r}")
-    REGISTRY.counter(f"jax.transfer.{direction}_bytes").inc(max(0, int(nbytes)))
-    REGISTRY.counter(f"jax.transfer.{direction}_count").inc()
+    prefix = f"{names.JAX_TRANSFER_PREFIX}{direction}"
+    REGISTRY.counter(f"{prefix}_bytes").inc(max(0, int(nbytes)))
+    REGISTRY.counter(f"{prefix}_count").inc()
 
 
 def tree_nbytes(tree) -> int:

@@ -9,7 +9,9 @@ registries.
 Naming convention: dotted lower-case (``jax.compiles``,
 ``io.tim.toas``); the Prometheus exporter rewrites characters outside
 ``[a-zA-Z0-9_:]`` to ``_``. Labels are plain ``str -> str`` pairs passed
-as keyword arguments: ``counter("jax.trace", fn="run_chunk").inc()``.
+as keyword arguments. Every metric name the library emits is registered
+in :mod:`.names` — graftlint's ``telemetry-unknown-name`` rule rejects
+unregistered literals at producer call sites (docs/static-analysis.md).
 """
 from __future__ import annotations
 

@@ -1,0 +1,171 @@
+"""Single source-of-truth registry of telemetry names.
+
+Every span, counter, gauge, histogram, event, and ``instrumented_jit``
+label the library emits is declared here ONCE. Producers either import
+the constant (``gauge(names.SWEEP_CHUNKS_DONE)``) or use the literal
+string — in which case the graftlint telemetry rule
+(``analysis/rules_telemetry.py``) cross-checks the literal against this
+registry, so a misspelled or renamed name is a lint error, not silent
+drift between a producer, the report renderer, the flight recorder's
+heartbeat, and ``scripts/check_telemetry_schema.py`` (all of which
+consume names from here).
+
+Adding a name: declare the constant, add it to the matching frozenset
+below, and (for instrumentation the schema gate must not lose) add a
+coverage row in ``analysis/rules_telemetry.py``. jax-free and
+import-cheap by design — the lint engine and the report CLI both load
+this module.
+
+The span/event *record* schema (field names and types) is a separate
+contract and lives in :data:`..obs.trace.EVENT_SCHEMA`; this module owns
+only the namespace of span/metric/event *names*.
+"""
+from __future__ import annotations
+
+# --------------------------------------------------------------- spans
+# ingest / freeze / oracle path
+SPAN_FREEZE = "freeze"
+SPAN_MAKE_IDEAL = "make_ideal"
+SPAN_LOAD_PULSARS = "load_pulsars"
+SPAN_ORACLE_FIT = "oracle_fit"
+SPAN_READ_PAR = "read_par"
+SPAN_READ_TIM = "read_tim"
+SPAN_DESIGN_TENSOR = "design_tensor"
+SPAN_COVARIANCE_FROM_RECIPE = "covariance_from_recipe"
+
+# mesh / device path
+SPAN_MAKE_MESH = "make_mesh"
+SPAN_SHARD_BATCH = "shard_batch"
+SPAN_STATIC_DELAYS = "static_delays"
+SPAN_SHARDED_REALIZE = "sharded_realize"
+SPAN_SHARDMAP_REALIZE = "shardmap_realize"
+
+# sweep / pipeline executor
+SPAN_SWEEP_CHUNK = "sweep_chunk"
+SPAN_READBACK_FENCE = "readback_fence"
+SPAN_SWEEP_PIPELINE = "sweep_pipeline"
+SPAN_DISPATCH = "dispatch"
+SPAN_DRAIN = "drain"
+SPAN_IO_WRITE = "io_write"
+
+# CLI runner (the top-level span is the subcommand name)
+SPAN_CLI_REALIZE = "realize"
+SPAN_CLI_INFO = "info"
+SPAN_INGEST = "ingest"
+SPAN_BUILD_RECIPE = "build_recipe"
+SPAN_COMPUTE = "compute"
+SPAN_WRITE_OUTPUT = "write_output"
+
+# bench.py harness
+SPAN_BENCH_INGEST_B1855 = "ingest_b1855"
+SPAN_BENCH_AOT_COMPILE = "aot_compile"
+SPAN_BENCH_WARMUP = "warmup"
+SPAN_BENCH_MEASURE = "measure"
+SPAN_BENCH_SWEEP_AB = "sweep_ab"
+
+SPANS = frozenset({
+    SPAN_FREEZE, SPAN_MAKE_IDEAL, SPAN_LOAD_PULSARS, SPAN_ORACLE_FIT,
+    SPAN_READ_PAR, SPAN_READ_TIM, SPAN_DESIGN_TENSOR,
+    SPAN_COVARIANCE_FROM_RECIPE,
+    SPAN_MAKE_MESH, SPAN_SHARD_BATCH, SPAN_STATIC_DELAYS,
+    SPAN_SHARDED_REALIZE, SPAN_SHARDMAP_REALIZE,
+    SPAN_SWEEP_CHUNK, SPAN_READBACK_FENCE, SPAN_SWEEP_PIPELINE,
+    SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE,
+    SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_INGEST, SPAN_BUILD_RECIPE,
+    SPAN_COMPUTE, SPAN_WRITE_OUTPUT,
+    SPAN_BENCH_INGEST_B1855, SPAN_BENCH_AOT_COMPILE, SPAN_BENCH_WARMUP,
+    SPAN_BENCH_MEASURE, SPAN_BENCH_SWEEP_AB,
+})
+
+# -------------------------------------------------------------- events
+EVENT_FLIGHTREC_STALL = "flightrec.stall"
+
+EVENTS = frozenset({EVENT_FLIGHTREC_STALL})
+
+# ------------------------------------------------------------- metrics
+# io / ingest counters
+IO_TIM_FILES = "io.tim.files"
+IO_TIM_TOAS = "io.tim.toas"
+IO_PAR_FILES = "io.par.files"
+BATCH_FREEZES = "batch.freezes"
+BATCH_TOAS_FROZEN = "batch.toas_frozen"
+SIMULATE_LEDGER_DISAMBIGUATED = "simulate.ledger_disambiguated"
+SIMULATE_PULSARS_LOADED = "simulate.pulsars_loaded"
+
+# mesh / sweep / pipeline
+MESH_DEVICES = "mesh.devices"
+SWEEP_CHUNKS_TOTAL = "sweep.chunks_total"
+SWEEP_CHUNKS_DONE = "sweep.chunks_done"
+SWEEP_REALIZATIONS = "sweep.realizations"
+SWEEP_INFLIGHT_CHUNKS = "sweep.inflight_chunks"
+SWEEP_LAST_DISPATCHED_CHUNK = "sweep.last_dispatched_chunk"
+PIPELINE_DRAIN_TIMEOUTS = "pipeline.drain_timeouts"
+
+# flight recorder
+FLIGHTREC_STALLS = "flightrec.stalls"
+
+# jax accounting (obs/jaxhooks.py)
+JAX_COMPILES = "jax.compiles"
+JAX_COMPILE_S = "jax.compile_s"
+JAX_TRACES = "jax.traces"
+JAX_TRACE_S = "jax.trace_s"
+JAX_LOWERING_S = "jax.lowering_s"
+JAX_TRACE_COUNT = "jax.trace_count"
+
+METRICS = frozenset({
+    IO_TIM_FILES, IO_TIM_TOAS, IO_PAR_FILES,
+    BATCH_FREEZES, BATCH_TOAS_FROZEN,
+    SIMULATE_LEDGER_DISAMBIGUATED, SIMULATE_PULSARS_LOADED,
+    MESH_DEVICES,
+    SWEEP_CHUNKS_TOTAL, SWEEP_CHUNKS_DONE, SWEEP_REALIZATIONS,
+    SWEEP_INFLIGHT_CHUNKS, SWEEP_LAST_DISPATCHED_CHUNK,
+    PIPELINE_DRAIN_TIMEOUTS,
+    FLIGHTREC_STALLS,
+    JAX_COMPILES, JAX_COMPILE_S, JAX_TRACES, JAX_TRACE_S, JAX_LOWERING_S,
+    JAX_TRACE_COUNT,
+})
+
+#: metric families whose full names are built at runtime (device label,
+#: transfer direction) — a literal starting with one of these prefixes
+#: is registered even though the exact name isn't enumerable statically
+JAX_MEMORY_PREFIX = "jax.memory."
+JAX_TRANSFER_PREFIX = "jax.transfer."
+METRIC_PREFIXES = (JAX_MEMORY_PREFIX, JAX_TRANSFER_PREFIX)
+
+#: dotted-name groups the report renderer and postmortem filter key on
+JAX_PREFIX = "jax."
+SWEEP_PREFIX = "sweep."
+FLIGHTREC_PREFIX = "flightrec."
+PIPELINE_PREFIX = "pipeline."
+
+# ----------------------------------------------- instrumented_jit labels
+JIT_REALIZE_ENGINE = "batched.realize_engine"
+JIT_MESH_CONSTRAINT_ENGINE = "mesh.constraint_engine"
+JIT_MESH_SHARDMAP_ENGINE = "mesh.shardmap_engine"
+JIT_MESH_SHARDMAP_PSR_ENGINE = "mesh.shardmap_psr_engine"
+
+JIT_LABELS = frozenset({
+    JIT_REALIZE_ENGINE, JIT_MESH_CONSTRAINT_ENGINE,
+    JIT_MESH_SHARDMAP_ENGINE, JIT_MESH_SHARDMAP_PSR_ENGINE,
+})
+
+#: every registered name, for membership checks that don't care about kind
+ALL_NAMES = SPANS | EVENTS | METRICS | JIT_LABELS
+
+
+def is_registered(name: str, kind: str = None) -> bool:
+    """True when ``name`` is a registered telemetry name.
+
+    ``kind`` narrows the check: "span", "event", "metric", or "jit";
+    None accepts any kind. Metric names additionally match the dynamic
+    :data:`METRIC_PREFIXES` families.
+    """
+    table = {
+        "span": SPANS, "event": EVENTS, "metric": METRICS,
+        "jit": JIT_LABELS, None: ALL_NAMES,
+    }[kind]
+    if name in table:
+        return True
+    if kind in ("metric", None):
+        return name.startswith(METRIC_PREFIXES)
+    return False

@@ -25,6 +25,8 @@ import json
 import os
 from typing import Dict, List, Optional, TextIO
 
+from . import names
+
 
 def load_events(path: str) -> List[dict]:
     """Parse an events.jsonl file (tolerates a truncated final line from
@@ -221,7 +223,8 @@ def render_report(
     parts.append(render_span_tree(agg, min_ms=min_ms))
 
     jax_rows = _metric_rows(
-        {k: v for k, v in metrics.items() if k.startswith("jax.")}
+        {k: v for k, v in metrics.items()
+         if k.startswith(names.JAX_PREFIX)}
     )
     if jax_rows:
         parts.append("")
@@ -239,7 +242,8 @@ def render_report(
             )
 
     other_rows = _metric_rows(
-        {k: v for k, v in metrics.items() if not k.startswith("jax.")}
+        {k: v for k, v in metrics.items()
+         if not k.startswith(names.JAX_PREFIX)}
     )
     if other_rows:
         parts.append("")
@@ -278,7 +282,7 @@ def render_report(
 
 
 def _stall_count(metrics: dict, progress: Optional[dict]) -> int:
-    insts = (metrics or {}).get("flightrec.stalls") or []
+    insts = (metrics or {}).get(names.FLIGHTREC_STALLS) or []
     for inst in insts:
         if inst.get("value"):
             return int(inst["value"])
@@ -395,7 +399,8 @@ def render_postmortem(directory: str, last: int = 25) -> str:
     metrics = pm.get("metrics") or {}
     interesting = {
         k: v for k, v in metrics.items()
-        if k.startswith(("sweep.", "flightrec.", "pipeline."))
+        if k.startswith((names.SWEEP_PREFIX, names.FLIGHTREC_PREFIX,
+                         names.PIPELINE_PREFIX))
     }
     rows = _metric_rows(interesting)
     if rows:

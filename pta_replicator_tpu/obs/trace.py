@@ -12,7 +12,9 @@ span is
 
 The JSONL stream is the contract consumed by :mod:`.report`, by
 ``scripts/check_telemetry_schema.py`` and by the BENCH telemetry block;
-its schema lives in :data:`EVENT_SCHEMA`. A Perfetto/``chrome://tracing``
+its schema lives in :data:`EVENT_SCHEMA`. Span *names* are the callers'
+contract: every library span name is registered in :mod:`.names` and
+cross-checked statically by graftlint (docs/static-analysis.md). A Perfetto/``chrome://tracing``
 view of the same spans is written by :meth:`Tracer.chrome_trace`.
 
 Device-side (XLA) tracing is a separate concern: capture it alongside

@@ -52,7 +52,7 @@ from typing import Callable, Iterable, Optional
 
 import numpy as np
 
-from ..obs import counter, gauge, span
+from ..obs import counter, gauge, names, span
 from ..obs.trace import TRACER
 
 
@@ -133,7 +133,7 @@ def run_pipelined(
         with lock:
             inflight[0] += delta
             stats["max_inflight"] = max(stats["max_inflight"], inflight[0])
-            gauge("sweep.inflight_chunks").set(inflight[0])
+            gauge(names.SWEEP_INFLIGHT_CHUNKS).set(inflight[0])
 
     def _put(q: queue.Queue, item) -> bool:
         """Put that stays responsive to stop (io_q is bounded). Returns
@@ -159,7 +159,7 @@ def run_pipelined(
                 # watchdog WARNS early on any quiet run; this deadline
                 # hard-fails one provably wedged fetch/write. Both land
                 # in the heartbeat so `watch` shows warning-then-kill.
-                counter("pipeline.drain_timeouts").inc()
+                counter(names.PIPELINE_DRAIN_TIMEOUTS).inc()
                 _fail(
                     stage,
                     DrainTimeout(
@@ -177,7 +177,7 @@ def run_pipelined(
                 i, dev = item
                 try:
                     fetch_started[0] = time.monotonic()
-                    with span("drain", chunk=i):
+                    with span(names.SPAN_DRAIN, chunk=i):
                         block = fetch(dev)
                     fetch_started[0] = None
                     if stop.is_set():
@@ -212,7 +212,8 @@ def run_pipelined(
                 i, block = item
                 try:
                     write_started[0] = time.monotonic()
-                    with span("io_write", chunk=i, nbytes=int(block.nbytes)):
+                    with span(names.SPAN_IO_WRITE, chunk=i,
+                              nbytes=int(block.nbytes)):
                         write(i, block)
                     write_started[0] = None
                     with lock:
@@ -242,7 +243,7 @@ def run_pipelined(
             if stop.is_set():
                 break
             try:
-                with span("dispatch", chunk=i):
+                with span(names.SPAN_DISPATCH, chunk=i):
                     dev = dispatch(i)
             except BaseException as exc:  # noqa: BLE001
                 _fail("dispatch", exc)
@@ -250,7 +251,7 @@ def run_pipelined(
             # heartbeat feed: how far ahead of the drained/written
             # chunks the dispatcher is running (sweep.chunks_done lags
             # this by the in-flight window)
-            gauge("sweep.last_dispatched_chunk").set(i)
+            gauge(names.SWEEP_LAST_DISPATCHED_CHUNK).set(i)
             _bump(+1)
             if not _put(drain_q, (i, dev)):
                 break
@@ -301,7 +302,7 @@ def run_pipelined(
                     )
                 elif time.monotonic() > quiesce_deadline:
                     break
-        gauge("sweep.inflight_chunks").set(0)
+        gauge(names.SWEEP_INFLIGHT_CHUNKS).set(0)
 
     if errors:
         _stage, exc = errors[0]

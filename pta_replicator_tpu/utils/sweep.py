@@ -365,13 +365,13 @@ def sweep(
 
         static = static_delays(batch, recipe, mesh=mesh)
 
-    from ..obs import counter, gauge, span
+    from ..obs import counter, gauge, names, span
 
     # chunk-progress gauges: the flight recorder's heartbeat derives
     # "12/64 chunks, ETA 4m" from exactly these (obs/flightrec.py), so
     # a resumed sweep must seed chunks_done with the resume offset
-    gauge("sweep.chunks_total").set(nchunks)
-    gauge("sweep.chunks_done").set(done)
+    gauge(names.SWEEP_CHUNKS_TOTAL).set(nchunks)
+    gauge(names.SWEEP_CHUNKS_DONE).set(done)
 
     def dispatch_chunk(i: int):
         """Dispatch chunk ``i`` and its on-device reduction; returns the
@@ -406,8 +406,8 @@ def sweep(
                 fh.write(payload)
 
         _atomic_write(write_meta, meta_path, ".json", durable=durable)
-        counter("sweep.realizations").inc(chunk)
-        gauge("sweep.chunks_done").set(i + 1)
+        counter(names.SWEEP_REALIZATIONS).inc(chunk)
+        gauge(names.SWEEP_CHUNKS_DONE).set(i + 1)
         if progress is not None:
             progress(i + 1, nchunks)
 
@@ -415,11 +415,11 @@ def sweep(
         # the synchronous reference loop: dispatch, fence, write — the
         # behavior every pipelined run must reproduce byte-for-byte
         for i in range(done, nchunks):
-            with span("sweep_chunk", chunk=i, nreal=chunk):
+            with span(names.SPAN_SWEEP_CHUNK, chunk=i, nreal=chunk):
                 out = dispatch_chunk(i)
                 # the host readback is the device-sync fence: this span
                 # is where queued device work (incl. collectives) drains
-                with span("readback_fence"):
+                with span(names.SPAN_READBACK_FENCE):
                     block = np.asarray(out)
             write_chunk(i, block)
             blocks.append(block)
@@ -473,7 +473,7 @@ def sweep(
             place(i, block)
 
         try:
-            with span("sweep_pipeline", depth=pipeline_depth,
+            with span(names.SPAN_SWEEP_PIPELINE, depth=pipeline_depth,
                       chunks=nchunks - done) as sp:
                 stats = run_pipelined(
                     range(done, nchunks),

@@ -433,3 +433,24 @@ def test_telemetry_summary_shape():
     s = obs.telemetry_summary()
     assert s["spans"]["stage_one"]["calls"] == 1
     assert s["jax"]["jax.compiles"] == 3
+
+
+# ----------------------------------------------------- clock discipline
+def test_backwards_wallclock_jump_cannot_negate_span_duration(monkeypatch):
+    """Span durations come from the monotonic perf_counter, never from
+    t0 arithmetic: a wall-clock step (NTP correction) mid-span must not
+    produce a negative wall_s. time.time() survives only as the exported
+    t0 timestamp (the invariant graftlint's thread-walltime-duration
+    rule enforces tree-wide)."""
+    import time as _time
+
+    t = Tracer()
+    wall = iter([1000.0, 400.0, 400.0])  # clock jumps 10 minutes back
+    monkeypatch.setattr(_time, "time", lambda: next(wall, 400.0))
+    with t.span("jumpy"):
+        pass
+    rec = [e for e in t.events() if e["type"] == "span"][0]
+    assert rec["t0"] == 1000.0  # wall timestamp: exported as-is
+    assert rec["wall_s"] >= 0.0
+    assert rec["cpu_s"] >= 0.0
+    assert t.summary()["jumpy"]["total_s"] >= 0.0
