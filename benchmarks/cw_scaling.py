@@ -9,9 +9,10 @@ This tool measures the one-time catalog cost across an Nsrc ladder and
 reports per-(source x TOA) throughput, so the tiling's linear scaling is
 recorded evidence rather than a claim.
 
-Usage: python benchmarks/cw_scaling.py [max_exp] [backend]
+Usage: python benchmarks/cw_scaling.py [max_exp|memprobe] [backend]
   max_exp: ladder goes 10^2 .. 10^max_exp sources (default 5)
-  backend: scan | pallas | both (default scan; pallas needs a real TPU)
+  backend: scan | pallas | streamed | both (scan+pallas) | ab
+  (scan+streamed A/B; default scan; pallas needs a real TPU)
   CW_CHUNKS="1024" (env): comma-separated scan-chunk candidates for the
   >=1e5 rungs, overriding the default {512,1024,4096} sweep — a single
   1e6-source evaluation takes tens of minutes on a 1-core CPU host, so
@@ -22,7 +23,32 @@ Usage: python benchmarks/cw_scaling.py [max_exp] [backend]
   CW_NTOA=122, the reference's own parity-workload TOA count) reaches
   the reference's 1e7-source regime on hosts where the full 7,758-TOA
   product would take days; rungs record the shape they ran at.
+  CW_TELEMETRY=DIR (env): capture the run's telemetry (the streamed
+  arm's ``cw_stream.*`` gauges land in the obs report).
 Prints one JSON line.
+
+The "streamed" arm measures the BOUNDED-MEMORY plane pipeline
+(models.batched.cw_stream_response: tile stream -> double-buffered
+host->device prefetch -> jitted per-tile accumulation) at equal
+precompute amortization with the scan arm: the scan arm's planes are
+built once at trace time and baked into its jit as constants, so the
+streamed arm likewise builds its tiles once per rung — recorded as
+``tile_build_once_s``; amortizing it across capture windows is the
+on-disk tile cache's job (benchmarks/mk_workload.py) — and each timed
+eval pays the prefetch/H2D-staging/per-tile-dispatch machinery the
+scan arm never pays. ``streamed_over_scan_wall`` <= 1.0 therefore
+means bounded memory costs nothing at that rung even before the
+memory wall makes the comparison moot (the monolithic arm CANNOT run
+the 68 psr x 1e7 flagship shape at all — see memprobe). Each streamed
+rung also records the ``cw_stream.*`` gauges.
+
+``memprobe`` mode is the memory-boundary instrument: it builds (and
+stages through the prefetcher, then discards) the full plane-tile
+stream for CW_NPSR (68) x CW_NSRC (1e7) sources — the exact shape whose
+MONOLITHIC f64 host precompute segfaulted this host at ~113 GB
+(CW_SCALING_r05_cpu.json) — sampling VmRSS per tile, and reports the
+peak. No response is computed: the probe certifies the plane build's
+bounded memory, the regime the monolithic path cannot enter at all.
 
 The "pallas" arm measures the ARCHIVED Mosaic kernel (retired from the
 production backend enum in round 5 — docs/DESIGN.md section 4) by
@@ -32,6 +58,7 @@ ever shows the kernel winning on real hardware.
 """
 import json
 import os
+import resource
 import sys
 import time
 
@@ -40,7 +67,78 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _vm_rss_mb() -> float:
+    from pta_replicator_tpu.utils.profiling import vm_rss_mb
+
+    return vm_rss_mb()
+
+
+def memprobe():
+    """Bounded-memory plane build at the monolithic path's segfault
+    shape: stream (and discard) every tile, report peak RSS."""
+    import jax
+
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from bench import random_cw_catalog
+    from pta_replicator_tpu import obs
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.obs import names
+    from pta_replicator_tpu.parallel.prefetch import prefetch_to_device
+
+    npsr = int(os.environ.get("CW_NPSR", "68"))
+    nsrc = int(float(os.environ.get("CW_NSRC", "1e7")))
+    chunk = int(os.environ.get("CW_STREAM_CHUNK", "65536"))
+    ntoa = int(os.environ.get("CW_NTOA", "122"))  # planes don't touch TOAs
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=4, seed=0)
+    args = random_cw_catalog(np.random.default_rng(1), nsrc)
+
+    rss0 = _vm_rss_mb()
+    peak = rss0
+    t0 = time.monotonic()
+    tiles = B.cw_catalog_plane_tiles_for(
+        batch, *args, chunk=chunk,
+    )
+    ntiles = 0
+    nbytes = 0
+    # the full pipeline shape minus the response: host build + H2D
+    # staging through the double-buffered window, tiles dropped on the
+    # floor as soon as they are staged
+    for src_t, psr_t in prefetch_to_device(tiles, depth=2):
+        ntiles += 1
+        obs.gauge(names.CW_STREAM_TILES_DONE).set(ntiles)
+        nbytes += int(src_t.nbytes) + int(psr_t.nbytes)
+        peak = max(peak, _vm_rss_mb())
+    wall = time.monotonic() - t0
+    out = {
+        "mode": "memprobe",
+        "device": jax.devices()[0].device_kind,
+        "npsr": npsr,
+        "nsrc": nsrc,
+        "stream_chunk": chunk,
+        "tiles": ntiles,
+        "staged_gb": round(nbytes / 1e9, 3),
+        "wall_s": round(wall, 1),
+        "rss_start_mb": round(rss0, 1),
+        "rss_peak_mb": round(peak, 1),
+        "ru_maxrss_mb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1
+        ),
+        "monolithic_reference": (
+            "same 68 psr x 1e7 src shape segfaulted the monolithic f64 "
+            "plane precompute at ~113 GB (CW_SCALING_r05_cpu.json)"
+        ),
+    }
+    print(json.dumps(out))
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "memprobe":
+        memprobe()
+        return
     max_exp = int(sys.argv[1]) if len(sys.argv) > 1 else 5
     backend_arg = sys.argv[2] if len(sys.argv) > 2 else "scan"
 
@@ -52,8 +150,14 @@ def main():
     import jax.numpy as jnp
 
     from bench import random_cw_catalog
+    from pta_replicator_tpu import obs
     from pta_replicator_tpu.batch import synthetic_batch
     from pta_replicator_tpu.models import batched as B
+    from pta_replicator_tpu.obs import names
+
+    telemetry = os.environ.get("CW_TELEMETRY")
+    if telemetry:
+        obs.start_capture(telemetry)
 
     npsr = int(os.environ.get("CW_NPSR", "68"))
     ntoa = int(os.environ.get("CW_NTOA", "7758"))
@@ -63,7 +167,10 @@ def main():
     def catalog(n):
         return [jnp.asarray(row) for row in random_cw_catalog(rng, n)]
 
-    backends = ["scan", "pallas"] if backend_arg == "both" else [backend_arg]
+    backends = {
+        "both": ["scan", "pallas"],
+        "ab": ["scan", "streamed"],
+    }.get(backend_arg, [backend_arg])
     ladder = [10**e for e in range(2, max_exp + 1)]
     out = {
         "device": jax.devices()[0].device_kind,
@@ -121,6 +228,32 @@ def main():
                             ) * batch.mask
                             + eps
                         )
+                    elif backend == "streamed":
+                        # equal precompute amortization with the scan
+                        # arm (whose planes are built ONCE at trace
+                        # time and baked into its jit as constants):
+                        # tiles are built once per rung — build_s
+                        # records that one-time cost, it is the tile
+                        # cache's job to amortize it across windows —
+                        # and each timed eval streams them through the
+                        # prefetch + per-tile-jit machinery, H2D
+                        # staging included (the scan arm stages
+                        # nothing per eval)
+                        t_b = time.perf_counter()
+                        tiles_list = list(
+                            B.cw_catalog_plane_tiles_for(
+                                batch, *args, chunk=chunk
+                            )
+                        )
+                        build_s = round(time.perf_counter() - t_b, 4)
+
+                        tps = int(os.environ.get("CW_TILES_PER_STEP", "16"))
+
+                        def fn(eps, tiles_list=tiles_list, tps=tps):
+                            return B.cw_stream_response(
+                                batch, iter(tiles_list), evolve=True,
+                                prefetch_depth=2, tiles_per_step=tps,
+                            ) + eps
                     else:
                         fn = jax.jit(
                             lambda eps, args=args, chunk=chunk:
@@ -137,7 +270,14 @@ def main():
                     # target ~1s of measurement per rung, 50 reps max
                     reps = max(1, min(50, int(1.0 / max(t1, 1e-4))))
                     best = np.inf
-                    for _ in range(int(os.environ.get("CW_LOOPS", "2"))):
+                    loops = int(os.environ.get("CW_LOOPS", "2"))
+                    # bytes_staged is a process-cumulative counter:
+                    # snapshot around the timed loops and divide, so
+                    # the record is per-eval, not warmup+every earlier
+                    # rung (the stall/tiles gauges are per-response
+                    # already — each cw_stream_response overwrites them)
+                    bytes0 = obs.counter(names.CW_STREAM_BYTES_STAGED).value
+                    for _ in range(loops):
                         t0 = time.perf_counter()
                         for _ in range(reps):
                             r = fn(zero)
@@ -152,6 +292,24 @@ def main():
                                 n * ntoa * npsr / best / 1e9, 2
                             ),
                         }
+                        if backend == "streamed":
+                            best_row["tile_build_once_s"] = build_s
+                            staged_delta = (
+                                obs.counter(
+                                    names.CW_STREAM_BYTES_STAGED
+                                ).value - bytes0
+                            )
+                            best_row["cw_stream"] = {
+                                "tiles_done": obs.gauge(
+                                    names.CW_STREAM_TILES_DONE
+                                ).value,
+                                "bytes_staged_per_eval": round(
+                                    staged_delta / (loops * reps)
+                                ),
+                                "prefetch_stall_s": obs.gauge(
+                                    names.CW_STREAM_PREFETCH_STALL_S
+                                ).value,
+                            }
                 except Exception as exc:
                     tried[str(chunk)] = repr(exc)[:160]
             rows[str(n)] = (
@@ -160,7 +318,18 @@ def main():
                 else {"error": tried}
             )
         out["results"][backend] = rows
+    if "scan" in out["results"] and "streamed" in out["results"]:
+        # the A/B column: streamed wall / scan wall per rung (<= 1.0 is
+        # parity-or-better despite the per-eval host plane build)
+        ab = {}
+        for n, srow in out["results"]["scan"].items():
+            trow = out["results"]["streamed"].get(n)
+            if trow and "seconds" in srow and "seconds" in trow:
+                ab[n] = round(trow["seconds"] / srow["seconds"], 3)
+        out["streamed_over_scan_wall"] = ab
     print(json.dumps(out))
+    if telemetry:
+        obs.finish_capture(context={"cw_scaling": True})
 
 
 if __name__ == "__main__":

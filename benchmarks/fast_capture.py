@@ -152,6 +152,27 @@ def write_preview(rec, path=_PREVIEW):
         path = "/root/repo/BENCH_PREVIEW_r05_fastcapture.json"
         log("canonical bench result promoted; preview diverted to "
             f"{path}")
+    # best-not-latest (ADVICE r5 low): among COMPARABLE rungs (same
+    # bench_chunk measuring the same metric — e.g. chunk800_long vs
+    # chunk800_headline) keep the faster record; a different chunk is a
+    # ladder upgrade and always replaces (smallest-first ladder: any
+    # window yields a number, later rungs are the better evidence)
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        if (
+            prev.get("bench_chunk") == rec.get("bench_chunk")
+            and prev.get("metric") == rec.get("metric")
+            and prev.get("value", 0) >= rec.get("value", 0)
+        ):
+            log(
+                f"preview keeps {prev.get('stage')} "
+                f"({prev.get('value')} >= {rec.get('value')} real/s); "
+                f"not demoting to {rec.get('stage')}"
+            )
+            return
+    except (FileNotFoundError, json.JSONDecodeError):
+        pass  # no (readable) preview yet: write unconditionally
     with open(path, "w") as f:
         json.dump(rec, f)
         f.flush()
@@ -200,9 +221,10 @@ def measure(chunk, nrep, tag, budget=600):
     return emit(rec)
 
 
-# smallest first: ANY window yields a number — and every rung becomes
-# the preview immediately, so a window that dies mid-ladder still leaves
-# the best number captured so far in the canonical artifact. A rung that
+# smallest first: ANY window yields a number — and every rung is offered
+# to the preview immediately (write_preview keeps the best among
+# comparable rungs), so a window that dies mid-ladder still leaves the
+# best number captured so far in the canonical artifact. A rung that
 # RAISES (device error, OOM — not a silent wedge) must not kill the
 # capture: later rungs and the battery can still use the live window, so
 # record the error and push on (exit 6 tells the loop the window was

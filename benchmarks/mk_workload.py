@@ -7,6 +7,20 @@ key-independent data: its f64 host plane precompute happens on the host
 either way, so the CPU-computed f32 plane is numerically equivalent input
 data for the rate measurement (the timed region is run_chunk only).
 Writes /tmp/workload.npz (~2 MB).
+
+It also writes the CW coefficient-plane TILE cache
+(/tmp/cw_plane_tiles.npz, parallel.prefetch.save_plane_tiles) stamped
+with the same workload fingerprint: the streamed plane pipeline
+(models.batched.cw_stream_response) can then feed a TPU capture window
+straight from disk — zero seconds rebuilding planes inside the window,
+and at large-catalog shapes (MK_NCW) the tiles are the only
+memory-feasible serialization (the monolithic plane set at the
+reference's 1e7-source regime needs >100 GB of f64 host intermediates;
+CW_SCALING_r05_cpu.json records the segfault).
+
+Env knobs: MK_NCW (catalog size, default 100 — the bench workload),
+MK_PLANE_CHUNK (tile width, default 65536), MK_PLANE_TILES (tile-cache
+path; '0' skips, default /tmp/cw_plane_tiles.npz).
 """
 import os
 import sys
@@ -21,14 +35,19 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 from bench import build_workload  # noqa: E402
-from pta_replicator_tpu.models.batched import deterministic_delays  # noqa: E402
+from pta_replicator_tpu.models.batched import (  # noqa: E402
+    cw_catalog_plane_tiles_for,
+    deterministic_delays,
+)
+from pta_replicator_tpu.parallel.prefetch import save_plane_tiles  # noqa: E402
 
+ncw = int(os.environ.get("MK_NCW", "100"))
 t = time.monotonic()
 # the fingerprint binds the cache to THIS workload definition (build
 # params, host draw bytes, STREAM_VERSION): fast_capture verifies it
 # before reuse, so a plane serialized from an older workload can never
 # silently substitute different static data (ADVICE.md r5)
-batch, recipe, fp = build_workload(ncw=100, with_fingerprint=True)
+batch, recipe, fp = build_workload(ncw=ncw, with_fingerprint=True)
 static = np.asarray(deterministic_delays(batch, recipe))
 # atomic write: a reader (fast_capture mid-window) must never see a
 # truncated file
@@ -37,3 +56,32 @@ np.savez(tmp, static=static, fingerprint=np.array(fp))
 os.replace(tmp, "/tmp/workload.npz")
 print(f"wrote /tmp/workload.npz {static.shape} {static.dtype} "
       f"fp={fp} in {time.monotonic()-t:.1f}s")
+
+tiles_path = os.environ.get("MK_PLANE_TILES", "/tmp/cw_plane_tiles.npz")
+if tiles_path != "0":
+    t = time.monotonic()
+    chunk = int(os.environ.get("MK_PLANE_CHUNK", "65536"))
+    # pdist/pphase forwarded exactly as deterministic_delays' streamed
+    # path forwards them: the fingerprint only covers the DRAWN recipe
+    # inputs, so a constant pdist/pphase dropped here would produce a
+    # cache with different pulsar-term physics that still passes the
+    # fingerprint gate
+    tiles = cw_catalog_plane_tiles_for(
+        batch, *[recipe.cgw_params[i] for i in range(8)],
+        pdist=recipe.cgw_pdist if recipe.cgw_pdist is not None else 1.0,
+        pphase=recipe.cgw_pphase,
+        evolve=recipe.cgw_evolve, phase_approx=recipe.cgw_phase_approx,
+        tref_s=recipe.cgw_tref_s, chunk=chunk,
+    )
+    # save_plane_tiles streams tile-by-tile (bounded memory) and renames
+    # into place only when complete, so the same mid-window reader
+    # guarantee holds; the fingerprint gates reuse exactly like the
+    # static-plane cache above
+    ntiles = save_plane_tiles(
+        tiles_path, tiles, fingerprint=fp,
+        meta={"ncw": ncw, "chunk": chunk, "npsr": int(batch.npsr),
+              "evolve": bool(recipe.cgw_evolve),
+              "psr_term": bool(recipe.cgw_psr_term)},
+    )
+    print(f"wrote {tiles_path} ({ntiles} tile(s), chunk={chunk}) "
+          f"fp={fp} in {time.monotonic()-t:.1f}s")

@@ -222,6 +222,13 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
          n.PIPELINE_DRAIN_TIMEOUTS),
         (f"{pkg}/parallel/pipeline.py", "metric",
          n.SWEEP_LAST_DISPATCHED_CHUNK),
+        (f"{pkg}/parallel/prefetch.py", "span", n.SPAN_CW_STREAM_STAGE),
+        (f"{pkg}/parallel/prefetch.py", "metric",
+         n.CW_STREAM_BYTES_STAGED),
+        (f"{pkg}/parallel/prefetch.py", "metric",
+         n.CW_STREAM_PREFETCH_STALL_S),
+        (f"{pkg}/models/batched.py", "span", n.SPAN_CW_STREAM_RESPONSE),
+        (f"{pkg}/models/batched.py", "metric", n.CW_STREAM_TILES_DONE),
         (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),
         (f"{pkg}/obs/flightrec.py", "event", n.EVENT_FLIGHTREC_STALL),
         (f"{pkg}/__main__.py", "span", n.SPAN_COMPUTE),

@@ -426,37 +426,30 @@ def gwb_delays(
     return uniform_grid_interp(batch.toas_s, ut[0], ut[-1], grid_series) * batch.mask
 
 
-def _cw_scan_response(
-    toas_rel, src_c, psr_c, psr_term: bool, evolve: bool, chunk: int
-):
-    """Portable plane-consuming fallback for :func:`cw_catalog_response`:
-    ``lax.scan`` over ``chunk``-sized source tiles, vmapped over pulsars,
-    so only a (chunk, Nt) workspace is live per pulsar while the scan
-    accumulates the (Np, Nt) sum."""
+def _cw_tile_response(toas_rel, src_tile, psr_tile, psr_term: bool,
+                      evolve: bool):
+    """(Np, Nt) response sum of ONE ``chunk``-wide coefficient tile
+    (``src_tile`` (NC_SRC, chunk), ``psr_tile`` (NC_PSR, Np, chunk)),
+    vmapped over pulsars with a (chunk, Nt) workspace per pulsar.
+
+    The ONE per-tile op sequence shared by the monolithic scan backend
+    (:func:`_cw_scan_response`'s body) and the streamed accumulator
+    (:func:`cw_stream_response`'s jitted step): the f32 phase math
+    amplifies even 1-ulp formula differences to ~3e-4 after
+    sin(2*phase), so the two paths must run the SAME ops to be — as
+    tests/test_cw_stream.py asserts — bit-identical."""
     from ..ops.pallas_cw import (
-        NC_PSR,
-        NC_SRC,
         _PSR_PLANES,
         _SRC_PLANES,
         _polarized,
         _term_response,
     )
 
-    dtype = toas_rel.dtype
-    npsr, _ = toas_rel.shape
-    nsrc = src_c.shape[1]
-    npad = (-nsrc) % chunk
-    src_p = jnp.pad(src_c, ((0, 0), (0, npad)))
-    psr_p = jnp.pad(psr_c, ((0, 0), (0, 0), (0, npad)))
-    nch = (nsrc + npad) // chunk
-    src_tiles = src_p.reshape(NC_SRC, nch, chunk).transpose(1, 0, 2)
-    psr_tiles = psr_p.reshape(NC_PSR, npsr, nch, chunk).transpose(2, 0, 1, 3)
-
-    def one_psr(u_row, psr_tile, src_tile):
+    def one_psr(u_row, psr_t, src_t):
         # (chunk, 1) coefficient columns against the (1, Nt) time row;
         # named plane lookups keep this in lockstep with the kernel
-        sp = lambda n: src_tile[_SRC_PLANES.index(n)][:, None]
-        pp = lambda n: psr_tile[_PSR_PLANES.index(n)][:, None]
+        sp = lambda n: src_t[_SRC_PLANES.index(n)][:, None]
+        pp = lambda n: psr_t[_PSR_PLANES.index(n)][:, None]
         u = u_row[None, :]
         inc1, inc2 = sp("incfac1"), sp("incfac2")
         s2p, c2p = sp("sin2psi"), sp("cos2psi")
@@ -480,11 +473,38 @@ def _cw_scan_response(
         res = jnp.where(jnp.isnan(res), 0.0, res) * sp("valid")
         return jnp.sum(res, axis=0)
 
-    per_psr = jax.vmap(one_psr, in_axes=(0, 1, None))
+    return jax.vmap(one_psr, in_axes=(0, 1, None))(
+        toas_rel, psr_tile, src_tile
+    )
+
+
+def _cw_scan_response(
+    toas_rel, src_c, psr_c, psr_term: bool, evolve: bool, chunk: int
+):
+    """Portable plane-consuming fallback for :func:`cw_catalog_response`:
+    ``lax.scan`` over ``chunk``-sized source tiles, vmapped over pulsars,
+    so only a (chunk, Nt) workspace is live per pulsar while the scan
+    accumulates the (Np, Nt) sum. The streamed pipeline
+    (:func:`cw_stream_response`) runs the same scan body per macro tile
+    via :func:`_cw_stream_step`, carrying its accumulator through as
+    the scan init."""
+    from ..ops.pallas_cw import NC_PSR, NC_SRC
+
+    dtype = toas_rel.dtype
+    npsr, _ = toas_rel.shape
+    nsrc = src_c.shape[1]
+    npad = (-nsrc) % chunk
+    src_p = jnp.pad(src_c, ((0, 0), (0, npad)))
+    psr_p = jnp.pad(psr_c, ((0, 0), (0, 0), (0, npad)))
+    nch = (nsrc + npad) // chunk
+    src_tiles = src_p.reshape(NC_SRC, nch, chunk).transpose(1, 0, 2)
+    psr_tiles = psr_p.reshape(NC_PSR, npsr, nch, chunk).transpose(2, 0, 1, 3)
 
     def step(carry, tiles):
         src_tile, psr_tile = tiles
-        return carry + per_psr(toas_rel, psr_tile, src_tile), None
+        return carry + _cw_tile_response(
+            toas_rel, src_tile, psr_tile, psr_term, evolve
+        ), None
 
     # derive the carry init from the (possibly device-varying) input so
     # its sharding/vma type matches the body output under shard_map with
@@ -551,6 +571,243 @@ def cw_catalog_planes_for(
         xp=np, dtype=batch.toas_s.dtype,
     )
     return src_c, psr_c, evolve
+
+
+def cw_catalog_plane_tiles_for(
+    batch: PulsarBatch,
+    gwtheta,
+    gwphi,
+    mc,
+    dist,
+    fgw,
+    phase0,
+    psi,
+    inc,
+    pdist=1.0,
+    pphase=None,
+    evolve: bool = True,
+    phase_approx: bool = False,
+    tref_s: float = 0.0,
+    chunk: int = 65536,
+):
+    """Streaming twin of :func:`cw_catalog_planes_for`: a generator of
+    ``chunk``-sized host plane tiles ``(src (NC_SRC, cs),
+    psr (NC_PSR, Np, cs))``, f64 host math per tile, cast to the batch
+    dtype — each tile bit-identical to the corresponding column slice
+    of the monolithic planes, with peak host memory O(Np x chunk)
+    instead of O(Np x Ns) (ops.pallas_cw.cw_catalog_plane_tiles).
+
+    Feed the tiles to :func:`cw_stream_response` (optionally through
+    the parallel.prefetch on-disk cache), or simply call
+    :func:`cgw_catalog_delays_streamed`, which wires the whole
+    pipeline. Requires concrete (non-tracer) parameters like the
+    monolithic precompute — there is no traced fallback here: the
+    whole point of streaming is the bounded-memory HOST build.
+    """
+    from ..ops.pallas_cw import cw_catalog_plane_tiles
+
+    params = (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
+    tracer = jax.core.Tracer
+    if any(
+        isinstance(x, tracer)
+        for x in (batch.phat, pdist, pphase, *params)
+        if x is not None
+    ):
+        raise TypeError(
+            "cw_catalog_plane_tiles_for requires concrete parameters "
+            "(the f64 host precompute cannot run on tracers); build the "
+            "streamed delays outside jit and pass them through as data "
+            "(e.g. the `static=` argument of realize/sweep)"
+        )
+    t_fold = batch.tref_mjd * 86400.0 - tref_s + batch.start_s
+    return cw_catalog_plane_tiles(
+        np.asarray(batch.phat, np.float64),
+        *[np.atleast_1d(np.asarray(x, np.float64)) for x in params],
+        pdist=np.asarray(pdist, np.float64),
+        pphase=None if pphase is None else np.asarray(pphase, np.float64),
+        t_fold=t_fold, evolve=evolve, phase_approx=phase_approx,
+        chunk=chunk, dtype=batch.toas_s.dtype,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _cw_stream_step(psr_term: bool, evolve: bool, donate: bool):
+    """Jitted macro-tile accumulator: ``lax.scan`` the monolithic
+    backend's own per-tile body over a staged macro — a host-stacked
+    ``(K, NC_SRC, chunk)`` / ``(K, NC_PSR, Np, chunk)`` tile block,
+    already in the scan's operand layout — with the accumulator as the
+    scan CARRY. Per-call dispatch overhead amortizes over the macro,
+    the f32 accumulation order stays that of one monolithic scan
+    (bit-identity), and the monolithic path's on-device
+    pad/reshape/transpose of the full plane set has no streamed
+    counterpart at all: the stacking happened tile-by-tile on the
+    prefetch worker. Cached per (psr_term, evolve, donate); jit
+    re-specializes per macro shape (two in practice: full macros and
+    the tail). ``donate`` aliases the accumulator buffer into the
+    output off-CPU (the previous partial sum is dead the moment the
+    new one exists)."""
+
+    def step(acc, toas_rel, src_tiles, psr_tiles):
+        def body(carry, tiles):
+            src_tile, psr_tile = tiles
+            return carry + _cw_tile_response(
+                toas_rel, src_tile, psr_tile, psr_term, evolve
+            ), None
+
+        total, _ = jax.lax.scan(body, acc, (src_tiles, psr_tiles))
+        return total
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def cw_stream_response(
+    batch: PulsarBatch,
+    tiles,
+    evolve: bool,
+    psr_term: bool = True,
+    prefetch_depth: int = 2,
+    tiles_per_step: int = 8,
+    stall_timeout_s=900.0,
+):
+    """Summed CW response (Np, Nt) from a *stream* of plane tiles, with
+    double-buffered host->device prefetch: the next macro tile is built
+    (f64 host math) and staged (``jax.device_put``) on a background
+    thread while the jitted scan step consumes the current one,
+    accumulating the (Np, Nt) sum on device — no stage ever holds more
+    than ``prefetch_depth`` macro tiles, and the monolithic path's
+    full-catalog pad/reshape/transpose copies never exist.
+
+    ``tiles`` yields host ``(src, psr)`` tiles in catalog order
+    (:func:`cw_catalog_plane_tiles_for`, or a cache iterator from
+    parallel.prefetch.load_plane_tiles), all the same width except an
+    optionally narrower LAST tile (zero-padded on the host — the same
+    zeros the monolithic path pads with, inert via ``valid=0``).
+    ``tiles_per_step`` tiles are stacked per staged macro — the stack
+    IS the scan's operand layout, so the device runs no
+    pad/reshape/transpose at all — amortizing per-dispatch overhead
+    while the staging granularity stays bounded
+    (tiles_per_step x tile bytes).
+
+    Bit-identical to ``cgw_catalog_delays_from_planes(...,
+    backend="scan", chunk=<tile width>)`` on the same catalog: each
+    macro is scanned by the SAME per-tile body
+    (:func:`_cw_tile_response`), with the accumulator threaded through
+    as the scan carry — same tile sequence, same f32 accumulation
+    order as one monolithic scan (tests/test_cw_stream.py asserts
+    exact equality at prefetch depths 1/2/4 and several
+    ``tiles_per_step`` groupings).
+    """
+    from ..obs import gauge, names, span
+    from ..parallel.prefetch import prefetch_to_device
+
+    if tiles_per_step < 1:
+        raise ValueError(f"tiles_per_step must be >= 1 (got {tiles_per_step})")
+    dtype = batch.toas_s.dtype
+    u = batch.toas_s - jnp.asarray(batch.start_s, dtype)
+    width = [None]  # established by the stream's first tile
+
+    def macros():
+        """Stack ``tiles_per_step`` host tiles per staged macro (runs
+        on the prefetch worker thread, so the copy overlaps device
+        compute)."""
+        buf_s, buf_p = [], []
+        tail_seen = False
+        for src, psr in tiles:
+            src, psr = np.asarray(src), np.asarray(psr)
+            if width[0] is None:
+                width[0] = src.shape[-1]
+            if tail_seen or src.shape[-1] > width[0]:
+                raise ValueError(
+                    f"plane tile of width {src.shape[-1]} after the "
+                    f"stream established width {width[0]}; tiles must be "
+                    "uniform with an optional narrower LAST tile "
+                    "(anything else would misalign the scan windows and "
+                    "break bit-identity with the monolithic backend)"
+                )
+            pad = width[0] - src.shape[-1]
+            if pad:
+                tail_seen = True
+                src = np.pad(src, ((0, 0), (0, pad)))
+                psr = np.pad(psr, ((0, 0), (0, 0), (0, pad)))
+            buf_s.append(src)
+            buf_p.append(psr)
+            if len(buf_s) == tiles_per_step:
+                yield np.stack(buf_s), np.stack(buf_p)
+                buf_s, buf_p = [], []
+        if buf_s:
+            yield np.stack(buf_s), np.stack(buf_p)
+
+    donate = bool(donate_keys_argnums(jax.default_backend()))
+    step = _cw_stream_step(psr_term, evolve, donate)
+    acc = jnp.zeros(batch.toas_s.shape, dtype)
+    nmacros = 0
+    with span(names.SPAN_CW_STREAM_RESPONSE, depth=prefetch_depth) as sp:
+        gauge(names.CW_STREAM_TILES_DONE).set(0)
+        staged = prefetch_to_device(
+            macros(),
+            depth=prefetch_depth,
+            stall_timeout_s=stall_timeout_s,
+        )
+        ntiles = 0
+        for src_macro, psr_macro in staged:
+            acc = step(acc, u, src_macro, psr_macro)
+            nmacros += 1
+            # the gauge reads in TILE units (a macro's leading axis is
+            # its tile count), matching the docs and the ungrouped
+            # streams memprobe/tests consume
+            ntiles += int(src_macro.shape[0])
+            gauge(names.CW_STREAM_TILES_DONE).set(ntiles)
+        sp["macros"] = nmacros
+        sp["tiles"] = ntiles
+        sp["tiles_per_step"] = tiles_per_step
+    return acc * batch.mask
+
+
+def cgw_catalog_delays_streamed(
+    batch: PulsarBatch,
+    gwtheta,
+    gwphi,
+    mc,
+    dist,
+    fgw,
+    phase0,
+    psi,
+    inc,
+    pdist=1.0,
+    pphase=None,
+    psr_term: bool = True,
+    evolve: bool = True,
+    phase_approx: bool = False,
+    tref_s: float = 0.0,
+    chunk: int = 65536,
+    prefetch_depth: int = 2,
+    tiles_per_step: int = 8,
+    stall_timeout_s=900.0,
+):
+    """Summed CW-catalog response with the full streaming pipeline:
+    tiled f64 host precompute -> double-buffered host->device prefetch
+    -> jitted on-device accumulation, peak memory O(Np x chunk) at
+    every stage. Bit-identical to
+    ``cgw_catalog_delays(..., chunk=chunk, backend="scan")`` — same
+    planes (per tile), same op sequence, same accumulation order —
+    but never materializes the (NC_PSR, Np, Ns) plane set that
+    segfaults the monolithic path at the reference's 1e7-source
+    flagship regime (CW_SCALING_r05_cpu.json, ~113 GB at 68 pulsars).
+
+    Deterministic (no key), host-driven (not jittable): source
+    parameters must be concrete, and the result is plain data — pass
+    it through jit boundaries like any precomputed ``static`` plane.
+    """
+    tiles = cw_catalog_plane_tiles_for(
+        batch, gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc,
+        pdist=pdist, pphase=pphase, evolve=evolve,
+        phase_approx=phase_approx, tref_s=tref_s, chunk=chunk,
+    )
+    return cw_stream_response(
+        batch, tiles, evolve=evolve, psr_term=psr_term,
+        prefetch_depth=prefetch_depth, tiles_per_step=tiles_per_step,
+        stall_timeout_s=stall_timeout_s,
+    )
 
 
 def cgw_catalog_delays_from_planes(
@@ -836,6 +1093,23 @@ class Recipe:
     gwb_howml: float = field(metadata=dict(static=True), default=10.0)
     cgw_tref_s: float = field(metadata=dict(static=True), default=0.0)
     cgw_chunk: int = field(metadata=dict(static=True), default=512)
+    #: source-tile size for the STREAMED CW-catalog pipeline (tiled f64
+    #: host precompute + double-buffered host->device prefetch,
+    #: cgw_catalog_delays_streamed). None (default) = the monolithic
+    #: plane build. Set it for catalogs whose full plane set exceeds
+    #: host memory (the reference's 1e7-source regime). Bit-identical
+    #: to the monolithic path at EQUAL tile width (== cgw_chunk); a
+    #: different width reorders the f32 accumulation exactly as
+    #: changing cgw_chunk itself does. Host-driven: requires concrete
+    #: cgw params, so deterministic_delays with this set must run
+    #: OUTSIDE jit (the sweep/bench `static=` precompute path,
+    #: parallel.mesh.static_delays).
+    cgw_stream_chunk: Optional[int] = field(
+        metadata=dict(static=True), default=None
+    )
+    #: in-flight window of the streamed pipeline's prefetch stage
+    #: (2 = double buffering; parallel.prefetch)
+    cgw_prefetch_depth: int = field(metadata=dict(static=True), default=2)
     cgw_psr_term: bool = field(metadata=dict(static=True), default=True)
     cgw_evolve: bool = field(metadata=dict(static=True), default=True)
     cgw_phase_approx: bool = field(metadata=dict(static=True), default=False)
@@ -1324,18 +1598,40 @@ def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
     realization axis."""
     total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
     if recipe.cgw_params is not None:
-        total = total + cgw_catalog_delays(
-            batch,
-            *[recipe.cgw_params[i] for i in range(8)],
-            pdist=recipe.cgw_pdist if recipe.cgw_pdist is not None else 1.0,
-            pphase=recipe.cgw_pphase,
-            psr_term=recipe.cgw_psr_term,
-            evolve=recipe.cgw_evolve,
-            phase_approx=recipe.cgw_phase_approx,
-            tref_s=recipe.cgw_tref_s,
-            chunk=recipe.cgw_chunk,
-            backend=recipe.cgw_backend,
-        )
+        if recipe.cgw_stream_chunk is not None:
+            # bounded-memory streamed pipeline (tiled host precompute +
+            # prefetch); host-driven, so the recipe must reach here
+            # eagerly (the static= precompute path) — tracer params
+            # raise in cw_catalog_plane_tiles_for with guidance
+            total = total + cgw_catalog_delays_streamed(
+                batch,
+                *[recipe.cgw_params[i] for i in range(8)],
+                pdist=(
+                    recipe.cgw_pdist if recipe.cgw_pdist is not None else 1.0
+                ),
+                pphase=recipe.cgw_pphase,
+                psr_term=recipe.cgw_psr_term,
+                evolve=recipe.cgw_evolve,
+                phase_approx=recipe.cgw_phase_approx,
+                tref_s=recipe.cgw_tref_s,
+                chunk=recipe.cgw_stream_chunk,
+                prefetch_depth=recipe.cgw_prefetch_depth,
+            )
+        else:
+            total = total + cgw_catalog_delays(
+                batch,
+                *[recipe.cgw_params[i] for i in range(8)],
+                pdist=(
+                    recipe.cgw_pdist if recipe.cgw_pdist is not None else 1.0
+                ),
+                pphase=recipe.cgw_pphase,
+                psr_term=recipe.cgw_psr_term,
+                evolve=recipe.cgw_evolve,
+                phase_approx=recipe.cgw_phase_approx,
+                tref_s=recipe.cgw_tref_s,
+                chunk=recipe.cgw_chunk,
+                backend=recipe.cgw_backend,
+            )
     if recipe.gwm_params is not None:
         total = total + gw_memory_delays(batch, *recipe.gwm_params)
     if recipe.burst_sky is not None:

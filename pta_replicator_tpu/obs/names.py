@@ -48,6 +48,11 @@ SPAN_DISPATCH = "dispatch"
 SPAN_DRAIN = "drain"
 SPAN_IO_WRITE = "io_write"
 
+# streamed CW-catalog plane pipeline (parallel/prefetch.py,
+# models/batched.py cw_stream_response)
+SPAN_CW_STREAM_STAGE = "cw_stream_stage"
+SPAN_CW_STREAM_RESPONSE = "cw_stream_response"
+
 # CLI runner (the top-level span is the subcommand name)
 SPAN_CLI_REALIZE = "realize"
 SPAN_CLI_INFO = "info"
@@ -71,6 +76,7 @@ SPANS = frozenset({
     SPAN_SHARDED_REALIZE, SPAN_SHARDMAP_REALIZE,
     SPAN_SWEEP_CHUNK, SPAN_READBACK_FENCE, SPAN_SWEEP_PIPELINE,
     SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE,
+    SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_INGEST, SPAN_BUILD_RECIPE,
     SPAN_COMPUTE, SPAN_WRITE_OUTPUT,
     SPAN_BENCH_INGEST_B1855, SPAN_BENCH_AOT_COMPILE, SPAN_BENCH_WARMUP,
@@ -101,6 +107,13 @@ SWEEP_INFLIGHT_CHUNKS = "sweep.inflight_chunks"
 SWEEP_LAST_DISPATCHED_CHUNK = "sweep.last_dispatched_chunk"
 PIPELINE_DRAIN_TIMEOUTS = "pipeline.drain_timeouts"
 
+# streamed CW-catalog plane pipeline: tiles consumed by the device
+# accumulator, bytes staged host->device by the prefetcher, and the
+# cumulative seconds the consumer starved waiting on a tile
+CW_STREAM_TILES_DONE = "cw_stream.tiles_done"
+CW_STREAM_BYTES_STAGED = "cw_stream.bytes_staged"
+CW_STREAM_PREFETCH_STALL_S = "cw_stream.prefetch_stall_s"
+
 # flight recorder
 FLIGHTREC_STALLS = "flightrec.stalls"
 
@@ -120,6 +133,8 @@ METRICS = frozenset({
     SWEEP_CHUNKS_TOTAL, SWEEP_CHUNKS_DONE, SWEEP_REALIZATIONS,
     SWEEP_INFLIGHT_CHUNKS, SWEEP_LAST_DISPATCHED_CHUNK,
     PIPELINE_DRAIN_TIMEOUTS,
+    CW_STREAM_TILES_DONE, CW_STREAM_BYTES_STAGED,
+    CW_STREAM_PREFETCH_STALL_S,
     FLIGHTREC_STALLS,
     JAX_COMPILES, JAX_COMPILE_S, JAX_TRACES, JAX_TRACE_S, JAX_LOWERING_S,
     JAX_TRACE_COUNT,
@@ -137,6 +152,7 @@ JAX_PREFIX = "jax."
 SWEEP_PREFIX = "sweep."
 FLIGHTREC_PREFIX = "flightrec."
 PIPELINE_PREFIX = "pipeline."
+CW_STREAM_PREFIX = "cw_stream."
 
 # ----------------------------------------------- instrumented_jit labels
 JIT_REALIZE_ENGINE = "batched.realize_engine"

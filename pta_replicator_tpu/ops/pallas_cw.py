@@ -238,6 +238,82 @@ def cw_catalog_planes(
     return src, psr
 
 
+def cw_catalog_plane_tiles(
+    phat,
+    gwtheta,
+    gwphi,
+    mc,
+    dist,
+    fgw,
+    phase0,
+    psi,
+    inc,
+    pdist=1.0,
+    pphase=None,
+    t_fold: float = 0.0,
+    evolve: bool = True,
+    phase_approx: bool = False,
+    chunk: int = 65536,
+    dtype=None,
+):
+    """Generator form of :func:`cw_catalog_planes`: yield
+    ``(src (NC_SRC, cs), psr (NC_PSR, Np, cs))`` host numpy tiles of at
+    most ``chunk`` sources, in catalog order.
+
+    Every plane value is computed per source (the only contraction,
+    ``phat @ m.T``, reduces over the 3-vector axis, never across
+    sources), so each tile is **bit-identical** to the corresponding
+    column slice of the monolithic plane set — the implementation
+    simply delegates each source window to :func:`cw_catalog_planes`
+    with the sliced parameters (same f64 host math, same op order).
+    Peak host memory is O(Np x chunk) instead of O(Np x Ns): the
+    monolithic f64 precompute at the reference's 1e7-source regime
+    needs >100 GB at 68 pulsars (CW_SCALING_r05_cpu.json records the
+    segfault) while the tiles stay at tens of MB.
+
+    Host-only by design (``xp=np``): the tiles exist to be staged to
+    the device incrementally (parallel.prefetch), and the f64 host
+    fold is what makes the f32 device path accurate. ``dtype`` casts
+    each tile on the host (numpy round-to-nearest, the same rounding
+    the monolithic path's device cast applies).
+    """
+    params = [
+        np.atleast_1d(np.asarray(x, np.float64))
+        for x in (gwtheta, gwphi, mc, dist, fgw, phase0, psi, inc)
+    ]
+    phat = np.asarray(phat, np.float64)
+    nsrc = max(p.shape[0] for p in params)
+    params = [np.broadcast_to(p, (nsrc,)) for p in params]
+    pdist = np.asarray(pdist, np.float64)
+    pphase = None if pphase is None else np.asarray(pphase, np.float64)
+
+    def _slice_per_src(v, lo, hi):
+        """Window a scalar / (Ns,) / (Np, Ns) per-source parameter."""
+        if v.ndim == 0:
+            return v
+        return v[..., lo:hi]
+
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    for lo in range(0, nsrc, chunk):
+        hi = min(lo + chunk, nsrc)
+        src, psr = cw_catalog_planes(
+            phat,
+            *[p[lo:hi] for p in params],
+            pdist=_slice_per_src(pdist, lo, hi),
+            pphase=None if pphase is None else _slice_per_src(pphase, lo, hi),
+            t_fold=t_fold,
+            evolve=evolve,
+            phase_approx=phase_approx,
+            xp=np,
+            dtype=None,  # cast below with numpy: tiles stay host arrays
+        )
+        if dtype is not None:
+            src = np.asarray(src, dtype)
+            psr = np.asarray(psr, dtype)
+        yield src, psr
+
+
 def _expm1_stable(z):
     """exp(z) - 1 from primitives Mosaic can lower (no native ``expm1``
     in the Mosaic TPU backend — one of the two direct causes of the

@@ -1,4 +1,4 @@
-from . import distributed, pipeline
+from . import distributed, pipeline, prefetch
 from .mesh import (
     make_mesh,
     shard_batch,
@@ -7,10 +7,17 @@ from .mesh import (
     static_delays,
 )
 from .pipeline import DrainTimeout, run_pipelined
+from .prefetch import (
+    load_plane_tiles,
+    load_plane_tiles_meta,
+    prefetch_to_device,
+    save_plane_tiles,
+)
 
 __all__ = [
     "distributed",
     "pipeline",
+    "prefetch",
     "make_mesh",
     "shard_batch",
     "sharded_realize",
@@ -18,4 +25,8 @@ __all__ = [
     "static_delays",
     "DrainTimeout",
     "run_pipelined",
+    "prefetch_to_device",
+    "save_plane_tiles",
+    "load_plane_tiles",
+    "load_plane_tiles_meta",
 ]

@@ -65,6 +65,31 @@ class DrainTimeout(RuntimeError):
 _STOP = object()  # queue sentinel: no more chunks
 
 
+def _stop_aware_put(q: queue.Queue, item, stop: threading.Event) -> bool:
+    """Bounded-queue put that stays responsive to ``stop``. Returns
+    False when the pipeline is stopping. The ONE implementation of the
+    back-pressure handshake, shared by this executor's worker threads
+    and the host->device prefetch stage (parallel.prefetch) built on
+    the same bounded-window pattern."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            pass
+    return False
+
+
+def _stage_overdue(started_box: list, timeout_s: Optional[float]) -> bool:
+    """True when the single-writer heartbeat ``started_box[0]`` (the
+    monotonic start of the stage operation currently in flight, None
+    between items) has been in flight longer than ``timeout_s``."""
+    if timeout_s is None:
+        return False
+    t0 = started_box[0]
+    return t0 is not None and time.monotonic() - t0 > timeout_s
+
+
 def run_pipelined(
     indices: Iterable[int],
     dispatch: Callable[[int], object],
@@ -136,25 +161,14 @@ def run_pipelined(
             gauge(names.SWEEP_INFLIGHT_CHUNKS).set(inflight[0])
 
     def _put(q: queue.Queue, item) -> bool:
-        """Put that stays responsive to stop (io_q is bounded). Returns
-        False when the pipeline is stopping."""
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                pass
-        return False
+        return _stop_aware_put(q, item, stop)
 
     def _check_deadline() -> None:
-        if drain_timeout_s is None:
-            return
         for stage, started, what in (
             ("drain", fetch_started, "host readback"),
             ("io_write", write_started, "checkpoint write"),
         ):
-            t0 = started[0]
-            if t0 is not None and time.monotonic() - t0 > drain_timeout_s:
+            if _stage_overdue(started, drain_timeout_s):
                 # distinct from flightrec.stalls: the flight recorder's
                 # watchdog WARNS early on any quiet run; this deadline
                 # hard-fails one provably wedged fetch/write. Both land

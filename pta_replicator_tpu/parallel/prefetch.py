@@ -1,0 +1,280 @@
+"""Double-buffered host->device tile prefetch + on-disk plane-tile cache.
+
+The streamed CW-catalog pipeline (models.batched.cw_stream_response)
+never holds the full catalog anywhere: the f64 host precompute emits
+``chunk``-sized coefficient-plane tiles (ops.pallas_cw.
+cw_catalog_plane_tiles), and this module's :func:`prefetch_to_device`
+stages tile ``k+1``'s ``jax.device_put`` on a background thread while
+the jitted per-tile accumulator consumes tile ``k`` — the classic
+input-pipeline shape, built on the same bounded-window dispatcher
+pattern as the pipelined sweep executor (parallel.pipeline, whose
+stop-aware put / stage-heartbeat helpers it reuses).
+
+Window semantics (``depth``): a slot is taken *before* a tile is built
+and staged, and released when the consumer comes back for the next
+tile, so at most ``depth`` tiles exist past the host generator at any
+instant — ``depth=2`` is double buffering (one tile being consumed,
+one staged ahead), ``depth=1`` is the fully serial loop (stage k+1
+only after k is consumed; the parity reference). Host memory is
+bounded by ``depth x tile_nbytes`` no matter how slow the consumer is.
+
+Failure semantics mirror the sweep executor: a tile-build or staging
+exception re-raises on the consumer's thread UNCHANGED, after every
+tile staged before it has been yielded (in order); a staging call
+wedged past ``stall_timeout_s`` raises the same
+:class:`~pta_replicator_tpu.parallel.pipeline.DrainTimeout` a wedged
+sweep readback does (the worker is a daemon, so process exit is never
+held hostage).
+
+Telemetry: a ``cw_stream_stage`` span per tile (host build +
+``device_put``) on the worker, and the ``cw_stream.tiles_done`` /
+``cw_stream.bytes_staged`` / ``cw_stream.prefetch_stall_s`` gauges —
+``prefetch_stall_s`` is the cumulative time the consumer starved
+waiting on a tile, i.e. how far the host precompute (not the device)
+is the bottleneck. docs/performance.md reads an example capture.
+
+The on-disk cache (:func:`save_plane_tiles` / :func:`load_plane_tiles`)
+serializes a tile stream into one npz-compatible archive stamped with
+the workload fingerprint benchmarks/mk_workload.py already uses for
+the static-plane cache, so a TPU capture window spends zero seconds
+rebuilding planes: tiles are written member-by-member (bounded memory)
+through utils.sweep's atomic-replace serialization layer, and read
+back lazily, member-by-member, straight into the prefetcher.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import zipfile
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from ..obs import counter, gauge, names, span, tree_nbytes
+from ..obs.trace import TRACER
+from ..utils.sweep import durable_replace, npy_bytes
+from .pipeline import DrainTimeout, _stage_overdue, _stop_aware_put
+
+_STOP = object()  # queue sentinel: no more tiles
+
+
+def _default_place(tile):
+    import jax
+
+    return jax.device_put(tile)
+
+
+def prefetch_to_device(
+    tiles: Iterable,
+    *,
+    depth: int = 2,
+    place: Optional[Callable] = None,
+    stall_timeout_s: Optional[float] = 900.0,
+) -> Iterator:
+    """Yield ``place(tile)`` for each host tile, staging up to ``depth``
+    tiles ahead on a background thread.
+
+    ``tiles`` is any iterable (typically a plane-tile generator — its
+    ``next()`` runs on the worker thread, so the f64 host math itself
+    overlaps device compute); ``place`` defaults to ``jax.device_put``
+    (asynchronous on real backends: the H2D copy overlaps the
+    consumer's compute, which is the point). Tiles are yielded strictly
+    in input order.
+    """
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1 (got {depth})")
+    if place is None:
+        place = _default_place
+
+    window = threading.Semaphore(depth)
+    out_q: queue.Queue = queue.Queue()
+    stop = threading.Event()
+    errors: list = []  # [exc] — first entry wins
+    stage_started = [None]  # single-writer heartbeat (worker writes)
+    stall_s = [0.0]
+    stack = TRACER.current_stack()  # nest worker spans under the caller's
+
+    def _worker() -> None:
+        with TRACER.inherit(stack):
+            it = iter(tiles)
+            i = 0
+            while not stop.is_set():
+                while not window.acquire(timeout=0.1):
+                    if stop.is_set():
+                        break
+                if stop.is_set():
+                    break
+                try:
+                    stage_started[0] = time.monotonic()
+                    with span(names.SPAN_CW_STREAM_STAGE, tile=i) as sp:
+                        try:
+                            tile = next(it)
+                        except StopIteration:
+                            sp["eos"] = True
+                            stage_started[0] = None
+                            break
+                        nbytes = tree_nbytes(tile)
+                        staged = place(tile)
+                        sp["nbytes"] = nbytes
+                    stage_started[0] = None
+                    counter(names.CW_STREAM_BYTES_STAGED).inc(nbytes)
+                except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
+                    stage_started[0] = None
+                    errors.append(exc)
+                    stop.set()
+                    break
+                if not _stop_aware_put(out_q, (i, staged), stop):
+                    break
+                i += 1
+            # always deliver the sentinel, even when stopping: the
+            # consumer may be parked on an empty queue
+            try:
+                out_q.put_nowait(_STOP)
+            except queue.Full:  # pragma: no cover — out_q is unbounded
+                pass
+
+    worker = threading.Thread(
+        target=_worker, name="cw-stream-prefetch", daemon=True
+    )
+    worker.start()
+
+    # NOTE: the cw_stream.tiles_done gauge is deliberately NOT set here:
+    # this stage's unit is "staged items", which consumers may group
+    # (cw_stream_response stages macros of tiles_per_step tiles) — the
+    # consumer owns the gauge so it always reads in TILE units.
+    try:
+        while True:
+            t_wait = time.monotonic()
+            while True:
+                try:
+                    item = out_q.get(timeout=0.1)
+                    break
+                except queue.Empty:
+                    if _stage_overdue(stage_started, stall_timeout_s):
+                        raise DrainTimeout(
+                            "host->device tile staging exceeded "
+                            f"{stall_timeout_s:.0f}s — backend wedged"
+                        )
+            stall_s[0] += time.monotonic() - t_wait
+            gauge(names.CW_STREAM_PREFETCH_STALL_S).set(
+                round(stall_s[0], 6)
+            )
+            if item is _STOP:
+                break
+            _i, staged = item
+            yield staged
+            window.release()
+    finally:
+        stop.set()
+        worker.join(timeout=5.0)
+    if errors:
+        raise errors[0]
+
+
+# ------------------------------------------------------------ tile cache
+
+#: archive member carrying the cache metadata (also the completeness
+#: marker: tiles are written first, meta last, so a truncated archive
+#: has no meta member and the loader refuses it)
+_META_MEMBER = "meta"
+
+
+def _tile_members(i: int):
+    return f"src{i:06d}.npy", f"psr{i:06d}.npy"
+
+
+def save_plane_tiles(
+    path: str,
+    tiles: Iterable,
+    fingerprint: str,
+    meta: Optional[dict] = None,
+    durable: bool = False,
+) -> int:
+    """Serialize a plane-tile stream into one ``np.load``-compatible
+    archive at ``path``; returns the tile count.
+
+    Members ``src000000.npy`` / ``psr000000.npy`` ... are written one
+    tile at a time (ZIP_STORED, exact ``np.save`` bytes via
+    utils.sweep's serialization layer), so peak memory stays one tile
+    regardless of catalog size; the archive is built under
+    ``path + ".tmp"`` and renamed into place only when complete
+    (``durable`` adds the fsync sequence the sweep checkpoints use).
+    ``fingerprint`` is the workload fingerprint
+    (bench.build_workload(with_fingerprint=True) /
+    benchmarks/mk_workload.py) that binds the cache to its workload
+    definition — :func:`load_plane_tiles` refuses a mismatch.
+    """
+    tmp = path + ".tmp"
+    ntiles = 0
+    zf = zipfile.ZipFile(tmp, "w", zipfile.ZIP_STORED, allowZip64=True)
+    try:
+        for src, psr in tiles:
+            sname, pname = _tile_members(ntiles)
+            for name, arr in ((sname, src), (pname, psr)):
+                with zf.open(name, "w", force_zip64=True) as fh:
+                    fh.write(npy_bytes(np.asarray(arr)))
+            ntiles += 1
+        full_meta = dict(meta or {})
+        full_meta["fingerprint"] = str(fingerprint)
+        full_meta["ntiles"] = ntiles
+        with zf.open(_META_MEMBER + ".npy", "w") as fh:
+            fh.write(npy_bytes(np.array(json.dumps(full_meta))))
+        zf.close()
+        durable_replace(tmp, path, durable)
+    except BaseException:
+        try:
+            zf.close()
+        except Exception:
+            pass
+        import os
+
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+    return ntiles
+
+
+def load_plane_tiles_meta(path: str) -> dict:
+    """The archive's metadata dict (fingerprint, ntiles, and whatever
+    the writer stamped — evolve/chunk/nsrc for CW plane caches)."""
+    with np.load(path) as z:
+        if _META_MEMBER not in z.files:
+            raise ValueError(
+                f"{path}: no '{_META_MEMBER}' member — truncated or not a "
+                "plane-tile cache"
+            )
+        return json.loads(str(z[_META_MEMBER]))
+
+
+def load_plane_tiles(path: str, expect_fingerprint: Optional[str] = None):
+    """Open a tile cache: returns ``(meta, tile_iterator)``.
+
+    The iterator yields ``(src, psr)`` numpy tiles lazily,
+    member-by-member (bounded memory — feed it straight into
+    :func:`prefetch_to_device`). ``expect_fingerprint`` refuses a cache
+    whose workload stamp differs, the same contract the static-plane
+    cache enforces in benchmarks/fast_capture.py: shape/dtype alone
+    would let a stale cache from an older workload definition
+    masquerade as current.
+    """
+    meta = load_plane_tiles_meta(path)
+    if (
+        expect_fingerprint is not None
+        and meta.get("fingerprint") != str(expect_fingerprint)
+    ):
+        raise ValueError(
+            f"{path}: plane-tile cache fingerprint "
+            f"{meta.get('fingerprint')!r} != expected "
+            f"{str(expect_fingerprint)!r} — rebuild the cache "
+            "(benchmarks/mk_workload.py) for this workload definition"
+        )
+
+    def _iter():
+        with np.load(path) as z:
+            for i in range(int(meta["ntiles"])):
+                sname, pname = _tile_members(i)
+                yield z[sname], z[pname]
+
+    return meta, _iter()

@@ -18,6 +18,23 @@ from typing import Dict
 from ..obs import trace as _trace
 
 
+def vm_rss_mb() -> float:
+    """Current VmRSS in MB (Linux ``/proc``; 0.0 where unavailable).
+
+    The ONE implementation of the RSS probe the bounded-memory
+    instrumentation uses (benchmarks/cw_scaling.py's ``memprobe`` and
+    the peak-RSS-bounded plane-build test) — a drifted copy would let
+    the benchmark and the test disagree about what "bounded" means."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
 def stage(name: str):
     """Time a host-side stage: ``with stage('ingest'): ...``
 

@@ -209,6 +209,17 @@ def _atomic_write(write_fn, final_path: str, suffix: str,
             os.remove(tmp)
 
 
+# Public aliases: the exact-npy serialization + atomic/durable-replace
+# sequence is the repo's ONE checkpoint-byte layer. The CW plane-tile
+# cache (parallel.prefetch.save_plane_tiles — npz members streamed one
+# tile at a time, renamed into place when complete) builds on these so
+# a tile archive can never drift to weaker atomicity/durability
+# guarantees than the sweep checkpoints carry.
+npy_bytes = _npy_bytes
+atomic_write = _atomic_write
+durable_replace = _durable_replace
+
+
 class _IncrementalNpz:
     """Consolidated-npz builder that appends members one at a time.
 

@@ -1,0 +1,414 @@
+"""Streamed CW-catalog plane pipeline: tiled host precompute
+bit-identity, double-buffered prefetch ordering/bounds/crash semantics
+(mirroring test_pipeline.py's executor contract), bounded peak RSS of
+the tiled build, and the on-disk tile cache's fingerprint gate."""
+import json
+import threading
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bench import random_cw_catalog
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models import batched as B
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.parallel.pipeline import DrainTimeout
+from pta_replicator_tpu.parallel.prefetch import (
+    load_plane_tiles,
+    load_plane_tiles_meta,
+    prefetch_to_device,
+    save_plane_tiles,
+)
+
+
+@pytest.fixture(scope="module")
+def cw_setup():
+    batch = synthetic_batch(npsr=5, ntoa=300, nbackend=2, seed=0)
+    cat = random_cw_catalog(np.random.default_rng(3), 10_000)
+    args = [jnp.asarray(r) for r in cat]
+    return batch, cat, args
+
+
+# -------------------------------------------------- plane bit-identity
+
+def test_plane_tiles_bit_identical_to_monolithic(cw_setup):
+    """Concatenated tiles == the monolithic plane set, exactly (the
+    per-source math never crosses sources, so slicing is lossless)."""
+    batch, _cat, args = cw_setup
+    src_c, psr_c, _ = B.cw_catalog_planes_for(batch, *args)
+    tiles = list(B.cw_catalog_plane_tiles_for(batch, *args, chunk=1024))
+    assert len(tiles) == 10  # 10_000 / 1024, last tile narrower
+    assert tiles[-1][0].shape[-1] == 10_000 - 9 * 1024
+    np.testing.assert_array_equal(
+        np.concatenate([s for s, _ in tiles], axis=-1), np.asarray(src_c)
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([p for _, p in tiles], axis=-1), np.asarray(psr_c)
+    )
+
+
+def test_plane_tiles_bit_identical_with_pdist_pphase(cw_setup):
+    """(Np, Ns) pdist and (Ns,) pphase window along the source axis."""
+    batch, cat, args = cw_setup
+    rng = np.random.default_rng(9)
+    ns = cat.shape[1]
+    pdist = rng.uniform(0.5, 2.0, (batch.npsr, ns))
+    src_c, psr_c, _ = B.cw_catalog_planes_for(batch, *args, pdist=pdist)
+    tiles = B.cw_catalog_plane_tiles_for(
+        batch, *args, pdist=pdist, chunk=997
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([p for _, p in tiles], axis=-1), np.asarray(psr_c)
+    )
+    pphase = rng.uniform(0, 2 * np.pi, ns)
+    src_c, psr_c, _ = B.cw_catalog_planes_for(batch, *args, pphase=pphase)
+    tiles = B.cw_catalog_plane_tiles_for(
+        batch, *args, pphase=pphase, chunk=2048
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([p for _, p in tiles], axis=-1), np.asarray(psr_c)
+    )
+
+
+# ---------------------------------------------- response bit-identity
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_streamed_response_bit_identical_at_depth(cw_setup, depth):
+    """The full streamed pipeline (tiled build -> prefetch -> jitted
+    accumulation) equals the monolithic scan backend EXACTLY, at every
+    prefetch-window depth: same per-tile op sequence, same tile order,
+    same f32 accumulation order."""
+    batch, _cat, args = cw_setup
+    src_c, psr_c, evolve = B.cw_catalog_planes_for(batch, *args)
+    mono = np.asarray(
+        B.cgw_catalog_delays_from_planes(
+            batch, src_c, psr_c, evolve=evolve, chunk=1024
+        )
+    )
+    streamed = np.asarray(
+        B.cgw_catalog_delays_streamed(
+            batch, *args, chunk=1024, prefetch_depth=depth
+        )
+    )
+    np.testing.assert_array_equal(streamed, mono)
+
+
+@pytest.mark.parametrize("tps", [1, 3, 8])
+def test_streamed_response_bit_identical_across_groupings(cw_setup, tps):
+    """Macro-tile grouping (tiles_per_step) is a dispatch-amortization
+    knob only: the accumulator threads through every scan as the carry,
+    so ANY grouping reproduces the monolithic accumulation order."""
+    batch, _cat, args = cw_setup
+    src_c, psr_c, evolve = B.cw_catalog_planes_for(batch, *args)
+    mono = np.asarray(
+        B.cgw_catalog_delays_from_planes(
+            batch, src_c, psr_c, evolve=evolve, chunk=512
+        )
+    )
+    streamed = np.asarray(
+        B.cgw_catalog_delays_streamed(
+            batch, *args, chunk=512, tiles_per_step=tps
+        )
+    )
+    np.testing.assert_array_equal(streamed, mono)
+    # the tiles_done gauge reads in TILE units at every grouping, not
+    # in staged-macro units (10_000 sources / 512-wide tiles = 20)
+    from pta_replicator_tpu import obs
+    from pta_replicator_tpu.obs import names
+
+    assert obs.gauge(names.CW_STREAM_TILES_DONE).value == 20
+
+
+def test_stream_misaligned_tile_rejected(cw_setup):
+    """A narrow tile anywhere but the stream tail would misalign the
+    scan windows — must raise, not silently break bit-identity."""
+    batch, _cat, _args = cw_setup
+
+    def bad_tiles():
+        from pta_replicator_tpu.ops.pallas_cw import NC_PSR, NC_SRC
+
+        np_ = batch.npsr
+        yield np.zeros((NC_SRC, 64)), np.zeros((NC_PSR, np_, 64))
+        yield np.zeros((NC_SRC, 32)), np.zeros((NC_PSR, np_, 32))
+        yield np.zeros((NC_SRC, 64)), np.zeros((NC_PSR, np_, 64))
+
+    with pytest.raises(ValueError, match="width"):
+        B.cw_stream_response(batch, bad_tiles(), evolve=True)
+
+
+def test_streamed_response_linear_modes_bit_identical(cw_setup):
+    """The non-evolve kernel variants (phase-approx, monochromatic)
+    stream identically too — the evolve flag travels with the planes."""
+    batch, _cat, args = cw_setup
+    for kw in (
+        dict(evolve=False, phase_approx=True),
+        dict(evolve=False, phase_approx=False),
+    ):
+        mono = np.asarray(
+            B.cgw_catalog_delays(batch, *args, chunk=512, **kw)
+        )
+        streamed = np.asarray(
+            B.cgw_catalog_delays_streamed(batch, *args, chunk=512, **kw)
+        )
+        np.testing.assert_array_equal(streamed, mono)
+
+
+def test_recipe_streamed_routing_bit_identical(cw_setup):
+    """Recipe.cgw_stream_chunk routes deterministic_delays through the
+    streamed pipeline with identical results (so sweeps/benches can
+    flip one static field to go bounded-memory)."""
+    import dataclasses
+
+    batch, cat, _args = cw_setup
+    r_mono = Recipe(cgw_params=jnp.asarray(cat), cgw_chunk=1024)
+    r_stream = dataclasses.replace(r_mono, cgw_stream_chunk=1024)
+    np.testing.assert_array_equal(
+        np.asarray(B.deterministic_delays(batch, r_stream)),
+        np.asarray(B.deterministic_delays(batch, r_mono)),
+    )
+
+
+def test_streamed_requires_concrete_params(cw_setup):
+    """Tracer params must raise with guidance, not silently demote the
+    f64 host precompute (the monolithic traced fallback has no streamed
+    analog — streaming exists for the bounded-memory HOST build)."""
+    batch, _cat, args = cw_setup
+
+    def traced(theta):
+        return B.cgw_catalog_delays_streamed(batch, theta, *args[1:])
+
+    with pytest.raises(TypeError, match="concrete"):
+        jax.jit(traced)(args[0])
+
+
+@pytest.mark.slow
+def test_streamed_response_bit_identical_1e5(cw_setup):
+    batch, _cat, _args = cw_setup
+    cat = random_cw_catalog(np.random.default_rng(11), 100_000)
+    args = [jnp.asarray(r) for r in cat]
+    mono = np.asarray(B.cgw_catalog_delays(batch, *args, chunk=4096))
+    streamed = np.asarray(
+        B.cgw_catalog_delays_streamed(batch, *args, chunk=4096)
+    )
+    np.testing.assert_array_equal(streamed, mono)
+
+
+# --------------------------------------------------- prefetch executor
+
+def test_prefetch_orders_and_bounds():
+    """Tiles come out strictly in input order; never more than ``depth``
+    tiles exist past the host generator at once."""
+    outstanding = [0]  # built but not yet consumed
+    peak = [0]
+    lock = threading.Lock()
+
+    def tiles():
+        for i in range(12):
+            with lock:
+                outstanding[0] += 1
+                peak[0] = max(peak[0], outstanding[0])
+            yield np.full((4,), i)
+
+    got = []
+    for staged in prefetch_to_device(tiles(), depth=3):
+        time.sleep(0.005)  # let the worker run ahead into the window
+        got.append(int(np.asarray(staged)[0]))
+        with lock:
+            outstanding[0] -= 1
+    assert got == list(range(12))
+    assert peak[0] <= 3 + 1  # window + the one being consumed
+
+
+def test_prefetch_depth1_is_serial():
+    """depth=1: tile k+1 is not built until tile k was consumed."""
+    events = []
+
+    def tiles():
+        for i in range(4):
+            events.append(("build", i))
+            yield np.asarray([i])
+
+    for i, staged in enumerate(prefetch_to_device(tiles(), depth=1)):
+        time.sleep(0.02)
+        events.append(("consume", i))
+    builds_before_first_consume = [
+        e for e in events[: events.index(("consume", 0))] if e[0] == "build"
+    ]
+    assert builds_before_first_consume == [("build", 0)]
+
+
+def test_prefetch_depth0_rejected():
+    with pytest.raises(ValueError, match="depth"):
+        list(prefetch_to_device(iter([np.zeros(1)]), depth=0))
+
+
+def test_prefetch_propagates_tile_build_exception_unchanged():
+    """A tile-build crash re-raises UNCHANGED on the consumer, after
+    every earlier tile was delivered in order (mirror of the pipelined
+    executor's stage-exception contract)."""
+
+    class Boom(Exception):
+        pass
+
+    def tiles():
+        yield np.asarray([0])
+        yield np.asarray([1])
+        raise Boom("tile build failed")
+
+    got = []
+    with pytest.raises(Boom):
+        for staged in prefetch_to_device(tiles(), depth=2):
+            got.append(int(np.asarray(staged)[0]))
+    assert got == [0, 1]
+
+
+def test_prefetch_propagates_place_exception_unchanged():
+    class Boom(Exception):
+        pass
+
+    def place(tile):
+        if int(tile[0]) == 2:
+            raise Boom("staging failed")
+        return tile
+
+    got = []
+    with pytest.raises(Boom):
+        for staged in prefetch_to_device(
+            (np.asarray([i]) for i in range(5)), depth=2, place=place
+        ):
+            got.append(int(staged[0]))
+    assert got == [0, 1]
+
+
+def test_prefetch_stall_timeout():
+    """A wedged device_put (hung tunnel) raises DrainTimeout fast — the
+    same failure type a wedged sweep readback raises."""
+    hang = threading.Event()
+
+    def place(tile):
+        hang.wait(20.0)  # never set: simulated wedge
+        return tile
+
+    t0 = time.monotonic()
+    with pytest.raises(DrainTimeout):
+        for _ in prefetch_to_device(
+            (np.asarray([i]) for i in range(3)),
+            depth=2, place=place, stall_timeout_s=0.4,
+        ):
+            pass
+    assert time.monotonic() - t0 < 10.0
+    hang.set()
+
+
+def test_prefetch_consumer_abandon_stops_worker():
+    """Breaking out of the consumer loop (exception upstream) must stop
+    the worker thread promptly, not leak it spinning on the window."""
+    built = [0]
+
+    def tiles():
+        for i in range(100):
+            built[0] += 1
+            yield np.asarray([i])
+
+    gen = prefetch_to_device(tiles(), depth=2)
+    next(gen)
+    gen.close()  # consumer abandons
+    time.sleep(0.3)
+    assert built[0] <= 5  # worker stopped near the window bound
+
+
+# ------------------------------------------------ bounded-memory build
+
+from pta_replicator_tpu.utils.profiling import vm_rss_mb as _vm_rss_mb
+
+
+def test_tiled_build_peak_rss_bounded():
+    """Iterating the tiled precompute at a shape whose MONOLITHIC f64
+    plane set needs >=300 MB (6 x 32 x 2e5 x 8B for the psr stack
+    alone, ~3x that with intermediates) must not grow RSS by more than
+    ~a tile's worth of working set."""
+    batch = synthetic_batch(npsr=32, ntoa=64, nbackend=2, seed=1)
+    cat = random_cw_catalog(np.random.default_rng(5), 200_000)
+    rss0 = _vm_rss_mb()
+    if rss0 == 0.0:
+        pytest.skip("no /proc VmRSS on this platform")
+    peak = rss0
+    ntiles = 0
+    for src_t, psr_t in B.cw_catalog_plane_tiles_for(
+        batch, *cat, chunk=4096
+    ):
+        assert src_t.shape[-1] <= 4096
+        ntiles += 1
+        if ntiles % 8 == 0:
+            peak = max(peak, _vm_rss_mb())
+    peak = max(peak, _vm_rss_mb())
+    assert ntiles == 49
+    growth = peak - rss0
+    assert growth < 200.0, (
+        f"tiled plane build grew RSS by {growth:.0f} MB — the bounded-"
+        "memory contract (O(Np x chunk), not O(Np x Ns)) is broken"
+    )
+
+
+# ------------------------------------------------------- tile cache
+
+def test_tile_cache_roundtrip_identity(tmp_path, cw_setup):
+    """save -> load -> stream equals the monolithic response exactly;
+    metadata and tile count survive the roundtrip."""
+    batch, _cat, args = cw_setup
+    path = str(tmp_path / "tiles.npz")
+    n = save_plane_tiles(
+        path,
+        B.cw_catalog_plane_tiles_for(batch, *args, chunk=1024),
+        fingerprint="fp-abc",
+        meta={"evolve": True, "chunk": 1024},
+    )
+    assert n == 10
+    meta, tiles = load_plane_tiles(path, expect_fingerprint="fp-abc")
+    assert meta["ntiles"] == 10 and meta["chunk"] == 1024
+    src_c, psr_c, evolve = B.cw_catalog_planes_for(batch, *args)
+    mono = np.asarray(
+        B.cgw_catalog_delays_from_planes(
+            batch, src_c, psr_c, evolve=evolve, chunk=1024
+        )
+    )
+    streamed = np.asarray(
+        B.cw_stream_response(batch, tiles, evolve=True, prefetch_depth=2)
+    )
+    np.testing.assert_array_equal(streamed, mono)
+
+
+def test_tile_cache_fingerprint_refusal(tmp_path, cw_setup):
+    batch, _cat, args = cw_setup
+    path = str(tmp_path / "tiles.npz")
+    save_plane_tiles(
+        path,
+        B.cw_catalog_plane_tiles_for(batch, *args, chunk=4096),
+        fingerprint="fp-old",
+    )
+    with pytest.raises(ValueError, match="fingerprint"):
+        load_plane_tiles(path, expect_fingerprint="fp-new")
+    # without an expectation the cache still opens (inspection tools)
+    meta, _ = load_plane_tiles(path)
+    assert meta["fingerprint"] == "fp-old"
+
+
+def test_tile_cache_truncated_archive_refused(tmp_path):
+    """Tiles are written before the meta member, so an archive that
+    died mid-write has no meta and must be refused, not half-read."""
+    path = str(tmp_path / "trunc.npz")
+    with zipfile.ZipFile(path, "w") as zf:
+        with zf.open("src000000.npy", "w") as fh:
+            bio = np.lib.format
+            import io
+
+            b = io.BytesIO()
+            np.save(b, np.zeros((9, 4)), allow_pickle=False)
+            fh.write(b.getbuffer())
+    with pytest.raises(ValueError, match="meta"):
+        load_plane_tiles_meta(path)
