@@ -657,24 +657,31 @@ def _bench():
         except Exception as exc:
             extra["cpu_oracle_error"] = repr(exc)[:200]
 
-    # ---- achieved FLOP/s + MFU from XLA's own cost model (VERDICT r2
-    # weak #3: "fast" must be a measured claim). Peak reference: bf16
-    # MXU peak for the recorded device_kind; the workload is f32, so
-    # this MFU is a conservative lower bound on hardware utilization.
+    # ---- achieved FLOP/s + roofline from XLA's own cost model (VERDICT
+    # r2 weak #3: "fast" must be a measured claim). One shared extraction
+    # (obs.devprof, also used by benchmarks/fast_capture.py): jax.cost.*
+    # and jax.roofline.* gauges land in the telemetry block below, and
+    # the flat fields (xla_flops_per_chunk, achieved_tflops_per_s,
+    # mfu_vs_bf16_peak_pct, intensity, bound class) keep their bench-diff
+    # alignable spellings. The MFU peak is the bf16 MXU number for the
+    # recorded device_kind; the workload is f32, so MFU is a conservative
+    # lower bound on hardware utilization.
+    from pta_replicator_tpu.obs import devprof
+
+    extra.update(devprof.bench_cost_fields(
+        compiled, reps=nrep, elapsed_s=elapsed,
+        device_kind=extra["device_kind"], label="bench.run_chunk",
+    ))
+
+    # instrumented_jit labels that (re)compiled during this run (the
+    # sweep A/B's realize engine): record their jax.cost.* gauges too.
+    # CPU-only inside capture_pending — on the tunneled TPU a re-lower
+    # could burn the window, and the AOT block above already covers the
+    # headline executable.
     try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        flops_per_chunk = float(ca.get("flops", 0.0))
-        if flops_per_chunk > 0:
-            achieved = flops_per_chunk * nrep / elapsed
-            extra["xla_flops_per_chunk"] = flops_per_chunk
-            extra["achieved_tflops_per_s"] = round(achieved / 1e12, 3)
-            peak = {"TPU v5 lite": 197e12}.get(extra["device_kind"])
-            if peak:
-                extra["mfu_vs_bf16_peak_pct"] = round(100 * achieved / peak, 3)
+        devprof.capture_pending()
     except Exception as exc:
-        extra["cost_analysis_error"] = repr(exc)
+        extra["devprof_pending_error"] = repr(exc)[:150]
 
     # ---- per-stage breakdown (VERDICT r2 item 3): ms per realization of
     # each injection op, measured standalone over a small key batch

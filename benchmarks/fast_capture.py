@@ -202,22 +202,14 @@ def measure(chunk, nrep, tag, budget=600):
            "compile_s": round(compile_s, 1), "warmup_s": round(warm_s, 2),
            "vs_baseline": round(rate / _NORTH_STAR_RATE, 3),
            "cgw_static_amortized": True}
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        fl = float(ca.get("flops", 0.0))
-        if fl > 0:
-            rec["xla_flops_per_chunk"] = fl
-            rec["achieved_tflops_per_s"] = round(fl * nrep / elapsed / 1e12, 3)
-            # peak gated on device_kind exactly as bench.py does: an MFU
-            # against TPU peak is meaningless in a CPU harness run
-            peak = {"TPU v5 lite": 197e12}.get(META["device_kind"])
-            if peak:
-                rec["mfu_vs_bf16_peak_pct"] = round(
-                    100 * fl * nrep / elapsed / peak, 3)
-    except Exception as exc:
-        rec["cost_analysis_error"] = repr(exc)[:150]
+    # one shared cost/roofline extraction with bench.py (obs.devprof):
+    # same field spellings, same peak table gated on device_kind (an MFU
+    # against TPU peak is meaningless in a CPU harness run), same error
+    # handling — the two hand-rolled copies had drifted
+    from pta_replicator_tpu.obs import devprof
+    rec.update(devprof.bench_cost_fields(
+        compiled, reps=nrep, elapsed_s=elapsed,
+        device_kind=META["device_kind"], label=f"fast_capture.{tag}"))
     return emit(rec)
 
 

@@ -68,7 +68,7 @@ def _pipeline_spans(summary: dict) -> dict:
 
 def run_arm(depth, key, batch, recipe, nreal, chunk, workdir):
     """One sweep at ``depth`` into a fresh checkpoint; returns
-    (wall_s, telemetry, sha256 of the consolidated npz).
+    (wall_s, telemetry, occupancy, sha256 of the consolidated npz).
 
     A FRESH subdirectory per invocation: re-writing the same chunk
     filenames would hit warm page-cache/9p entries on later reps,
@@ -91,6 +91,18 @@ def run_arm(depth, key, batch, recipe, nreal, chunk, workdir):
           durable=True)
     wall = time.perf_counter() - t0
     telem = _pipeline_spans(obs.TRACER.summary())
+    # measured stage occupancy of this arm (duty cycle per stage,
+    # overlap efficiency, bottleneck verdict) from the same spans the
+    # report's utilization section reads — the A/B's wall reduction and
+    # this number must tell one story. Without a configured sink the
+    # tracer's in-memory buffer caps at IDLE_MAX_EVENTS: a huge arm
+    # (>~650 chunks) would silently analyze only its first part, so a
+    # truncated buffer yields no occupancy block rather than a wrong one
+    if obs.TRACER.dropped:
+        occ = {"skipped": f"{obs.TRACER.dropped} span records dropped "
+                          "(arm larger than the idle event buffer)"}
+    else:
+        occ = obs.occupancy.analyze(obs.TRACER.events())
     # streaming digest, not raw bytes: at the default config each
     # consolidated npz is ~0.5 GiB — holding both arms' archives
     # resident would pressure the page cache of the very host the A/B
@@ -100,7 +112,7 @@ def run_arm(depth, key, batch, recipe, nreal, chunk, workdir):
         for piece in iter(lambda: fh.read(1 << 22), b""):
             h.update(piece)
     shutil.rmtree(arm_dir, ignore_errors=True)
-    return wall, telem, h.hexdigest()
+    return wall, telem, occ, h.hexdigest()
 
 
 def main():
@@ -131,16 +143,18 @@ def main():
 
         results = {1: [], depth: []}
         telem = {}
+        occs = {}
         digests = {}
         # interleave arms so filesystem-cache drift hits both equally
         for _ in range(nrep):
             for dep in (1, depth):
-                wall, t, digest = run_arm(
+                wall, t, occ, digest = run_arm(
                     dep, key, batch, recipe, nreal, chunk, d
                 )
                 results[dep].append(wall)
                 if dep not in telem or wall <= min(results[dep]):
                     telem[dep] = t  # keep the best rep's span profile
+                    occs[dep] = occ
                 digests[dep] = digest
 
         # median over interleaved reps: the shared-host 9p filesystem and
@@ -170,6 +184,18 @@ def main():
                 "depth1": telem[1],
                 f"depth{depth}": telem[depth],
             },
+            # the A/B's 1 - depthN/depth1 wall reduction above is the
+            # outcome; this block is the mechanism, measured: per-stage
+            # duty, overlap efficiency (wall vs the serial
+            # counterfactual of the same stage busy times), and the
+            # bottleneck verdict for each arm
+            "occupancy": {
+                "depth1": occs.get(1),
+                f"depth{depth}": occs.get(depth),
+            },
+            "measured_overlap_efficiency": (occs.get(depth) or {}).get(
+                "overlap_efficiency"
+            ),
             "timestamp": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),

@@ -112,8 +112,9 @@ def main(argv=None):
                    help="hide span paths with total wall below this")
     p = sub.add_parser(
         "watch", help="tail a live run's progress.json heartbeat (one "
-                      "line per tick; exits when the run finishes or "
-                      "leaves a postmortem)")
+                      "line per tick, including the stage-occupancy "
+                      "bottleneck verdict; exits when the run finishes "
+                      "or leaves a postmortem)")
     p.add_argument("dir", help="the run's --telemetry directory")
     p.add_argument("--interval", type=float, default=2.0, metavar="S",
                    help="poll period in seconds (default 2)")
@@ -135,6 +136,12 @@ def main(argv=None):
                    help="relative regression gate (default 0.10 = 10%%; "
                         "half of it is the warn band)")
     p = sub.choices["realize"]
+    p.add_argument("--device-trace", action="store_true",
+                   help="also capture an XLA device trace (jax.profiler) "
+                        "around the run, into <telemetry dir>/xla_trace, "
+                        "registered as a capture artifact in meta.json "
+                        "(view in TensorBoard/Perfetto); requires "
+                        "--telemetry")
     p.add_argument("--recipe", required=True, help="JSON recipe file")
     p.add_argument("--nreal", type=int, default=100)
     p.add_argument("--out", required=True)
@@ -228,16 +235,26 @@ def main(argv=None):
         jax.config.update("jax_platforms", args.platform)
 
     telemetry = getattr(args, "telemetry", None)
+    if getattr(args, "device_trace", False) and not telemetry:
+        raise SystemExit("--device-trace requires --telemetry DIR (the "
+                         "trace is an artifact of the capture)")
     if not telemetry:
         return _run_command(args)
 
     # capture mode: stream spans/metrics (and JAX compile accounting)
     # into the telemetry dir; flush artifacts even when the run raises
+    import contextlib
+
     from . import obs
 
     obs.start_capture(telemetry)
     try:
-        with obs.span(args.cmd):
+        xla_trace = (
+            obs.devprof.device_trace()
+            if getattr(args, "device_trace", False)
+            else contextlib.nullcontext()
+        )
+        with obs.span(args.cmd), xla_trace:
             return _run_command(args)
     finally:
         obs.finish_capture(context={

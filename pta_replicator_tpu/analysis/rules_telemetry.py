@@ -231,10 +231,23 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/models/batched.py", "metric", n.CW_STREAM_TILES_DONE),
         (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),
         (f"{pkg}/obs/flightrec.py", "event", n.EVENT_FLIGHTREC_STALL),
+        # stage-occupancy + device-cost layer (PR 6): the heartbeat's
+        # duty gauges, the prefetcher's busy accounting, the managed
+        # jax.profiler capture, and the jax.cost./jax.roofline. gauge
+        # families (emitted via the names.py prefix constants — the
+        # text markers pin the constants' use, the f-strings themselves
+        # aren't statically checkable)
+        (f"{pkg}/obs/flightrec.py", "metric", n.OCCUPANCY_DUTY_CYCLE),
+        (f"{pkg}/parallel/prefetch.py", "metric", n.OCCUPANCY_BUSY_S),
+        (f"{pkg}/obs/devprof.py", "span", n.SPAN_DEVICE_TRACE),
+        (f"{pkg}/obs/devprof.py", "event", n.EVENT_DEVICE_TRACE),
+        (f"{pkg}/obs/devprof.py", "text", "JAX_COST_PREFIX"),
+        (f"{pkg}/obs/devprof.py", "text", "JAX_ROOFLINE_PREFIX"),
         (f"{pkg}/__main__.py", "span", n.SPAN_COMPUTE),
         (f"{pkg}/__main__.py", "span", n.SPAN_INGEST),
         ("bench.py", "span", n.SPAN_BENCH_MEASURE),
         ("bench.py", "text", "BENCH_TELEMETRY"),
+        ("bench.py", "text", "bench_cost_fields"),
     )
 
 

@@ -26,7 +26,17 @@ from __future__ import annotations
 import sys
 import time
 
-from . import flightrec, jaxhooks, metrics, names, regress, report, trace
+from . import (
+    devprof,
+    flightrec,
+    jaxhooks,
+    metrics,
+    names,
+    occupancy,
+    regress,
+    report,
+    trace,
+)
 from .flightrec import FlightRecorder, StallWarning
 from .jaxhooks import (
     RetraceWarning,
@@ -48,7 +58,7 @@ __all__ = [
     "trace_count", "tree_nbytes", "start_capture", "finish_capture",
     "telemetry_summary", "reset_all", "metrics", "trace", "report",
     "jaxhooks", "flightrec", "regress", "FlightRecorder", "StallWarning",
-    "names",
+    "names", "devprof", "occupancy",
 ]
 
 
@@ -83,6 +93,7 @@ def start_capture(
         stale.stop(finished=False)
     TRACER.reset()
     REGISTRY.reset()
+    devprof.reset()
     trace.configure(directory)
     # one capture dir describes ONE run: configure() truncated
     # events.jsonl, and a previous run's black box must go too, or a
@@ -140,6 +151,9 @@ def finish_capture(context: dict = None) -> None:
         "dropped_events": TRACER.dropped,
         "device_memory": device_memory_snapshot(),
     }
+    traces = devprof.trace_dirs(relative_to=directory)
+    if traces:
+        meta["device_traces"] = traces
     if "jax" in sys.modules:
         import jax
 
@@ -192,3 +206,4 @@ def reset_all() -> None:
         rec.stop(finished=False)
     TRACER.reset()
     REGISTRY.reset()
+    devprof.reset()

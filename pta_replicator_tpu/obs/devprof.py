@@ -1,0 +1,444 @@
+"""Device-time attribution: XLA cost/memory accounting, roofline
+classification, and managed ``jax.profiler`` trace capture.
+
+The host-side tracer (obs/trace.py) says how long a stage took; this
+module says what the *device* was asked to do in it, from XLA's own
+numbers:
+
+* **cost capture** — :func:`record_compiled` extracts
+  ``Compiled.cost_analysis()`` (flops, bytes accessed, transcendentals)
+  and ``Compiled.memory_analysis()`` (argument/output/temp/code bytes)
+  from an AOT-compiled executable into ``jax.cost.*`` gauges, labeled
+  by jit label and cached per compilation. The hand-rolled extraction
+  blocks bench.py and benchmarks/fast_capture.py used to carry are now
+  :func:`bench_cost_fields` over this path, so both emit the same
+  schema and error handling.
+* **roofline** — :func:`roofline` combines flops/bytes with a measured
+  elapsed time and the per-backend :data:`PEAK_TABLE` into achieved
+  FLOP/s and bytes/s, arithmetic intensity, and (when the device's
+  peaks are known) percent-of-roofline plus the ridge intensity that
+  separates compute-bound from memory-bound — all exported as
+  ``jax.roofline.*`` gauges the report renders with a
+  compute/memory-bound verdict.
+* **instrumented_jit labels** — the jaxhooks retrace probe also records
+  each label's argument avals at trace time (shape/dtype only, zero
+  device traffic); :func:`capture_pending` later lowers+compiles from
+  those avals and records the costs. Guarded: lowering implies an XLA
+  compile, so pending labels are only captured on the CPU backend (or
+  with ``force=True``) — on the tunneled TPU a recompile can burn a
+  whole capture window; there the evidence channel is the profiler
+  trace below. With the persistent compilation cache configured
+  (bench.py does) the CPU-side compile is near-free on reruns.
+* **managed device trace** — :func:`device_trace` wraps
+  ``jax.profiler.start_trace``/``stop_trace``, defaults its logdir
+  INSIDE the active capture directory, and registers the directory as a
+  capture artifact (an ``devprof.device_trace`` event plus a
+  ``device_traces`` list in meta.json), so the per-kernel XLA evidence
+  from a rare TPU tunnel window is referenced from the run's report
+  instead of being an orphan directory.
+
+jax is imported lazily per call: the module stays importable (and
+cheap) in the jax-free report/lint tooling.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import weakref
+from typing import Dict, Optional, Tuple
+
+from . import names
+from .metrics import REGISTRY
+from .trace import TRACER
+
+#: device_kind -> (peak FLOP/s, peak HBM bytes/s). FLOP peaks are the
+#: bf16 MXU numbers (the workload is f32, so every MFU derived from
+#: this table is a conservative lower bound on utilization — the same
+#: convention bench.py has recorded since round 2).
+PEAK_TABLE: Dict[str, Tuple[float, float]] = {
+    "TPU v5 lite": (197e12, 819e9),
+    "TPU v5": (459e12, 2765e9),
+    "TPU v5p": (459e12, 2765e9),
+    "TPU v4": (275e12, 1228e9),
+    "TPU v3": (123e12, 900e9),
+    "TPU v2": (46e12, 700e9),
+}
+
+#: env overrides for backends the table doesn't know (a CPU roofline is
+#: meaningless without them; achieved/intensity gauges still export)
+_PEAK_FLOPS_ENV = "DEVPROF_PEAK_FLOPS"
+_PEAK_BYTES_ENV = "DEVPROF_PEAK_BYTES_PER_S"
+
+_lock = threading.Lock()
+#: label -> (weakref to the executable, extracted cost dict). Cache per
+#: compilation: the same executable is extracted once no matter how many
+#: measure loops re-report it; a weakref, not id(), because a recycled
+#: address after GC must not make a NEW compilation read as recorded.
+_RECORDED: Dict[str, tuple] = {}
+#: label -> (args avals, kwargs avals, weakref-to-wrapper) noted at
+#: instrumented_jit trace time, awaiting capture_pending. The wrapper
+#: ref travels WITH the avals: several jit instances may share a label
+#: (the lru_cached mesh engines), and lowering instance B from instance
+#: A's avals would record a program that never ran.
+_PENDING: Dict[str, tuple] = {}
+#: logdirs registered by managed device-trace captures this run
+_TRACE_DIRS: list = []
+
+#: set while capture_pending is lowering a wrapper on THIS thread —
+#: the instrumented_jit probe consults it so the synthetic measurement
+#: lowering never counts as a retrace (or re-arms the pending set)
+_CAPTURING = threading.local()
+
+
+def measurement_in_progress() -> bool:
+    """True while capture_pending's synthetic lowering is running on
+    the current thread (jaxhooks skips its retrace probe then: the
+    measurement must not perturb the retrace counters it reports on,
+    nor re-populate the pending set it is draining)."""
+    return getattr(_CAPTURING, "active", False)
+
+
+def peak_for(device_kind: Optional[str]) -> Optional[Tuple[float, float]]:
+    """(peak FLOP/s, peak bytes/s) for a device kind, or None when
+    unknown. ``DEVPROF_PEAK_FLOPS`` / ``DEVPROF_PEAK_BYTES_PER_S`` env
+    vars override (BOTH required — a roofline needs both axes); a
+    half-set or unparseable override warns instead of silently
+    reporting no peak-relative numbers."""
+    import warnings
+
+    env_f, env_b = os.environ.get(_PEAK_FLOPS_ENV), os.environ.get(
+        _PEAK_BYTES_ENV
+    )
+    if env_f or env_b:
+        try:
+            if not (env_f and env_b):
+                raise ValueError("both env vars are required")
+            return float(env_f), float(env_b)
+        except ValueError as exc:
+            warnings.warn(
+                f"ignoring peak override ({_PEAK_FLOPS_ENV}={env_f!r}, "
+                f"{_PEAK_BYTES_ENV}={env_b!r}): {exc} — falling back to "
+                "the built-in PEAK_TABLE",
+                stacklevel=2,
+            )
+    if device_kind in PEAK_TABLE:
+        return PEAK_TABLE[device_kind]
+    return None
+
+
+def _first(obj):
+    if isinstance(obj, (list, tuple)):
+        obj = obj[0] if obj else None
+    return obj
+
+
+def extract_cost(compiled, *, strict: bool = False) -> dict:
+    """Normalized ``cost_analysis()`` dict: ``flops``,
+    ``bytes_accessed``, ``transcendentals`` (whichever XLA reported;
+    per-operand breakdown keys are dropped). {} when the backend
+    doesn't report — never raises unless ``strict``, which re-raises a
+    *failing* ``cost_analysis()`` so callers that record an error
+    marker (bench_cost_fields) can distinguish "extraction broke" from
+    "backend has no cost model"."""
+    try:
+        ca = _first(compiled.cost_analysis()) or {}
+    except Exception:
+        if strict:
+            raise
+        return {}
+    out = {}
+    for key, norm in (
+        ("flops", "flops"),
+        ("bytes accessed", "bytes_accessed"),
+        ("bytes_accessed", "bytes_accessed"),
+        ("transcendentals", "transcendentals"),
+    ):
+        val = ca.get(key)
+        if isinstance(val, (int, float)) and norm not in out and val >= 0:
+            out[norm] = float(val)
+    return out
+
+
+def extract_memory(compiled) -> dict:
+    """Normalized ``memory_analysis()`` dict (``*_bytes`` keys from
+    XLA's CompiledMemoryStats). {} when unavailable — never raises."""
+    try:
+        ma = _first(compiled.memory_analysis())
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for attr, norm in (
+        ("argument_size_in_bytes", "argument_bytes"),
+        ("output_size_in_bytes", "output_bytes"),
+        ("temp_size_in_bytes", "temp_bytes"),
+        ("alias_size_in_bytes", "alias_bytes"),
+        ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ):
+        val = getattr(ma, attr, None)
+        if isinstance(val, (int, float)) and val >= 0:
+            out[norm] = float(val)
+    return out
+
+
+def record_compiled(label: str, compiled, *, strict: bool = False) -> dict:
+    """Extract cost + memory analysis from ``compiled`` into
+    ``jax.cost.*`` gauges labeled ``label``; returns the combined dict.
+    Cached per (label, compilation): re-recording the same executable
+    returns the dict extracted the first time without re-invoking
+    ``cost_analysis()`` (non-trivial work for a large XLA program)."""
+    try:
+        ref = weakref.ref(compiled)
+    except TypeError:  # not weakref-able: never cache, always re-extract
+        ref = None
+    if ref is not None:
+        with _lock:
+            prev = _RECORDED.get(label)
+            if prev is not None and prev[0]() is compiled:
+                return dict(prev[1])
+    cost = extract_cost(compiled, strict=strict)
+    cost.update(extract_memory(compiled))
+    if ref is not None:
+        with _lock:
+            _RECORDED[label] = (ref, dict(cost))
+    for key, val in cost.items():
+        REGISTRY.gauge(
+            f"{names.JAX_COST_PREFIX}{key}", label=label
+        ).set(val)
+    return cost
+
+
+def roofline(
+    label: str,
+    *,
+    flops: float,
+    bytes_accessed: Optional[float] = None,
+    elapsed_s: float,
+    calls: int = 1,
+    device_kind: Optional[str] = None,
+) -> dict:
+    """Roofline position of ``calls`` executions of a program totalling
+    ``flops``/``bytes_accessed`` *per call* over ``elapsed_s`` seconds.
+
+    Always computes achieved FLOP/s (and bytes/s + arithmetic intensity
+    when ``bytes_accessed`` is known); with a known device peak
+    (:func:`peak_for`) adds percent-of-peak, the ridge intensity, the
+    percent of the *roofline* (the intensity-limited attainable rate),
+    and a ``bound`` classification. Everything lands in
+    ``jax.roofline.*`` gauges labeled ``label``.
+    """
+    if elapsed_s <= 0 or flops <= 0:
+        return {}
+    out: Dict[str, float] = {
+        "flops_per_s": flops * calls / elapsed_s,
+    }
+    if bytes_accessed:
+        out["bytes_per_s"] = bytes_accessed * calls / elapsed_s
+        out["intensity_flop_per_byte"] = flops / bytes_accessed
+    peak = peak_for(device_kind)
+    if peak is not None:
+        peak_flops, peak_bw = peak
+        out["pct_of_peak_flops"] = 100.0 * out["flops_per_s"] / peak_flops
+        if "intensity_flop_per_byte" in out:
+            ridge = peak_flops / peak_bw
+            out["ridge_intensity"] = ridge
+            attainable = min(
+                peak_flops, out["intensity_flop_per_byte"] * peak_bw
+            )
+            out["pct_of_roofline"] = 100.0 * out["flops_per_s"] / attainable
+    for key, val in out.items():
+        REGISTRY.gauge(
+            f"{names.JAX_ROOFLINE_PREFIX}{key}", label=label
+        ).set(val)
+    result = dict(out)
+    if "ridge_intensity" in out:
+        result["bound"] = classify(
+            out["intensity_flop_per_byte"], out["ridge_intensity"]
+        )
+    return result
+
+
+def classify(intensity: float, ridge: float) -> str:
+    """"compute-bound" when the program's arithmetic intensity sits at
+    or beyond the ridge point, else "memory-bound"."""
+    return "compute-bound" if intensity >= ridge else "memory-bound"
+
+
+def bench_cost_fields(
+    compiled,
+    *,
+    reps: int,
+    elapsed_s: float,
+    device_kind: Optional[str] = None,
+    label: str = "bench.run_chunk",
+) -> dict:
+    """The ONE bench-JSON cost block, shared by bench.py and
+    benchmarks/fast_capture.py (their two hand-rolled extraction copies
+    had already drifted): extracts + records ``jax.cost.*`` gauges for
+    ``label``, computes the roofline, and returns the flat fields both
+    harnesses embed. Keeps the historical key spellings
+    (``xla_flops_per_chunk``, ``achieved_tflops_per_s``,
+    ``mfu_vs_bf16_peak_pct``) so bench-diff aligns across rounds.
+    Never raises: failures return ``{"cost_analysis_error": ...}``.
+    """
+    try:
+        # strict: a RAISING cost_analysis() must surface as the
+        # cost_analysis_error field both harnesses have recorded since
+        # round 2, not read as "backend reports no cost model"
+        cost = record_compiled(label, compiled, strict=True)
+        flops = cost.get("flops", 0.0)
+        if flops <= 0 or elapsed_s <= 0:
+            return {}
+        out = {"xla_flops_per_chunk": flops}
+        roof = roofline(
+            label,
+            flops=flops,
+            bytes_accessed=cost.get("bytes_accessed"),
+            elapsed_s=elapsed_s,
+            calls=reps,
+            device_kind=device_kind,
+        )
+        out["achieved_tflops_per_s"] = round(roof["flops_per_s"] / 1e12, 3)
+        if "bytes_per_s" in roof:
+            out["achieved_gbytes_per_s"] = round(roof["bytes_per_s"] / 1e9, 3)
+            out["arithmetic_intensity_flop_per_byte"] = round(
+                roof["intensity_flop_per_byte"], 3
+            )
+        if "pct_of_peak_flops" in roof:
+            out["mfu_vs_bf16_peak_pct"] = round(roof["pct_of_peak_flops"], 3)
+        if "pct_of_roofline" in roof:
+            out["pct_of_roofline"] = round(roof["pct_of_roofline"], 3)
+            out["roofline_bound"] = roof["bound"]
+        return out
+    except Exception as exc:  # cost evidence must never kill a bench
+        return {"cost_analysis_error": repr(exc)[:150]}
+
+
+# ------------------------------------------- instrumented_jit capture
+
+def note_trace(
+    label: str, args: tuple, kwargs: dict, wrapper=None
+) -> None:
+    """Called from inside the instrumented_jit trace probe: snapshot the
+    call's avals (ShapeDtypeStruct for array-likes, pass-through for
+    static values) so the compilation can be reproduced abstractly.
+    ``wrapper`` is a weakref to the jit instance being traced, so a
+    label shared by several instances is always lowered from the
+    instance that produced the avals. Cheap (shape/dtype only) and
+    exception-proofed by the caller."""
+    import jax
+
+    def _aval(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    sds_args = jax.tree_util.tree_map(_aval, args)
+    sds_kwargs = jax.tree_util.tree_map(_aval, kwargs)
+    with _lock:
+        _PENDING[label] = (sds_args, sds_kwargs, wrapper)
+
+
+def capture_pending(force: bool = False) -> Dict[str, dict]:
+    """Record ``jax.cost.*`` gauges for every instrumented_jit label
+    that (re)traced since the last capture, by lowering + compiling
+    from the avals noted at trace time.
+
+    Lowering implies an XLA compile (deduped by the persistent
+    compilation cache when configured), so this runs only on the CPU
+    backend unless ``force=True`` — on the tunneled TPU a flagship
+    recompile can eat a whole capture window; the managed
+    :func:`device_trace` is the TPU-side evidence channel instead.
+    Returns {label: cost dict} for the labels captured.
+    """
+    import jax
+
+    if not force and jax.default_backend() != "cpu":
+        return {}
+    with _lock:
+        pending = dict(_PENDING)
+        _PENDING.clear()
+    out = {}
+    for label, (sds_args, sds_kwargs, wrapper) in pending.items():
+        # always the exact instance that produced the avals (the weakref
+        # jaxhooks threads through note_trace)
+        fn = wrapper() if wrapper is not None else None
+        if fn is None:
+            continue
+        try:
+            # ShapeDtypeStruct avals strip weak_type, so this lowering
+            # can genuinely retrace (weak-typed scalar args): flag it so
+            # the probe in jaxhooks ignores the synthetic trace
+            _CAPTURING.active = True
+            try:
+                compiled = fn.lower(*sds_args, **sds_kwargs).compile()
+            finally:
+                _CAPTURING.active = False
+            out[label] = record_compiled(label, compiled)
+        except Exception:
+            continue  # a dead/shape-mismatched label is not evidence
+    return out
+
+
+# ------------------------------------------------ managed device trace
+
+@contextlib.contextmanager
+def device_trace(logdir: Optional[str] = None):
+    """Capture an XLA device trace (TensorBoard/Perfetto format) as a
+    *capture artifact*: ``logdir`` defaults to ``<capture dir>/xla_trace``
+    when a telemetry capture is active, the capture is wrapped in a
+    ``device_trace`` span, and on completion the directory is recorded
+    as a ``devprof.device_trace`` event plus the ``device_traces`` list
+    ``finish_capture`` stamps into meta.json — so the per-kernel trace
+    from a tunnel window is referenced from the run's report instead of
+    being an orphan directory. ``utils.profiling.device_trace`` is the
+    compatibility shim over this."""
+    import jax
+
+    if logdir is None:
+        base = TRACER.directory
+        if base is None:
+            raise ValueError(
+                "no telemetry capture is active; pass an explicit logdir "
+                "or call obs.start_capture first"
+            )
+        logdir = os.path.join(base, "xla_trace")
+    with TRACER.span(names.SPAN_DEVICE_TRACE, logdir=logdir):
+        jax.profiler.start_trace(logdir)
+        try:
+            yield logdir
+        finally:
+            jax.profiler.stop_trace()
+            with _lock:
+                _TRACE_DIRS.append(logdir)
+            TRACER.event(names.EVENT_DEVICE_TRACE, logdir=logdir)
+
+
+def trace_dirs(relative_to: Optional[str] = None) -> list:
+    """Logdirs registered by managed captures this run; with
+    ``relative_to``, paths inside that directory are relativized (so a
+    capture directory stays self-describing when moved)."""
+    with _lock:
+        dirs = list(_TRACE_DIRS)
+    if relative_to is None:
+        return dirs
+    out = []
+    for d in dirs:
+        try:
+            rel = os.path.relpath(d, relative_to)
+        except ValueError:  # different drive (windows)
+            rel = d
+        out.append(rel if not rel.startswith("..") else d)
+    return out
+
+
+def reset() -> None:
+    """Forget per-run state (recorded-compilation cache, pending jit
+    avals, registered trace dirs) — called by ``obs.start_capture`` /
+    ``obs.reset_all`` so one capture dir describes one run."""
+    with _lock:
+        _RECORDED.clear()
+        _PENDING.clear()
+        _TRACE_DIRS.clear()

@@ -60,12 +60,14 @@ import traceback
 import warnings
 from typing import Optional
 
-from . import names
+from . import names, occupancy
 from .jaxhooks import device_memory_snapshot
 from .metrics import REGISTRY
 from .trace import TRACER
 
-PROGRESS_SCHEMA_VERSION = 1
+#: v2 adds the "occupancy" block (per-stage duty cycle over the rolling
+#: window + bottleneck verdict) — readers stay tolerant of v1 files
+PROGRESS_SCHEMA_VERSION = 2
 
 #: Required fields (and JSON types) of progress.json — the heartbeat
 #: contract consumed by the ``watch`` subcommand and validated by
@@ -78,6 +80,7 @@ PROGRESS_SCHEMA = {
     "last_span_age_s": float,  # seconds since any span opened/closed
     "open_spans": dict,     # {tid: ["realize", "compute", ...]}
     "sweep": dict,          # chunks_done/chunks_total/inflight/rate/eta_s
+    "occupancy": dict,      # {"stages": {name: duty}, "bottleneck": ...}
     "jax": dict,            # compiles / traces counters
     "stalls": float,        # flightrec.stalls counter
     "finished": bool,       # True only in the final heartbeat
@@ -142,6 +145,10 @@ class FlightRecorder:
             None if stall_timeout_s is None else float(stall_timeout_s)
         )
         self.ring = collections.deque(maxlen=int(ring_size))
+        #: live per-stage duty over a rolling window, fed by the same
+        #: tracer listener as the ring; its snapshot (duty cycles + a
+        #: bottleneck verdict) is the heartbeat's "occupancy" block
+        self.occupancy = occupancy.StageOccupancy()
         self._thread: Optional[threading.Thread] = None
         self._lifecycle_lock = threading.Lock()
         self._stop = threading.Event()
@@ -153,6 +160,14 @@ class FlightRecorder:
         # last sample that saw progress
         self._rate_ewma: Optional[float] = None
         self._last_progress: Optional[tuple] = None
+        # stages whose duty gauge has ever been mirrored: a stage that
+        # leaves the rolling window must be zeroed, not left stale.
+        # Guarded by its own lock: the sampler thread and a postmortem
+        # flush (crashing thread / signal path) can both build a
+        # heartbeat, and an unsynchronized read-modify-write could lose
+        # the zeroing of a stage that just went idle
+        self._mirror_lock = threading.Lock()
+        self._mirrored_stages: set = set()
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "FlightRecorder":
@@ -194,6 +209,7 @@ class FlightRecorder:
     # -- tracer listener ------------------------------------------------
     def _on_record(self, rec: dict) -> None:
         self.ring.append(rec)
+        self.occupancy.observe(rec)
 
     # -- sampler --------------------------------------------------------
     def _run(self) -> None:
@@ -248,6 +264,28 @@ class FlightRecorder:
         # (and instantly trip the watchdog) before its first span
         return max(TRACER.last_activity, self._t_start)
 
+    def _occupancy_block(self) -> dict:
+        occ = self.occupancy.snapshot()
+        # mirror the live duties into gauges so metrics.json / the
+        # report carry the final window's utilization after the run —
+        # including zeroing stages that went idle (dropped out of the
+        # window), or a long-finished stage would keep reporting the
+        # saturated duty of a window minutes in the past
+        stages = occ["stages"]
+        with self._mirror_lock:
+            for stage in self._mirrored_stages - set(stages):
+                REGISTRY.gauge(
+                    names.OCCUPANCY_DUTY_CYCLE, stage=stage
+                ).set(0.0)
+            for stage, duty in stages.items():
+                REGISTRY.gauge(
+                    names.OCCUPANCY_DUTY_CYCLE, stage=stage
+                ).set(duty)
+            # track only the currently-busy stages: an idle stage is
+            # zeroed exactly once, not re-written on every later tick
+            self._mirrored_stages = set(stages)
+        return occ
+
     def _heartbeat(self, finished: bool = False) -> dict:
         hb = {
             "schema": PROGRESS_SCHEMA_VERSION,
@@ -262,6 +300,7 @@ class FlightRecorder:
                 for tid, stack in TRACER.open_spans().items()
             },
             "sweep": self._sweep_block(),
+            "occupancy": self._occupancy_block(),
             "jax": {
                 name.split(".", 1)[1]: val
                 for name in (names.JAX_COMPILES, names.JAX_TRACES)

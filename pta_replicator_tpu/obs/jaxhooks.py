@@ -31,7 +31,7 @@ import threading
 import warnings
 from typing import Dict, Optional
 
-from . import names
+from . import devprof, names
 from .metrics import REGISTRY
 
 _COMPILE_EVENT = "backend_compile_duration"
@@ -47,6 +47,11 @@ class RetraceWarning(UserWarning):
 
 
 def _duration_listener(event: str, duration_secs: float, **_kw) -> None:
+    if devprof.measurement_in_progress():
+        # devprof.capture_pending's synthetic lowering+compile: the
+        # measurement must not inflate the compile/trace accounting it
+        # is reported alongside (same invariant as the retrace probe)
+        return
     if event.endswith(_COMPILE_EVENT):
         REGISTRY.counter(names.JAX_COMPILES).inc()
         REGISTRY.histogram(names.JAX_COMPILE_S).observe(duration_secs)
@@ -120,9 +125,20 @@ def instrumented_jit(
     # not read as one function retracing; only THIS jit cache thrashing
     # is the pathology the warning names
     local_count = [0]
+    # filled after jax.jit below: a weakref to THIS wrapper, passed to
+    # devprof with each trace's avals so co-labeled jit instances (the
+    # lru_cached mesh engines share one label) can't cross-wire their
+    # cost captures
+    self_ref = [None]
 
     @functools.wraps(fun)
     def traced(*args, **kwargs):
+        if devprof.measurement_in_progress():
+            # devprof.capture_pending's synthetic lowering: not a real
+            # (re)trace — counting it would let the measurement trip
+            # the very RetraceWarning it reports on, and re-arm the
+            # pending set it is draining
+            return fun(*args, **kwargs)
         # this body executes exactly once per trace (cache hits bypass
         # Python entirely), so it IS the retrace probe
         # the wrapper body runs only WHILE jax is tracing (never inside
@@ -133,6 +149,14 @@ def instrumented_jit(
             local_count[0] += 1
             n = local_count[0]
         REGISTRY.counter(names.JAX_TRACE_COUNT, fn=label).inc()
+        try:
+            # the trace is also the moment a NEW compilation is being
+            # built: snapshot the call's avals (shape/dtype only) so
+            # devprof.capture_pending can later reproduce the lowering
+            # and record this label's jax.cost.* gauges
+            devprof.note_trace(label, args, kwargs, wrapper=self_ref[0])
+        except Exception:
+            pass  # cost attribution must never break a trace
         if n > retrace_warn:
             warnings.warn(
                 f"jit function {label!r} traced {n} times "
@@ -143,7 +167,13 @@ def instrumented_jit(
             )
         return fun(*args, **kwargs)
 
-    return jax.jit(traced, **jit_kwargs)
+    jitted = jax.jit(traced, **jit_kwargs)
+    import weakref
+
+    # jit never traces at construction, so self_ref is always set
+    # before the probe's first note_trace can fire
+    self_ref[0] = weakref.ref(jitted)
+    return jitted
 
 
 def device_memory_snapshot() -> list:

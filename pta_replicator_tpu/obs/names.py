@@ -68,6 +68,9 @@ SPAN_BENCH_WARMUP = "warmup"
 SPAN_BENCH_MEASURE = "measure"
 SPAN_BENCH_SWEEP_AB = "sweep_ab"
 
+# managed jax.profiler device-trace capture (obs/devprof.py)
+SPAN_DEVICE_TRACE = "device_trace"
+
 SPANS = frozenset({
     SPAN_FREEZE, SPAN_MAKE_IDEAL, SPAN_LOAD_PULSARS, SPAN_ORACLE_FIT,
     SPAN_READ_PAR, SPAN_READ_TIM, SPAN_DESIGN_TENSOR,
@@ -81,12 +84,16 @@ SPANS = frozenset({
     SPAN_COMPUTE, SPAN_WRITE_OUTPUT,
     SPAN_BENCH_INGEST_B1855, SPAN_BENCH_AOT_COMPILE, SPAN_BENCH_WARMUP,
     SPAN_BENCH_MEASURE, SPAN_BENCH_SWEEP_AB,
+    SPAN_DEVICE_TRACE,
 })
 
 # -------------------------------------------------------------- events
 EVENT_FLIGHTREC_STALL = "flightrec.stall"
+#: a managed jax.profiler trace finished and registered its directory
+#: as a capture artifact (obs/devprof.py)
+EVENT_DEVICE_TRACE = "devprof.device_trace"
 
-EVENTS = frozenset({EVENT_FLIGHTREC_STALL})
+EVENTS = frozenset({EVENT_FLIGHTREC_STALL, EVENT_DEVICE_TRACE})
 
 # ------------------------------------------------------------- metrics
 # io / ingest counters
@@ -117,6 +124,12 @@ CW_STREAM_PREFETCH_STALL_S = "cw_stream.prefetch_stall_s"
 # flight recorder
 FLIGHTREC_STALLS = "flightrec.stalls"
 
+# stage occupancy (obs/occupancy.py): live per-stage duty cycle over the
+# flight recorder's rolling window, and the cumulative busy seconds a
+# staged executor's worker spent inside its stage
+OCCUPANCY_DUTY_CYCLE = "occupancy.duty_cycle"
+OCCUPANCY_BUSY_S = "occupancy.busy_s"
+
 # jax accounting (obs/jaxhooks.py)
 JAX_COMPILES = "jax.compiles"
 JAX_COMPILE_S = "jax.compile_s"
@@ -136,16 +149,27 @@ METRICS = frozenset({
     CW_STREAM_TILES_DONE, CW_STREAM_BYTES_STAGED,
     CW_STREAM_PREFETCH_STALL_S,
     FLIGHTREC_STALLS,
+    OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
     JAX_COMPILES, JAX_COMPILE_S, JAX_TRACES, JAX_TRACE_S, JAX_LOWERING_S,
     JAX_TRACE_COUNT,
 })
 
 #: metric families whose full names are built at runtime (device label,
-#: transfer direction) — a literal starting with one of these prefixes
-#: is registered even though the exact name isn't enumerable statically
+#: transfer direction, cost-analysis key) — a literal starting with one
+#: of these prefixes is registered even though the exact name isn't
+#: enumerable statically
 JAX_MEMORY_PREFIX = "jax.memory."
 JAX_TRANSFER_PREFIX = "jax.transfer."
-METRIC_PREFIXES = (JAX_MEMORY_PREFIX, JAX_TRANSFER_PREFIX)
+#: XLA Compiled.cost_analysis()/memory_analysis() gauges, labeled by
+#: jit label (obs/devprof.py) — sub-names come from XLA's own key set
+JAX_COST_PREFIX = "jax.cost."
+#: roofline gauges derived from jax.cost.* + measured elapsed time
+#: (achieved FLOP/s, bytes/s, arithmetic intensity, % of roofline)
+JAX_ROOFLINE_PREFIX = "jax.roofline."
+METRIC_PREFIXES = (
+    JAX_MEMORY_PREFIX, JAX_TRANSFER_PREFIX, JAX_COST_PREFIX,
+    JAX_ROOFLINE_PREFIX,
+)
 
 #: dotted-name groups the report renderer and postmortem filter key on
 JAX_PREFIX = "jax."
@@ -153,6 +177,7 @@ SWEEP_PREFIX = "sweep."
 FLIGHTREC_PREFIX = "flightrec."
 PIPELINE_PREFIX = "pipeline."
 CW_STREAM_PREFIX = "cw_stream."
+OCCUPANCY_PREFIX = "occupancy."
 
 # ----------------------------------------------- instrumented_jit labels
 JIT_REALIZE_ENGINE = "batched.realize_engine"

@@ -1,0 +1,315 @@
+"""Stage-occupancy accounting: duty cycle, overlap efficiency, and a
+bottleneck verdict for the staged executors.
+
+The pipelined sweep (parallel/pipeline.py: dispatcher + reader + writer
+threads) and the CW prefetch stream (parallel/prefetch.py: staging
+worker) already emit a span per stage operation — but reading "is the
+writer the bottleneck?" out of a span tree was a hand-worked recipe
+(the old docs/performance.md overlap-reading section: compare
+``sum(drain) + sum(io_write)`` against the phase wall by eye). This
+module turns that into measured numbers:
+
+* **duty cycle** — fraction of the observation window a stage was busy
+  (union of its span intervals / window). A single-worker stage at
+  ~100% duty is saturated: the pipeline cannot go faster without making
+  that stage faster.
+* **overlap efficiency** — how close the executor got to ideal
+  pipelining: ``(serial - wall) / (serial - longest)`` where ``serial``
+  is the sum of all stage busy times (the synchronous counterfactual)
+  and ``longest`` is the busiest stage (the pipelined ideal, wall ==
+  longest stage). 1.0 = perfect overlap, 0.0 = fully serial.
+* **bottleneck verdict** — a one-line diagnosis naming the saturated
+  stage and the resource it binds on ("io_write 92% busy ->
+  disk-bound"), rendered in the ``obs.report`` utilization section, in
+  the flight recorder's heartbeat (``watch`` prints it live), and
+  computed post-hoc from any captured events.jsonl.
+
+Two consumption modes share the same math:
+
+* :func:`analyze` — post-hoc, over span records from events.jsonl or
+  ``TRACER.events()`` (the report path; jax-free).
+* :class:`StageOccupancy` — live, as a tracer listener feeding the
+  flight recorder's heartbeat over a rolling window.
+
+:func:`overlap_stats` is the shared kernel (also used directly by
+``run_pipelined``, which accounts its own per-stage busy seconds and
+stamps the result into the ``sweep_pipeline`` span attrs).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import names
+
+#: stage span name -> the resource that stage binds on when saturated.
+#: The verdict string is "<stage> NN% busy -> <resource>-bound".
+STAGES: Dict[str, str] = {
+    names.SPAN_DISPATCH: "host-dispatch",
+    names.SPAN_DRAIN: "readback",
+    names.SPAN_IO_WRITE: "disk",
+    names.SPAN_SWEEP_CHUNK: "compute",
+    names.SPAN_READBACK_FENCE: "readback",
+    names.SPAN_CW_STREAM_STAGE: "host-precompute",
+}
+
+#: nested stage -> the enclosing stage whose span contains it. A nested
+#: stage's busy time is already inside its parent's, so it must not be
+#: double-counted into the serial counterfactual or win the bottleneck
+#: verdict over the parent — it stays in the per-stage duty table as
+#: the parent's breakdown (the synchronous loop's readback share).
+NESTED_STAGES: Dict[str, str] = {
+    names.SPAN_READBACK_FENCE: names.SPAN_SWEEP_CHUNK,
+}
+
+#: span names that bound a whole pipelined phase — when present, the
+#: longest one defines the observation window for :func:`analyze`
+PHASE_SPANS = (names.SPAN_SWEEP_PIPELINE, names.SPAN_CW_STREAM_RESPONSE)
+
+#: duty above which a stage is called THE bottleneck, and below which
+#: (for every stage) the executor is called idle
+BUSY_VERDICT = 0.75
+IDLE_VERDICT = 0.20
+
+
+def merge_intervals(
+    intervals: Iterable[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    """Union of (t0, t1) intervals as a sorted, disjoint list."""
+    merged: List[Tuple[float, float]] = []
+    for t0, t1 in sorted(intervals):
+        if merged and t0 <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], t1))
+        else:
+            merged.append((t0, t1))
+    return merged
+
+
+def busy_seconds(intervals: Iterable[Tuple[float, float]]) -> float:
+    """Total covered seconds of the union of ``intervals`` (overlapping
+    calls of the same stage are not double-counted)."""
+    return sum(t1 - t0 for t0, t1 in merge_intervals(intervals))
+
+
+def stage_intervals(
+    events: Iterable[dict], stages: Optional[Sequence[str]] = None
+) -> Dict[str, List[Tuple[float, float]]]:
+    """Per-stage (t0, t1) busy intervals from span records (events.jsonl
+    shape). ``stages`` defaults to the :data:`STAGES` table; unknown
+    span names are ignored."""
+    wanted = set(stages if stages is not None else STAGES)
+    out: Dict[str, List[Tuple[float, float]]] = {}
+    for rec in events:
+        if rec.get("type") != "span":
+            continue
+        name = rec.get("name")
+        if name not in wanted:
+            continue
+        t0 = float(rec.get("t0", 0.0))
+        out.setdefault(name, []).append((t0, t0 + float(rec.get("wall_s", 0.0))))
+    return out
+
+
+def _drop_nested(values: Dict[str, float]) -> Dict[str, float]:
+    """Drop stages whose enclosing parent stage is also present — their
+    time is contained in the parent's and must not be counted twice."""
+    return {
+        k: v for k, v in values.items()
+        if NESTED_STAGES.get(k) not in values
+    }
+
+
+def verdict(duties: Dict[str, float]) -> Optional[str]:
+    """One-line bottleneck diagnosis from per-stage duty cycles, or None
+    when there is nothing to diagnose. A nested stage never outranks
+    the parent that contains it."""
+    duties = _drop_nested(duties)
+    if not duties:
+        return None
+    stage = max(duties, key=lambda s: duties[s])
+    duty = duties[stage]
+    resource = STAGES.get(stage, stage)
+    if duty >= BUSY_VERDICT:
+        return f"{stage} {duty:.0%} busy -> {resource}-bound"
+    if max(duties.values()) < IDLE_VERDICT:
+        return "all stages mostly idle"
+    return f"no single bottleneck (busiest: {stage} {duty:.0%})"
+
+
+def overlap_stats(busy_s: Dict[str, float], wall_s: float) -> dict:
+    """Overlap metrics from per-stage busy seconds over a ``wall_s``
+    window — the shared kernel behind :func:`analyze`, the pipelined
+    executor's stats block, and the tests' hand-computed fixtures.
+
+    ``serial_s`` is the synchronous counterfactual (stages run one after
+    the other); ``overlap_efficiency`` is where the measured wall sits
+    between fully serial (0.0) and ideal pipelining, wall == longest
+    stage (1.0); ``wall_reduction_vs_serial_pct`` is the wall time the
+    overlap actually saved relative to that serial counterfactual.
+    Stages nested inside another present stage (:data:`NESTED_STAGES`)
+    are excluded — their time is already inside the parent's, and
+    counting it twice would fabricate overlap for a fully serial run.
+    """
+    active = _drop_nested({k: v for k, v in busy_s.items() if v > 0.0})
+    if not active or wall_s <= 0.0:
+        return {}
+    serial = sum(active.values())
+    longest = max(active.values())
+    duties = {k: min(1.0, v / wall_s) for k, v in active.items()}
+    out = {
+        "wall_s": round(wall_s, 6),
+        "serial_s": round(serial, 6),
+        "longest_stage_s": round(longest, 6),
+        "wall_reduction_vs_serial_pct": round(
+            100.0 * (1.0 - wall_s / serial), 1
+        ),
+        "duty": {k: round(v, 3) for k, v in duties.items()},
+        "bottleneck": verdict(duties),
+    }
+    if serial > longest:
+        eff = (serial - wall_s) / (serial - longest)
+        out["overlap_efficiency"] = round(min(1.0, max(0.0, eff)), 3)
+    return out
+
+
+def analyze(
+    events: Iterable[dict],
+    stages: Optional[Sequence[str]] = None,
+    window: Optional[Tuple[float, float]] = None,
+) -> Optional[dict]:
+    """Post-hoc occupancy report over span records.
+
+    Returns None when no stage spans are present (a capture from before
+    this module, or a run that never touched a staged executor) — the
+    report renderer degrades by omitting its utilization section.
+
+    ``window`` defaults to the longest :data:`PHASE_SPANS` span when one
+    was recorded (the pipelined phase itself), else to the extent of the
+    stage intervals.
+    """
+    events = list(events)
+    per_stage = stage_intervals(events, stages)
+    if not per_stage:
+        return None
+    if window is None:
+        window = _phase_window(events)
+    if window is None:
+        lo = min(t0 for iv in per_stage.values() for t0, _ in iv)
+        hi = max(t1 for iv in per_stage.values() for _, t1 in iv)
+        window = (lo, hi)
+    wall = max(1e-9, window[1] - window[0])
+
+    # clip every interval to the window and drop stages that never ran
+    # inside it: one capture can hold several phases (bench.py's sweep
+    # A/B runs the pipelined arm AND the synchronous arm), and a stage
+    # busy outside the analyzed phase must not read as busy within it
+    per_stage = {
+        name: clipped
+        for name, iv in per_stage.items()
+        if (clipped := _clip(iv, window[0], window[1]))
+    }
+    if not per_stage:
+        return None
+    busy = {name: busy_seconds(iv) for name, iv in per_stage.items()}
+    out = overlap_stats(busy, wall)
+    # the stages table below carries per-stage duty; overlap_stats' flat
+    # duty dict would be the same numbers twice in every embedded
+    # artifact (and could silently desynchronize from the table)
+    out.pop("duty", None)
+    out["stages"] = {
+        name: {
+            "calls": len(iv),
+            "busy_s": round(busy[name], 6),
+            "duty": round(min(1.0, busy[name] / wall), 3),
+        }
+        for name, iv in sorted(per_stage.items())
+    }
+    return out
+
+
+def _clip(
+    intervals: Iterable[Tuple[float, float]], lo: float, hi: float
+) -> List[Tuple[float, float]]:
+    """Intervals intersected with [lo, hi]; empty intersections drop."""
+    out = []
+    for t0, t1 in intervals:
+        t0c, t1c = max(t0, lo), min(t1, hi)
+        if t1c > t0c:
+            out.append((t0c, t1c))
+    return out
+
+
+def _phase_window(events: Iterable[dict]) -> Optional[Tuple[float, float]]:
+    best = None
+    for rec in events:
+        if rec.get("type") != "span" or rec.get("name") not in PHASE_SPANS:
+            continue
+        t0 = float(rec.get("t0", 0.0))
+        t1 = t0 + float(rec.get("wall_s", 0.0))
+        if best is None or t1 - t0 > best[1] - best[0]:
+            best = (t0, t1)
+    return best
+
+
+class StageOccupancy:
+    """Live per-stage duty over a rolling window, fed from completed
+    span records (a tracer-listener shape: the flight recorder calls
+    :meth:`observe` from its existing listener and :meth:`snapshot`
+    from the heartbeat sampler).
+
+    Only completed spans count — a drain wedged for minutes shows up as
+    *low* duty here but as an open span (and eventually a stall warning)
+    in the same heartbeat, which together read correctly as "wedged",
+    not "idle". Timing uses the monotonic clock of ``observe`` arrival,
+    so a wall-clock step cannot tear the window.
+    """
+
+    def __init__(
+        self,
+        stages: Optional[Dict[str, str]] = None,
+        window_s: float = 120.0,
+    ):
+        self.stages = dict(stages if stages is not None else STAGES)
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self._done: Dict[str, collections.deque] = {
+            name: collections.deque() for name in self.stages
+        }
+
+    def observe(self, rec: dict) -> None:
+        if rec.get("type") != "span":
+            return
+        dq = self._done.get(rec.get("name"))
+        if dq is None:
+            return
+        now = time.monotonic()
+        cutoff = now - self.window_s
+        with self._lock:
+            dq.append((now, float(rec.get("wall_s", 0.0))))
+            while dq and dq[0][0] < cutoff:
+                dq.popleft()
+
+    def snapshot(self) -> dict:
+        """``{"stages": {name: duty}, "bottleneck": str|None}`` over the
+        trailing window (clamped to the recorder's own lifetime, so the
+        first seconds of a run don't read as near-zero duty)."""
+        now = time.monotonic()
+        horizon = max(1e-9, min(self.window_s, now - self._t0))
+        cutoff = now - horizon
+        duties: Dict[str, float] = {}
+        with self._lock:
+            for name, dq in self._done.items():
+                busy = 0.0
+                for end, dur in dq:
+                    if end < cutoff:
+                        continue
+                    busy += min(dur, end - cutoff)
+                if busy > 0.0:
+                    duties[name] = min(1.0, busy / horizon)
+        return {
+            "stages": {k: round(v, 3) for k, v in sorted(duties.items())},
+            "bottleneck": verdict(duties),
+        }

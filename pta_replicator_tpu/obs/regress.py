@@ -59,10 +59,24 @@ _SKIP_PREFIXES = ("backup_", "platform.")
 
 _HIGHER_BETTER_TOKENS = (
     "value", "rate", "per_s", "speedup", "vs_baseline", "mfu",
-    "tflops", "flops", "realizations",
+    "tflops", "flops", "realizations", "efficiency", "reduction",
+    "pct_of_roofline", "pct_of_peak",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts")
+#: name fragments with NO better direction: jax.cost.* gauges are
+#: properties of the compiled program (flops per chunk changing is a
+#: workload change, not a perf verdict — even though "flops" is a
+#: higher-better token in rate names), and duty/intensity/ridge are
+#: positions, not scores
+#: wall_reduction_vs_serial is info, not higher-better: the depth-1
+#: null-control arm records it hovering at ~0 (SWEEP_OVERLAP_r07), where
+#: a relative-delta verdict amplifies pure noise into "regressed"; the
+#: directional score for the same property is overlap_efficiency
+_NO_DIRECTION_FRAGMENTS = (
+    "jax.cost.", "flops_per_chunk", "duty", "intensity", "ridge",
+    "wall_reduction_vs_serial",
+)
 
 
 class SchemaMismatch(RuntimeError):
@@ -117,8 +131,15 @@ def metric_direction(name: str) -> Optional[bool]:
     Rate tokens are checked BEFORE the duration suffixes: a throughput
     name like ``cpu_oracle_real_per_s`` ends in ``_s`` too, and reading
     it as a duration would invert the gate's verdict for every
-    realizations/s metric."""
-    leaf = name.rsplit(".", 1)[-1].lower()
+    realizations/s metric. Directionless families
+    (:data:`_NO_DIRECTION_FRAGMENTS`) are checked against the FULL
+    dotted name first — ``jax.cost.flops`` must stay ``info`` even
+    though its leaf carries a rate token."""
+    if any(frag in name.lower() for frag in _NO_DIRECTION_FRAGMENTS):
+        return None
+    # metric instances may carry a {label=...} suffix (telemetry_summary
+    # keys); the label text must not leak into leaf-token matching
+    leaf = name.split("{", 1)[0].rsplit(".", 1)[-1].lower()
     if any(t in leaf for t in _HIGHER_BETTER_TOKENS):
         return True
     if leaf.endswith(_LOWER_BETTER_SUFFIXES) or any(

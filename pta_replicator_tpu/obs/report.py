@@ -25,7 +25,7 @@ import json
 import os
 from typing import Dict, List, Optional, TextIO
 
-from . import names
+from . import names, occupancy
 
 
 def load_events(path: str) -> List[dict]:
@@ -194,6 +194,7 @@ def render_report(
             {"spans": agg, "metrics": metrics, "meta": data["meta"],
              "progress": data["progress"],
              "postmortem": data["postmortem"],
+             "utilization": occupancy.analyze(data["events"]),
              "problems": data["problems"]},
             indent=1, sort_keys=True,
         )
@@ -222,14 +223,39 @@ def render_report(
     parts.append("")
     parts.append(render_span_tree(agg, min_ms=min_ms))
 
+    util = occupancy.analyze(data["events"])
+    if util:
+        parts.append("")
+        parts.append(render_utilization(util))
+
+    # jax.roofline.* is excluded here: those gauges render once, in the
+    # dedicated roofline section below (jax.cost.* stays — these raw
+    # rows are its only rendering)
     jax_rows = _metric_rows(
         {k: v for k, v in metrics.items()
-         if k.startswith(names.JAX_PREFIX)}
+         if k.startswith(names.JAX_PREFIX)
+         and not k.startswith(names.JAX_ROOFLINE_PREFIX)}
     )
     if jax_rows:
         parts.append("")
         parts.append("jax accounting:")
         parts.extend(jax_rows)
+    roof_rows = _roofline_rows(metrics)
+    if roof_rows:
+        parts.append("")
+        parts.append("roofline (per jit label):")
+        parts.extend(roof_rows)
+    traces = meta.get("device_traces") or []
+    if traces:
+        # own block: a tunnel-window capture typically has the trace
+        # but no roofline gauges, and these lines must not read as
+        # stray rows of whatever section happened to precede them
+        parts.append("")
+        for trace_dir in traces:
+            parts.append(
+                f"device trace: {trace_dir} (jax.profiler capture — "
+                "open in TensorBoard's profile plugin or Perfetto)"
+            )
     mem = meta.get("device_memory") or []
     for snap in mem:
         if "bytes_in_use" in snap:
@@ -281,6 +307,70 @@ def render_report(
     return "\n".join(parts)
 
 
+def render_utilization(util: dict) -> str:
+    """The report's utilization section from an :func:`occupancy.analyze`
+    result: per-stage duty table, overlap efficiency, bottleneck
+    verdict — the measured successor of the old hand-worked
+    "sum(drain)+sum(io_write) vs wall" reading."""
+    lines = ["utilization (stage occupancy):"]
+    for stage, s in (util.get("stages") or {}).items():
+        lines.append(
+            f"  {stage:<18} duty {100 * s['duty']:5.1f}%  "
+            f"busy {_fmt_s(s['busy_s']):>10}  {s['calls']:>5} calls"
+        )
+    if "overlap_efficiency" in util:
+        lines.append(
+            f"  overlap efficiency {100 * util['overlap_efficiency']:.0f}% "
+            f"(wall {_fmt_s(util['wall_s'])} vs serial "
+            f"{_fmt_s(util['serial_s'])}: "
+            f"{util['wall_reduction_vs_serial_pct']:.0f}% of the serial "
+            "wall overlapped away)"
+        )
+    if util.get("bottleneck"):
+        lines.append(f"  bottleneck: {util['bottleneck']}")
+    return "\n".join(lines)
+
+
+def _roofline_rows(metrics: dict) -> List[str]:
+    """Per-jit-label roofline lines from the jax.roofline.* gauges:
+    achieved rate, intensity, and the compute/memory-bound verdict
+    (derived here from intensity vs the recorded ridge, so the verdict
+    works from metrics.json alone)."""
+    per_label: Dict[str, dict] = {}
+    for name, insts in metrics.items():
+        if not name.startswith(names.JAX_ROOFLINE_PREFIX):
+            continue
+        key = name[len(names.JAX_ROOFLINE_PREFIX):]
+        for inst in insts:
+            label = (inst.get("labels") or {}).get("label", "?")
+            per_label.setdefault(label, {})[key] = inst.get("value")
+    rows = []
+    for label in sorted(per_label):
+        vals = per_label[label]
+        flops = vals.get("flops_per_s")
+        if not flops:
+            continue
+        row = f"  {label}: {flops / 1e12:.3f} TFLOP/s"
+        if vals.get("bytes_per_s"):
+            row += f", {vals['bytes_per_s'] / 1e9:.2f} GB/s"
+        if vals.get("intensity_flop_per_byte"):
+            row += f", {vals['intensity_flop_per_byte']:.1f} flop/B"
+        ridge = vals.get("ridge_intensity")
+        if ridge and vals.get("intensity_flop_per_byte"):
+            from . import devprof
+
+            row += (
+                " -> "
+                + devprof.classify(vals["intensity_flop_per_byte"], ridge)
+            )
+            if vals.get("pct_of_roofline") is not None:
+                row += f" ({vals['pct_of_roofline']:.1f}% of roofline)"
+        elif vals.get("pct_of_peak_flops") is not None:
+            row += f" ({vals['pct_of_peak_flops']:.1f}% of peak)"
+        rows.append(row)
+    return rows
+
+
 def _stall_count(metrics: dict, progress: Optional[dict]) -> int:
     insts = (metrics or {}).get(names.FLIGHTREC_STALLS) or []
     for inst in insts:
@@ -309,6 +399,9 @@ def render_heartbeat(hb: dict) -> str:
             parts.append(f"{rate:.3g} chunk/s")
     if sweep.get("inflight"):
         parts.append(f"inflight {int(sweep['inflight'])}")
+    occ = hb.get("occupancy") or {}
+    if occ.get("bottleneck"):
+        parts.append(occ["bottleneck"])
     open_spans = hb.get("open_spans") or {}
     if open_spans:
         deepest = max(open_spans.values(), key=len)
@@ -400,7 +493,7 @@ def render_postmortem(directory: str, last: int = 25) -> str:
     interesting = {
         k: v for k, v in metrics.items()
         if k.startswith((names.SWEEP_PREFIX, names.FLIGHTREC_PREFIX,
-                         names.PIPELINE_PREFIX))
+                         names.PIPELINE_PREFIX, names.OCCUPANCY_PREFIX))
     }
     rows = _metric_rows(interesting)
     if rows:

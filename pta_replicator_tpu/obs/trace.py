@@ -307,14 +307,35 @@ class Tracer:
     def dropped(self) -> int:
         return self._dropped
 
+    #: synthetic tid base for the per-stage occupancy tracks (far above
+    #: any real thread id modulo — see chrome_trace)
+    _STAGE_TID_BASE = 1 << 22
+
     def chrome_trace(self) -> dict:
         """The buffered spans as a ``chrome://tracing`` / Perfetto JSON
-        object (phase-"X" complete events, microsecond timestamps)."""
+        object (phase-"X" complete events, microsecond timestamps).
+
+        Stage spans of the staged executors (the ``obs.occupancy``
+        stage table: dispatch/drain/io_write/cw_stream_stage/...) are
+        lifted onto one synthetic, named track per stage — so the
+        pipeline's utilization reads as contiguous per-stage lanes
+        (gaps = idle) instead of being scattered across whatever worker
+        thread ids the executor happened to spawn."""
+        from . import occupancy
+
         pid = os.getpid()
+        stage_tid = {
+            name: self._STAGE_TID_BASE + i
+            for i, name in enumerate(sorted(occupancy.STAGES))
+        }
+        used_stages = set()
         trace_events = []
         for rec in self.events():
             if rec["type"] != "span":
                 continue
+            tid = stage_tid.get(rec["name"], rec["tid"])
+            if rec["name"] in stage_tid:
+                used_stages.add(rec["name"])
             trace_events.append({
                 "name": rec["name"],
                 "cat": "host",
@@ -322,10 +343,20 @@ class Tracer:
                 "ts": rec["t0"] * 1e6,
                 "dur": rec["wall_s"] * 1e6,
                 "pid": pid,
-                "tid": rec["tid"],
+                "tid": tid,
                 "args": {**rec["attrs"], "path": rec["path"]},
             })
-        return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+        meta_events = [
+            {
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": stage_tid[name], "args": {"name": f"stage:{name}"},
+            }
+            for name in sorted(used_stages)
+        ]
+        return {
+            "traceEvents": meta_events + trace_events,
+            "displayTimeUnit": "ms",
+        }
 
     def flush(self) -> None:
         with self._lock:

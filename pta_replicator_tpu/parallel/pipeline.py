@@ -39,9 +39,12 @@ files themselves).
 Telemetry: ``dispatch`` / ``drain`` / ``io_write`` spans per chunk (the
 reader and writer adopt the caller's span ancestry, so they nest under
 the sweep span in the report tree) and the ``sweep.inflight_chunks``
-gauge. Overlap shows up in a captured report as
-``sum(drain) + sum(io_write)`` approaching ``sum(dispatch..wall)``
-instead of adding to it — docs/performance.md shows a worked reading.
+gauge. The executor also accounts each stage's busy seconds itself and
+returns them — with duty cycles, overlap efficiency, and a bottleneck
+verdict (``obs.occupancy.overlap_stats``) — in its stats dict, which
+``utils.sweep`` stamps into the ``sweep_pipeline`` span attrs; the
+``obs.report`` utilization section renders the same numbers for any
+captured run (docs/performance.md).
 """
 from __future__ import annotations
 
@@ -52,7 +55,7 @@ from typing import Callable, Iterable, Optional
 
 import numpy as np
 
-from ..obs import counter, gauge, names, span
+from ..obs import counter, gauge, names, occupancy, span
 from ..obs.trace import TRACER
 
 
@@ -148,6 +151,16 @@ def run_pipelined(
     inflight = [0]  # dispatched - drained, under lock
     lock = threading.Lock()
     stats = {"chunks": 0, "max_inflight": 0, "drain_wait_s": 0.0}
+    # per-stage busy seconds (each stage is a single actor, so its busy
+    # time is just the sum of its operation durations) — folded into
+    # occupancy.overlap_stats at the end so every pipelined run reports
+    # its own duty cycles, overlap efficiency, and bottleneck verdict
+    busy = {names.SPAN_DISPATCH: 0.0, names.SPAN_DRAIN: 0.0,
+            names.SPAN_IO_WRITE: 0.0}
+
+    def _busy(stage: str, seconds: float) -> None:
+        with lock:
+            busy[stage] += seconds
 
     def _fail(stage: str, exc: BaseException) -> None:
         with lock:
@@ -193,6 +206,8 @@ def run_pipelined(
                     fetch_started[0] = time.monotonic()
                     with span(names.SPAN_DRAIN, chunk=i):
                         block = fetch(dev)
+                    _busy(names.SPAN_DRAIN,
+                          time.monotonic() - fetch_started[0])
                     fetch_started[0] = None
                     if stop.is_set():
                         # abandoned run: a DrainTimeout already raised on
@@ -229,6 +244,8 @@ def run_pipelined(
                     with span(names.SPAN_IO_WRITE, chunk=i,
                               nbytes=int(block.nbytes)):
                         write(i, block)
+                    _busy(names.SPAN_IO_WRITE,
+                          time.monotonic() - write_started[0])
                     write_started[0] = None
                     with lock:
                         stats["chunks"] += 1
@@ -257,8 +274,10 @@ def run_pipelined(
             if stop.is_set():
                 break
             try:
+                t_disp = time.monotonic()
                 with span(names.SPAN_DISPATCH, chunk=i):
                     dev = dispatch(i)
+                _busy(names.SPAN_DISPATCH, time.monotonic() - t_disp)
             except BaseException as exc:  # noqa: BLE001
                 _fail("dispatch", exc)
                 break
@@ -323,4 +342,10 @@ def run_pipelined(
         raise exc
     stats["wall_s"] = time.monotonic() - t_start
     stats["drain_wait_s"] = round(stats["drain_wait_s"], 6)
+    stats["stage_busy_s"] = {k: round(v, 6) for k, v in busy.items()}
+    # measured occupancy of THIS run: duty cycles, overlap efficiency
+    # (how close wall came to the longest single stage), and the
+    # bottleneck verdict — lands in the sweep_pipeline span attrs via
+    # utils.sweep, and in the obs.report utilization section
+    stats["occupancy"] = occupancy.overlap_stats(busy, stats["wall_s"])
     return stats

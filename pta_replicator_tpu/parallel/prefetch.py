@@ -94,6 +94,12 @@ def prefetch_to_device(
     errors: list = []  # [exc] — first entry wins
     stage_started = [None]  # single-writer heartbeat (worker writes)
     stall_s = [0.0]
+    # cumulative staging busy seconds (single-writer: the worker), fed
+    # to the occupancy.busy_s gauge so a capture records how much of
+    # the stream's wall the host-precompute+H2D stage was actually
+    # working — the post-hoc duty/bottleneck math runs on the
+    # cw_stream_stage spans (obs.occupancy)
+    busy_s = [0.0]
     stack = TRACER.current_stack()  # nest worker spans under the caller's
 
     def _worker() -> None:
@@ -118,8 +124,12 @@ def prefetch_to_device(
                         nbytes = tree_nbytes(tile)
                         staged = place(tile)
                         sp["nbytes"] = nbytes
+                    busy_s[0] += time.monotonic() - stage_started[0]
                     stage_started[0] = None
                     counter(names.CW_STREAM_BYTES_STAGED).inc(nbytes)
+                    gauge(names.OCCUPANCY_BUSY_S,
+                          stage=names.SPAN_CW_STREAM_STAGE).set(
+                        round(busy_s[0], 6))
                 except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
                     stage_started[0] = None
                     errors.append(exc)

@@ -71,14 +71,19 @@ def reset() -> None:
 
 @contextlib.contextmanager
 def device_trace(logdir: str):
-    """Capture an XLA device trace (TensorBoard/Perfetto format)."""
-    import jax
+    """Capture an XLA device trace (TensorBoard/Perfetto format).
 
-    jax.profiler.start_trace(logdir)
-    try:
+    Compatibility shim over :func:`pta_replicator_tpu.obs.devprof.
+    device_trace`, which manages the capture: wraps it in a
+    ``device_trace`` span and registers ``logdir`` as a capture
+    artifact (``device_traces`` in meta.json), so the trace is
+    referenced from the run's report instead of being an orphan
+    directory. New code should call the obs API directly — it can also
+    default ``logdir`` into the active capture directory."""
+    from ..obs import devprof
+
+    with devprof.device_trace(logdir):
         yield
-    finally:
-        jax.profiler.stop_trace()
 
 
 def injection_stage_fns(batch, recipe) -> dict:

@@ -27,7 +27,12 @@ thread and earlier chunks' files are written by a single writer thread
 readback + disk latency. The pipeline changes scheduling only — keys,
 reductions, file contents, and the write ordering (chunk file before
 sidecar, in chunk order) are identical to the synchronous loop, which
-``pipeline_depth=1`` still runs verbatim for debugging.
+``pipeline_depth=1`` still runs verbatim for debugging. The executor's
+stats — per-stage busy seconds, duty cycles, overlap efficiency, and a
+bottleneck verdict (obs.occupancy) — land in the ``sweep_pipeline``
+span attrs, so every captured sweep carries its own utilization
+evidence (rendered by ``obs.report``; live verdict in the flight
+recorder's heartbeat).
 """
 from __future__ import annotations
 
@@ -432,7 +437,13 @@ def sweep(
                 # is where queued device work (incl. collectives) drains
                 with span(names.SPAN_READBACK_FENCE):
                     block = np.asarray(out)
-            write_chunk(i, block)
+            # same stage span the pipelined writer thread emits, so the
+            # occupancy report attributes the synchronous loop's disk
+            # time too (without it an fsync-bound depth-1 run reads as
+            # compute-bound)
+            with span(names.SPAN_IO_WRITE, chunk=i,
+                      nbytes=int(block.nbytes)):
+                write_chunk(i, block)
             blocks.append(block)
     elif done < nchunks:
         from ..parallel.pipeline import run_pipelined

@@ -127,11 +127,24 @@ def generate_sample(directory: str) -> str:
     return os.path.join(directory, "events.jsonl")
 
 
+#: heartbeat fields only required from the given PROGRESS_SCHEMA
+#: version on — a v1 capture (pre-occupancy) must keep validating
+#: ("readers stay tolerant of v1 files", obs/flightrec.py)
+_FIELD_SINCE_VERSION = {"occupancy": 2}
+
+
 def _validate_shape(path: str, doc, schema: dict, kind: str) -> list:
-    """Field/type validation of one flight-recorder JSON document."""
+    """Field/type validation of one flight-recorder JSON document.
+    Fields newer than the document's own ``schema`` stamp are skipped."""
     problems = []
     if not isinstance(doc, dict):
         return [f"{path}: {kind} is not a JSON object"]
+    version = doc.get("schema")
+    if isinstance(version, int):
+        schema = {
+            k: v for k, v in schema.items()
+            if _FIELD_SINCE_VERSION.get(k, 0) <= version
+        }
     for field, ftype in schema.items():
         if field not in doc:
             problems.append(f"{path}: {kind} missing {field!r}")
@@ -190,6 +203,38 @@ def validate_flightrec_file(path: str, kind: str) -> list:
     return problems
 
 
+def validate_device_traces(directory: str) -> list:
+    """A capture's meta.json may register managed jax.profiler trace
+    dirs (obs.devprof.device_trace). Each registered path — relative
+    paths resolve against the capture dir — must exist, or the
+    capture's report would point at an artifact that was never written
+    (or was moved without its capture)."""
+    meta_path = os.path.join(directory, "meta.json")
+    if not os.path.exists(meta_path):
+        return []
+    try:
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+    except json.JSONDecodeError as exc:
+        return [f"{meta_path}: unparseable JSON ({exc})"]
+    problems = []
+    traces = meta.get("device_traces")
+    if traces is None:
+        return []
+    if not isinstance(traces, list):
+        return [f"{meta_path}: device_traces is not a list"]
+    for entry in traces:
+        path = entry if os.path.isabs(str(entry)) else os.path.join(
+            directory, str(entry)
+        )
+        if not os.path.isdir(path):
+            problems.append(
+                f"{meta_path}: registered device trace {entry!r} does "
+                "not exist (trace dir moved or never written)"
+            )
+    return problems
+
+
 def generate_flightrec_sample(directory: str) -> list:
     """Exercise the flight recorder in-process (no sampler thread, no
     jax): one heartbeat + one postmortem, returned as paths to check."""
@@ -220,6 +265,7 @@ def main(argv=None) -> int:
                 p = os.path.join(target, fname)
                 if os.path.exists(p):
                     problems += validate_flightrec_file(p, kind)
+            problems += validate_device_traces(target)
             target = os.path.join(target, "events.jsonl")
         problems += validate_events(target)
     else:

@@ -1,0 +1,733 @@
+"""Device-time attribution layer: occupancy math against hand-computed
+interval fixtures, the live heartbeat bottleneck path (incl. the
+torn-read hammer), devprof cost/roofline extraction, the managed
+device-trace capture, report rendering + degradation, and the
+bench-diff direction classes for the new names.
+
+CPU-only and fixture-free; the devprof capture tests use real jax on
+the CPU backend.
+"""
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pta_replicator_tpu import obs
+from pta_replicator_tpu.obs import devprof, names, occupancy
+from pta_replicator_tpu.obs.regress import metric_direction
+
+
+@pytest.fixture(autouse=True)
+def _fresh_globals():
+    obs.reset_all()
+    yield
+    obs.configure(None)
+    obs.reset_all()
+
+
+def _span(name, t0, wall, tid=1):
+    return {"type": "span", "name": name, "path": name, "t0": float(t0),
+            "wall_s": float(wall), "cpu_s": 0.0, "tid": tid, "seq": 0,
+            "attrs": {}}
+
+
+# ------------------------------------------------------ interval math
+def test_merge_and_busy_seconds_hand_computed():
+    assert occupancy.merge_intervals([]) == []
+    # overlapping + disjoint: union is [0,3] + [5,6] = 4 s
+    assert occupancy.busy_seconds([(0, 2), (1, 3), (5, 6)]) == 4.0
+    # nested and touching intervals
+    assert occupancy.merge_intervals([(0, 10), (2, 3), (10, 12)]) == \
+        [(0, 12)]
+
+
+def test_analyze_hand_computed_duty_overlap_and_bottleneck():
+    # drain busy [0,4]+[5,9] = 8 s; io_write busy [2,6]+[7,9] = 6 s;
+    # window [0,9] = 9 s; serial = 14 s; longest = 8 s
+    events = [_span("drain", 0, 4), _span("drain", 5, 4),
+              _span("io_write", 2, 4), _span("io_write", 7, 2)]
+    util = occupancy.analyze(events)
+    assert util["wall_s"] == 9.0
+    assert util["serial_s"] == 14.0
+    assert util["stages"]["drain"] == {
+        "calls": 2, "busy_s": 8.0, "duty": round(8 / 9, 3)}
+    assert util["stages"]["io_write"]["duty"] == round(6 / 9, 3)
+    # efficiency = (14 - 9) / (14 - 8)
+    assert util["overlap_efficiency"] == round(5 / 6, 3)
+    assert util["wall_reduction_vs_serial_pct"] == round(
+        100 * (1 - 9 / 14), 1)
+    assert util["bottleneck"] == "drain 89% busy -> readback-bound"
+
+
+def test_analyze_window_prefers_phase_span():
+    # stage spans cover [0,2] but the sweep_pipeline phase ran [0,10]:
+    # duty must be computed over the PHASE wall, not the busy extent
+    events = [_span("sweep_pipeline", 0, 10), _span("io_write", 0, 2)]
+    util = occupancy.analyze(events)
+    assert util["wall_s"] == 10.0
+    assert util["stages"]["io_write"]["duty"] == 0.2
+
+
+def test_analyze_clips_stages_to_the_phase_window():
+    """One capture can hold several phases (bench.py's sweep A/B runs
+    the pipelined arm AND the synchronous arm): stage spans outside the
+    analyzed phase must not count as busy inside it."""
+    events = [_span("sweep_pipeline", 0, 10), _span("drain", 1, 4),
+              # the synchronous arm, entirely after the pipelined phase
+              _span("sweep_chunk", 12, 15), _span("readback_fence", 27, 3)]
+    util = occupancy.analyze(events)
+    assert set(util["stages"]) == {"drain"}
+    assert util["wall_s"] == 10.0
+    assert util["stages"]["drain"]["duty"] == 0.4
+    # an interval straddling the window edge is clipped, not dropped
+    events.append(_span("io_write", 8, 5))  # [8, 13] -> [8, 10]
+    util = occupancy.analyze(events)
+    assert util["stages"]["io_write"]["busy_s"] == 2.0
+    # every stage outside the window: no utilization at all
+    assert occupancy.analyze(
+        [_span("sweep_pipeline", 0, 10), _span("sweep_chunk", 12, 3)]
+    ) is None
+
+
+def test_analyze_never_fabricates_overlap_from_nested_fence():
+    """The synchronous loop nests readback_fence INSIDE sweep_chunk:
+    counting both into the serial counterfactual would report overlap
+    for a loop that has none by construction."""
+    events = [_span("sweep_chunk", 0, 10), _span("readback_fence", 6, 3)]
+    util = occupancy.analyze(events)
+    # serial counterfactual counts the fence once (inside its parent)
+    assert util["serial_s"] == 10.0
+    assert util["wall_reduction_vs_serial_pct"] == 0.0
+    assert "overlap_efficiency" not in util  # single top-level stage
+    # ...but the per-stage table still shows the fence share
+    assert util["stages"]["readback_fence"]["duty"] == 0.3
+    # and the verdict names the parent, never the nested sub-stage
+    assert util["bottleneck"].startswith("sweep_chunk")
+    assert occupancy.verdict(
+        {"sweep_chunk": 0.9, "readback_fence": 0.95}
+    ).startswith("sweep_chunk")
+    # a fence WITHOUT its parent present (custom window) counts normally
+    assert occupancy.overlap_stats({"readback_fence": 5.0}, 10.0)[
+        "duty"
+    ] == {"readback_fence": 0.5}
+
+
+def test_analyze_degrades_to_none_without_stage_spans():
+    assert occupancy.analyze([]) is None
+    assert occupancy.analyze([_span("freeze", 0, 1)]) is None
+
+
+def test_verdict_thresholds():
+    assert occupancy.verdict({}) is None
+    assert occupancy.verdict({"io_write": 0.92}) == \
+        "io_write 92% busy -> disk-bound"
+    assert occupancy.verdict({"cw_stream_stage": 0.8}) == \
+        "cw_stream_stage 80% busy -> host-precompute-bound"
+    assert occupancy.verdict({"drain": 0.1, "io_write": 0.05}) == \
+        "all stages mostly idle"
+    v = occupancy.verdict({"drain": 0.5, "io_write": 0.3})
+    assert v.startswith("no single bottleneck")
+    assert "drain" in v
+
+
+def test_overlap_stats_fully_serial_and_ideal():
+    # fully serial: wall == serial -> efficiency 0
+    s = occupancy.overlap_stats({"a": 3.0, "b": 3.0}, 6.0)
+    assert s["overlap_efficiency"] == 0.0
+    # ideal pipelining: wall == longest stage -> efficiency 1
+    s = occupancy.overlap_stats({"a": 3.0, "b": 6.0}, 6.0)
+    assert s["overlap_efficiency"] == 1.0
+    # one active stage: efficiency undefined, not crashed
+    s = occupancy.overlap_stats({"a": 3.0, "b": 0.0}, 4.0)
+    assert "overlap_efficiency" not in s
+    assert occupancy.overlap_stats({}, 1.0) == {}
+
+
+# --------------------------------------------------- live StageOccupancy
+def test_stage_occupancy_live_snapshot_and_bottleneck():
+    occ = occupancy.StageOccupancy(window_s=60.0)
+    t0 = time.monotonic() - occ._t0  # noqa: F841 — recorder just built
+    # simulate a saturated writer: busy ~= the recorder's lifetime
+    time.sleep(0.05)
+    lifetime = time.monotonic() - occ._t0
+    occ.observe(_span("io_write", 0, lifetime))
+    snap = occ.snapshot()
+    assert snap["stages"]["io_write"] >= 0.75
+    assert "disk-bound" in snap["bottleneck"]
+    # non-stage spans and events are ignored
+    occ.observe(_span("freeze", 0, 100))
+    occ.observe({"type": "event", "name": "io_write"})
+    assert "freeze" not in occ.snapshot()["stages"]
+
+
+def test_stage_occupancy_empty_snapshot():
+    snap = occupancy.StageOccupancy().snapshot()
+    assert snap == {"stages": {}, "bottleneck": None}
+
+
+# -------------------------------------------------------- pipeline stats
+def test_run_pipelined_reports_stage_busy_and_occupancy(tmp_path):
+    from pta_replicator_tpu.parallel.pipeline import run_pipelined
+
+    def dispatch(i):
+        time.sleep(0.01)
+        return np.full(4, i)
+
+    def write(i, block):
+        time.sleep(0.03)
+        np.save(tmp_path / f"c{i}.npy", block)
+
+    stats = run_pipelined(range(4), dispatch, write, depth=2)
+    busy = stats["stage_busy_s"]
+    assert set(busy) == {"dispatch", "drain", "io_write"}
+    assert busy["io_write"] >= 4 * 0.03 * 0.9
+    occ = stats["occupancy"]
+    assert occ["bottleneck"]
+    assert 0.0 <= occ.get("overlap_efficiency", 0.0) <= 1.0
+    # stage_busy_s values are rounded for the JSON; compare loosely
+    assert occ["serial_s"] == pytest.approx(sum(busy.values()), abs=1e-5)
+
+
+def test_synchronous_sweep_attributes_disk_time(tmp_path):
+    """The depth-1 loop's checkpoint write carries the same io_write
+    stage span as the pipelined writer thread, so an I/O-bound
+    synchronous run cannot read as compute-bound."""
+    import jax
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.batch import synthetic_batch
+    from pta_replicator_tpu.models.batched import Recipe
+    from pta_replicator_tpu.utils.sweep import sweep
+
+    batch = synthetic_batch(npsr=2, ntoa=128, seed=0)
+    recipe = Recipe(efac=jnp.ones(2, batch.toas_s.dtype))
+    sweep(jax.random.PRNGKey(0), batch, recipe, nreal=8, chunk=4,
+          checkpoint_path=str(tmp_path / "s.npz"), pipeline_depth=1)
+    util = occupancy.analyze(obs.TRACER.events())
+    assert "io_write" in util["stages"]
+    assert util["stages"]["io_write"]["calls"] == 2
+
+
+# ---------------------------------------------------- heartbeat + watch
+def test_heartbeat_carries_occupancy_and_watch_prints_bottleneck(tmp_path):
+    from pta_replicator_tpu.obs.flightrec import (
+        PROGRESS_SCHEMA,
+        FlightRecorder,
+    )
+    from pta_replicator_tpu.obs.report import render_heartbeat
+
+    rec = FlightRecorder(str(tmp_path), interval_s=5.0,
+                         stall_timeout_s=None).start()
+    try:
+        time.sleep(0.05)
+        # duty = busy / recorder lifetime: a span ~9x the pre-span
+        # lifetime leaves duty ~0.9 however slow the host is
+        lifetime = time.monotonic() - rec.occupancy._t0
+        with obs.span("io_write"):
+            time.sleep(min(2.0, lifetime * 9.0))
+        hb = rec.write_heartbeat()
+    finally:
+        rec.stop()
+    assert "occupancy" in PROGRESS_SCHEMA
+    occ = hb["occupancy"]
+    assert occ["stages"]["io_write"] > 0.5
+    assert "disk-bound" in occ["bottleneck"]
+    # the duty gauges mirror into the registry for metrics.json
+    assert obs.REGISTRY.gauge(
+        names.OCCUPANCY_DUTY_CYCLE, stage="io_write"
+    ).value > 0.5
+    line = render_heartbeat(hb)
+    assert "disk-bound" in line
+    # a v1-era heartbeat without the block still renders
+    assert "disk-bound" not in render_heartbeat(
+        {"written_at": "x", "finished": False})
+
+
+def test_heartbeat_with_occupancy_valid_under_torn_read_hammer(tmp_path):
+    """Satellite: the heartbeat grew the occupancy block — the
+    atomic-replace contract must still hold while stage spans hammer
+    the recorder (readers never see a torn or partial document)."""
+    from pta_replicator_tpu.obs.flightrec import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path), interval_s=0.001,
+                         stall_timeout_s=None).start()
+    path = tmp_path / "progress.json"
+    deadline = time.monotonic() + 5.0
+    while not path.exists() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    failures = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                doc = json.loads(path.read_text())
+                if "occupancy" not in doc:
+                    failures.append("heartbeat missing occupancy block")
+            except json.JSONDecodeError as exc:
+                failures.append(repr(exc))
+            except FileNotFoundError:
+                failures.append("file vanished")
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 1.0:
+        with obs.span("drain"):
+            pass
+        with obs.span("io_write"):
+            pass
+    stop.set()
+    for t in threads:
+        t.join()
+    rec.stop()
+    assert not failures, failures[:5]
+
+
+# ------------------------------------------------------------- devprof
+class _FakeMem:
+    argument_size_in_bytes = 100
+    output_size_in_bytes = 50
+    temp_size_in_bytes = 10
+    alias_size_in_bytes = 0
+    generated_code_size_in_bytes = 5
+
+
+class _FakeCompiled:
+    def __init__(self, flops=2e9, nbytes=1e8):
+        self._flops, self._bytes = flops, nbytes
+        self.cost_calls = 0
+
+    def cost_analysis(self):
+        self.cost_calls += 1
+        return [{"flops": self._flops, "bytes accessed": self._bytes,
+                 "transcendentals": 3.0, "bytes accessed0{}": 1.0}]
+
+    def memory_analysis(self):
+        return _FakeMem()
+
+
+class _BrokenCompiled:
+    def cost_analysis(self):
+        raise RuntimeError("backend does not report")
+
+    def memory_analysis(self):
+        raise RuntimeError("nope")
+
+
+def test_extract_cost_and_memory_normalized():
+    cost = devprof.extract_cost(_FakeCompiled())
+    assert cost == {"flops": 2e9, "bytes_accessed": 1e8,
+                    "transcendentals": 3.0}
+    mem = devprof.extract_memory(_FakeCompiled())
+    assert mem["argument_bytes"] == 100 and mem["temp_bytes"] == 10
+    assert devprof.extract_cost(_BrokenCompiled()) == {}
+    assert devprof.extract_memory(_BrokenCompiled()) == {}
+    with pytest.raises(RuntimeError):
+        devprof.extract_cost(_BrokenCompiled(), strict=True)
+
+
+def test_record_compiled_sets_gauges_cached_per_compilation():
+    c = _FakeCompiled()
+    out = devprof.record_compiled("lbl", c)
+    assert out["flops"] == 2e9
+    g = obs.REGISTRY.gauge("jax.cost.flops", label="lbl")
+    assert g.value == 2e9
+    # same executable again: served from the cache without re-invoking
+    # cost_analysis()
+    assert devprof.record_compiled("lbl", c)["flops"] == 2e9
+    assert c.cost_calls == 1
+    # a NEW compilation under the same label overwrites
+    devprof.record_compiled("lbl", _FakeCompiled(flops=5e9))
+    assert g.value == 5e9
+
+
+def test_roofline_classification_and_gauges():
+    # v5e ridge = 197e12 / 819e9 ~= 240 flop/B
+    roof = devprof.roofline(
+        "mem", flops=2e9, bytes_accessed=1e8, elapsed_s=0.01, calls=10,
+        device_kind="TPU v5 lite",
+    )
+    assert roof["flops_per_s"] == pytest.approx(2e12)
+    assert roof["intensity_flop_per_byte"] == pytest.approx(20.0)
+    assert roof["bound"] == "memory-bound"
+    assert devprof.classify(300.0, roof["ridge_intensity"]) == \
+        "compute-bound"
+    # below the ridge the attainable rate is bandwidth-limited
+    attainable = 20.0 * 819e9
+    assert roof["pct_of_roofline"] == pytest.approx(
+        100 * 2e12 / attainable)
+    assert obs.REGISTRY.gauge(
+        "jax.roofline.ridge_intensity", label="mem"
+    ).value == pytest.approx(197e12 / 819e9)
+    # unknown backend: achieved + intensity only, no peak-relative keys
+    roof = devprof.roofline(
+        "cpu", flops=1e9, bytes_accessed=1e9, elapsed_s=1.0,
+        device_kind="weird accelerator",
+    )
+    assert "pct_of_roofline" not in roof and "bound" not in roof
+    assert roof["flops_per_s"] == pytest.approx(1e9)
+
+
+def test_peak_for_env_override(monkeypatch):
+    monkeypatch.setenv("DEVPROF_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("DEVPROF_PEAK_BYTES_PER_S", "1e11")
+    assert devprof.peak_for("anything") == (1e12, 1e11)
+    # a half-set override warns instead of silently dropping the peak
+    monkeypatch.delenv("DEVPROF_PEAK_FLOPS")
+    with pytest.warns(UserWarning, match="both env vars"):
+        assert devprof.peak_for("nope") is None
+    with pytest.warns(UserWarning):
+        assert devprof.peak_for("TPU v4") == (275e12, 1228e9)
+    monkeypatch.delenv("DEVPROF_PEAK_BYTES_PER_S")
+    assert devprof.peak_for("TPU v4") == (275e12, 1228e9)
+    assert devprof.peak_for("nope") is None
+
+
+def test_bench_cost_fields_schema_and_error_path():
+    out = devprof.bench_cost_fields(
+        _FakeCompiled(), reps=5, elapsed_s=0.5,
+        device_kind="TPU v5 lite", label="bench.test",
+    )
+    assert out["xla_flops_per_chunk"] == 2e9
+    assert out["achieved_tflops_per_s"] == pytest.approx(
+        2e9 * 5 / 0.5 / 1e12, rel=1e-3)
+    assert out["roofline_bound"] == "memory-bound"
+    assert "mfu_vs_bf16_peak_pct" in out and "pct_of_roofline" in out
+    # a backend whose cost_analysis() RAISES yields the historical
+    # cost_analysis_error marker (never an exception out of a bench) —
+    # distinguishable from a backend that merely reports no cost model
+    broken = devprof.bench_cost_fields(
+        _BrokenCompiled(), reps=1, elapsed_s=1.0)
+    assert "RuntimeError" in broken["cost_analysis_error"]
+    assert "cost_analysis_error" in devprof.bench_cost_fields(
+        None, reps=1, elapsed_s=1.0)
+
+    class _NoCostModel:  # reports an empty model: empty block, no error
+        def cost_analysis(self):
+            return [{}]
+
+        def memory_analysis(self):
+            return None
+
+    assert devprof.bench_cost_fields(
+        _NoCostModel(), reps=1, elapsed_s=1.0) == {}
+
+
+def test_instrumented_jit_pending_capture_cpu():
+    import jax
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.obs.jaxhooks import instrumented_jit
+
+    f = instrumented_jit(lambda x: (x * 2.0).sum(), name="occ.test_fn")
+    f(jnp.ones(16)).block_until_ready()
+    captured = devprof.capture_pending()
+    assert "occ.test_fn" in captured
+    assert captured["occ.test_fn"]["flops"] > 0
+    assert obs.REGISTRY.gauge(
+        "jax.cost.flops", label="occ.test_fn").value > 0
+    # nothing pending after a capture (no retrace happened)
+    assert devprof.capture_pending() == {}
+    # a retrace (new shape) re-arms the pending set
+    f(jnp.ones(32)).block_until_ready()
+    assert "occ.test_fn" in devprof.capture_pending(force=True)
+
+
+def test_capture_pending_pairs_avals_with_their_own_instance():
+    """Several jit instances may share one label (the lru_cached mesh
+    engines): the pending avals must be lowered from the instance that
+    produced them, not from whichever instance registered last."""
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.obs.jaxhooks import instrumented_jit
+
+    small = instrumented_jit(lambda x: x * 2.0, name="occ.shared")
+    big = instrumented_jit(lambda m: (m @ m).sum(), name="occ.shared")
+    # trace big first, then small: the label's pending slot holds
+    # SMALL's avals and must lower SMALL (big's matmul would be ~1000x
+    # the flops — and lowering big from a 1-D aval would just raise and
+    # silently record nothing)
+    big(jnp.ones((32, 32))).block_until_ready()
+    small(jnp.ones(8)).block_until_ready()
+    captured = devprof.capture_pending(force=True)
+    assert "occ.shared" in captured
+    assert 0 < captured["occ.shared"]["flops"] < 100
+
+
+def test_capture_pending_does_not_perturb_retrace_accounting():
+    """The synthetic lowering strips weak_type, which can genuinely
+    retrace a label called with Python scalars — the measurement must
+    not count as a retrace nor re-arm the pending set it drains."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.obs import trace_count
+    from pta_replicator_tpu.obs.jaxhooks import instrumented_jit
+
+    obs.install_jax_hooks()
+    g = instrumented_jit(lambda x, s: x * s, name="occ.weak",
+                         retrace_warn=1)
+    g(jnp.ones(4), 2.0).block_until_ready()  # weak-typed scalar arg
+    assert trace_count("occ.weak") == 1
+    compiles_before = obs.REGISTRY.counter("jax.compiles").value
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # a RetraceWarning would raise
+        captured = devprof.capture_pending()
+    assert "occ.weak" in captured
+    assert trace_count("occ.weak") == 1  # the probe ignored the probe
+    # ...and the synthetic compile stayed out of the compile accounting
+    assert obs.REGISTRY.counter("jax.compiles").value == compiles_before
+    assert devprof.capture_pending() == {}  # pending set drained
+
+
+def test_duty_gauge_zeroed_when_stage_leaves_window(tmp_path):
+    from pta_replicator_tpu.obs.flightrec import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path), interval_s=5.0,
+                         stall_timeout_s=None).start()
+    try:
+        rec.occupancy.window_s = 0.15
+        with obs.span("io_write"):
+            time.sleep(0.1)
+        rec.write_heartbeat()
+        g = obs.REGISTRY.gauge(names.OCCUPANCY_DUTY_CYCLE,
+                               stage="io_write")
+        assert g.value > 0.0
+        time.sleep(0.3)  # the write drops out of the rolling window
+        hb = rec.write_heartbeat()
+        assert "io_write" not in hb["occupancy"]["stages"]
+        assert g.value == 0.0  # stale saturation must not linger
+        # ...and the zeroing happens once, not on every later tick
+        g.set(0.5)
+        rec.write_heartbeat()
+        assert g.value == 0.5
+    finally:
+        rec.stop()
+
+
+def test_device_trace_registers_capture_artifact(tmp_path):
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "cap")
+    obs.start_capture(d, flight_recorder=False)
+    with devprof.device_trace() as logdir:
+        jnp.ones(8).sum().block_until_ready()
+    obs.finish_capture()
+    assert os.path.isdir(logdir)
+    meta = json.loads((tmp_path / "cap" / "meta.json").read_text())
+    assert meta["device_traces"] == ["xla_trace"]  # relativized
+    # the completion event landed in the stream
+    evs = (tmp_path / "cap" / "events.jsonl").read_text()
+    assert "devprof.device_trace" in evs and '"device_trace"' in evs
+
+    from pta_replicator_tpu.obs.report import render_report
+
+    out = render_report(d)
+    assert "device trace: xla_trace" in out
+
+    # schema checker: registered dirs must exist
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    assert checker.validate_device_traces(d) == []
+    import shutil
+
+    shutil.rmtree(logdir)
+    problems = checker.validate_device_traces(d)
+    assert problems and "does not exist" in problems[0]
+
+
+def test_schema_checker_tolerates_v1_heartbeats(tmp_path):
+    """PROGRESS_SCHEMA v2 added the required occupancy block; a capture
+    written by the v1 recorder must still validate (the field is only
+    required from the document's own schema stamp on)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+
+    v1 = {"schema": 1, "pid": 1, "written_at": "x", "uptime_s": 0.1,
+          "last_span_age_s": 0.1, "open_spans": {}, "sweep": {},
+          "jax": {}, "stalls": 0.0, "finished": True}
+    p = tmp_path / "progress.json"
+    p.write_text(json.dumps(v1))
+    assert checker.validate_flightrec_file(str(p), "progress") == []
+    # a v2 document missing the block is still an error
+    p.write_text(json.dumps({**v1, "schema": 2}))
+    problems = checker.validate_flightrec_file(str(p), "progress")
+    assert problems and "occupancy" in problems[0]
+
+
+def test_device_trace_requires_capture_or_logdir():
+    with pytest.raises(ValueError, match="no telemetry capture"):
+        with devprof.device_trace():
+            pass
+
+
+def test_profiling_shim_delegates_to_devprof(tmp_path):
+    import jax.numpy as jnp
+
+    from pta_replicator_tpu.utils.profiling import device_trace
+
+    d = str(tmp_path / "cap")
+    obs.start_capture(d, flight_recorder=False)
+    with device_trace(str(tmp_path / "xla")):
+        jnp.ones(4).sum().block_until_ready()
+    obs.finish_capture()
+    meta = json.loads((tmp_path / "cap" / "meta.json").read_text())
+    # explicit logdir outside the capture dir stays absolute
+    assert meta["device_traces"] == [str(tmp_path / "xla")]
+
+
+# ------------------------------------------------------ report rendering
+def test_report_renders_utilization_and_roofline(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    d = tmp_path / "cap"
+    d.mkdir()
+    with open(d / "events.jsonl", "w") as fh:
+        fh.write(json.dumps(
+            {"type": "meta", "schema": 1, "t0": 0.0}) + "\n")
+        for rec in (_span("drain", 0, 8), _span("io_write", 1, 8.5)):
+            fh.write(json.dumps(rec) + "\n")
+    (d / "metrics.json").write_text(json.dumps({
+        "jax.roofline.flops_per_s": [
+            {"kind": "gauge", "labels": {"label": "bench.run_chunk"},
+             "value": 2e12}],
+        "jax.roofline.intensity_flop_per_byte": [
+            {"kind": "gauge", "labels": {"label": "bench.run_chunk"},
+             "value": 20.0}],
+        "jax.roofline.ridge_intensity": [
+            {"kind": "gauge", "labels": {"label": "bench.run_chunk"},
+             "value": 240.0}],
+        "jax.roofline.pct_of_roofline": [
+            {"kind": "gauge", "labels": {"label": "bench.run_chunk"},
+             "value": 12.2}],
+    }))
+    main(["report", str(d)])
+    out = capsys.readouterr().out
+    assert "utilization (stage occupancy):" in out
+    assert "io_write" in out and "duty" in out
+    assert "bottleneck:" in out
+    assert "roofline (per jit label):" in out
+    assert "memory-bound" in out and "12.2% of roofline" in out
+
+    # degraded: a capture with no stage spans simply has no section
+    empty = tmp_path / "plain"
+    empty.mkdir()
+    (empty / "events.jsonl").write_text(
+        json.dumps({"type": "meta", "schema": 1, "t0": 0.0}) + "\n"
+        + json.dumps(_span("freeze", 0, 1)) + "\n")
+    main(["report", str(empty)])
+    out = capsys.readouterr().out
+    assert "utilization" not in out
+    assert "roofline" not in out
+
+
+def test_report_json_includes_utilization(tmp_path):
+    from pta_replicator_tpu.obs.report import render_report
+
+    d = tmp_path / "cap"
+    d.mkdir()
+    (d / "events.jsonl").write_text(json.dumps(_span("drain", 0, 2)) + "\n")
+    doc = json.loads(render_report(str(d), as_json=True))
+    assert doc["utilization"]["stages"]["drain"]["busy_s"] == 2.0
+
+
+def test_chrome_trace_lifts_stage_spans_onto_named_tracks():
+    from pta_replicator_tpu.obs.trace import Tracer
+
+    tracer = Tracer()
+    with tracer.span("drain"):  # graftlint: disable=telemetry-unknown-name
+        pass
+    with tracer.span("my_custom"):  # graftlint: disable=telemetry-unknown-name
+        pass
+    doc = tracer.chrome_trace()
+    metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    named = {e["tid"]: e["args"]["name"] for e in metas}
+    spans = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    # the stage span rides its named synthetic track...
+    assert named[spans["drain"]["tid"]] == "stage:drain"
+    # ...while a non-stage span keeps its real thread id
+    assert spans["my_custom"]["tid"] == threading.get_ident()
+    assert spans["my_custom"]["tid"] not in named
+
+
+# ------------------------------------------------- bench-diff directions
+def test_metric_direction_for_cost_roofline_and_occupancy_names():
+    # jax.cost.* are program properties: never a perf verdict, even
+    # though "flops" is a rate token elsewhere
+    assert metric_direction("telemetry.jax.jax.cost.flops{label=x}") is None
+    assert metric_direction("jax.cost.bytes_accessed") is None
+    # roofline achieved rates and percentages are higher-better
+    assert metric_direction(
+        "telemetry.jax.jax.roofline.flops_per_s{label=bench.run_chunk}"
+    ) is True
+    assert metric_direction("pct_of_roofline") is True
+    assert metric_direction("mfu_vs_bf16_peak_pct") is True
+    # positions, not scores
+    assert metric_direction("arithmetic_intensity_flop_per_byte") is None
+    assert metric_direction("jax.roofline.ridge_intensity{label=x}") is None
+    assert metric_direction("occupancy.duty_cycle{stage=drain}") is None
+    # overlap metrics. wall_reduction_vs_serial is info, NOT
+    # higher-better: the depth-1 null control records it at ~0, where a
+    # relative-delta verdict turns noise (-0.2 -> -0.6) into
+    # "regressed"; overlap_efficiency is the directional score
+    assert metric_direction("measured_overlap_efficiency") is True
+    assert metric_direction(
+        "occupancy.depth1.wall_reduction_vs_serial_pct") is None
+    assert metric_direction("wall_reduction_vs_serial_pct") is None
+    assert metric_direction("stage_busy_s") is False
+    assert metric_direction("cw_stream.prefetch_stall_s") is False
+
+
+def test_bench_diff_accepts_new_names(tmp_path):
+    from pta_replicator_tpu.obs.regress import bench_diff
+
+    def doc(flops, tflops, pct):
+        return {
+            "metric": "m", "value": 100.0, "unit": "r/s",
+            "schema_version": 2,
+            "xla_flops_per_chunk": flops,
+            "achieved_tflops_per_s": tflops,
+            "pct_of_roofline": pct,
+            "arithmetic_intensity_flop_per_byte": 20.0,
+            "telemetry": {"jax": {
+                "jax.cost.flops{label=bench.run_chunk}": flops,
+                "jax.roofline.flops_per_s{label=bench.run_chunk}":
+                    tflops * 1e12,
+            }},
+        }
+
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(doc(1e9, 2.0, 10.0)))
+    # flops halved (workload change: info), achieved rate halved
+    # (regression), roofline % halved (regression)
+    b.write_text(json.dumps(doc(5e8, 1.0, 5.0)))
+    _table, summary, rc = bench_diff([str(a), str(b)], threshold=0.10)
+    v = summary["verdicts"]
+    assert v["xla_flops_per_chunk"] == "info"
+    assert v["telemetry.jax.jax.cost.flops{label=bench.run_chunk}"] == \
+        "info"
+    assert v["achieved_tflops_per_s"] == "regressed"
+    assert v["pct_of_roofline"] == "regressed"
+    assert v["arithmetic_intensity_flop_per_byte"] == "info"
+    assert rc == 1
