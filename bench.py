@@ -60,28 +60,15 @@ BENCH_SCHEMA_VERSION = 2
 def _provenance() -> dict:
     """Self-describing stamp on every bench JSON (success AND failure):
     schema version, git revision, and the host/runtime platform — what
-    bench-diff needs to refuse or annotate cross-round comparisons."""
-    import platform as _plat
+    bench-diff needs to refuse or annotate cross-round comparisons.
+    The ONE stamping implementation is shared with the MULTICHIP /
+    validate_device evidence series (utils.provenance)."""
+    from pta_replicator_tpu.utils.provenance import provenance_stamp
 
-    prov = {
-        "schema_version": BENCH_SCHEMA_VERSION,
-        "platform": {
-            "python": _plat.python_version(),
-            "os": _plat.platform(),
-            "machine": _plat.machine(),
-        },
-    }
-    try:
-        r = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, text=True, timeout=10,
-        )
-        if r.returncode == 0 and r.stdout.strip():
-            prov["git_rev"] = r.stdout.strip()
-    except Exception:
-        pass  # provenance is best-effort, never a bench failure
-    return prov
+    return provenance_stamp(
+        BENCH_SCHEMA_VERSION,
+        repo_root=os.path.dirname(os.path.abspath(__file__)),
+    )
 
 def _probe_and_hold() -> float:
     """In-process backend probe under a watchdog; the caller keeps the

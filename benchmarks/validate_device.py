@@ -163,6 +163,11 @@ def main():
         "pass": dev < 0.15,
     }
 
+    from pta_replicator_tpu.utils.provenance import (
+        EVIDENCE_SCHEMA_VERSION,
+        provenance_stamp,
+    )
+
     print(
         json.dumps(
             {
@@ -174,6 +179,9 @@ def main():
                 "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 "all_pass": all(c["pass"] for c in checks.values()),
                 "checks": checks,
+                # schema_version/git_rev/platform, same stamping as
+                # bench.py's BENCH_r*.json (bench-diff gate parity)
+                **provenance_stamp(EVIDENCE_SCHEMA_VERSION),
             }
         )
     )

@@ -159,6 +159,13 @@ def main(argv=None):
                         "instead of plain WLS; implies --full-fit")
     p.add_argument("--sharded", action="store_true",
                    help="shard realizations over all visible devices")
+    p.add_argument("--mesh-shape", default=None, metavar="RxP",
+                   help="explicit ('real','psr') mesh shape for the "
+                        "sharded path, e.g. 4x2 (npsr must divide P); "
+                        "default: all devices on the realization axis. "
+                        "Implies --sharded. A sharded checkpointed "
+                        "sweep writes per-shard chunk archives "
+                        "(docs/performance.md 'Sharding the sweep')")
     p.add_argument("--checkpoint", default=None,
                    help="resumable sweep checkpoint path (chunked)")
     p.add_argument("--chunk", type=int, default=256)
@@ -262,6 +269,22 @@ def main(argv=None):
         })
 
 
+def _make_mesh_arg(mesh_shape):
+    """A ('real','psr') mesh from the --mesh-shape argument ("RxP"), or
+    the all-devices-on-'real' default when it is None."""
+    from .parallel import make_mesh
+
+    if not mesh_shape:
+        return make_mesh()
+    try:
+        n_real, n_psr = (int(x) for x in mesh_shape.lower().split("x"))
+    except ValueError:
+        raise SystemExit(
+            f"--mesh-shape must look like 4x2 (got {mesh_shape!r})"
+        )
+    return make_mesh(n_real, n_psr)
+
+
 def _run_command(args):
     from . import load_from_directories, make_ideal
     from .obs import names, span
@@ -316,10 +339,8 @@ def _run_command(args):
                     f"--nreal {args.nreal} must be a multiple of --chunk {chunk}"
                 )
             mesh = None
-            if args.sharded:
-                from .parallel import make_mesh
-
-                mesh = make_mesh()
+            if args.sharded or args.mesh_shape:
+                mesh = _make_mesh_arg(args.mesh_shape)
             out = sweep(key, batch, recipe, nreal=args.nreal,
                         checkpoint_path=args.checkpoint, chunk=chunk,
                         reduce_fn=None, fit=args.fit, mesh=mesh,
@@ -329,12 +350,12 @@ def _run_command(args):
                                          else None),
                         progress=lambda d, t: print(f"chunk {d}/{t}",
                                                     file=sys.stderr))
-        elif args.sharded:
-            from .parallel import make_mesh, sharded_realize
+        elif args.sharded or args.mesh_shape:
+            from .parallel import sharded_realize
 
             out = np.asarray(sharded_realize(
-                key, batch, recipe, nreal=args.nreal, mesh=make_mesh(),
-                fit=args.fit,
+                key, batch, recipe, nreal=args.nreal,
+                mesh=_make_mesh_arg(args.mesh_shape), fit=args.fit,
             ))
         else:
             from .models.batched import realize

@@ -211,6 +211,7 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/utils/sweep.py", "span", n.SPAN_SWEEP_CHUNK),
         (f"{pkg}/utils/sweep.py", "span", n.SPAN_READBACK_FENCE),
         (f"{pkg}/utils/sweep.py", "span", n.SPAN_SWEEP_PIPELINE),
+        (f"{pkg}/utils/sweep.py", "span", n.SPAN_MULTICHIP_SWEEP),
         (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_CHUNKS_TOTAL),
         (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_CHUNKS_DONE),
         (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_REALIZATIONS),
@@ -227,6 +228,11 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
          n.CW_STREAM_BYTES_STAGED),
         (f"{pkg}/parallel/prefetch.py", "metric",
          n.CW_STREAM_PREFETCH_STALL_S),
+        # multi-chip sweep path (PR 7): the per-shard readback gauge on
+        # the mesh fetch, and the per-device staging instrumentation of
+        # prefetch_to_mesh rides the cw_stream_stage/bytes_staged rows
+        # above (same names, device= label)
+        (f"{pkg}/parallel/mesh.py", "metric", n.SWEEP_SHARDS_INFLIGHT),
         (f"{pkg}/models/batched.py", "span", n.SPAN_CW_STREAM_RESPONSE),
         (f"{pkg}/models/batched.py", "metric", n.CW_STREAM_TILES_DONE),
         (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),

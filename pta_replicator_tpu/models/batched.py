@@ -668,6 +668,7 @@ def cw_stream_response(
     prefetch_depth: int = 2,
     tiles_per_step: int = 8,
     stall_timeout_s=900.0,
+    mesh=None,
 ):
     """Summed CW response (Np, Nt) from a *stream* of plane tiles, with
     double-buffered host->device prefetch: the next macro tile is built
@@ -696,6 +697,16 @@ def cw_stream_response(
     order as one monolithic scan (tests/test_cw_stream.py asserts
     exact equality at prefetch depths 1/2/4 and several
     ``tiles_per_step`` groupings).
+
+    On a multi-device ``mesh`` the staging fans out per device
+    (parallel.prefetch.prefetch_to_mesh): the pulsar-plane macros
+    shard along 'psr' (each chip receives and accumulates only its
+    pulsars — the per-source sum order per pulsar is unchanged, so the
+    result stays bit-identical to the single-chip stream), the source
+    planes replicate, and the (Np, Nt) accumulator lives psr-sharded
+    on the mesh — ready for :func:`~pta_replicator_tpu.parallel.mesh.
+    static_delays` to hand to the sharded engines without a host
+    round-trip.
     """
     from ..obs import gauge, names, span
     from ..parallel.prefetch import prefetch_to_device
@@ -737,17 +748,42 @@ def cw_stream_response(
         if buf_s:
             yield np.stack(buf_s), np.stack(buf_p)
 
-    donate = bool(donate_keys_argnums(jax.default_backend()))
+    multichip = mesh is not None and int(mesh.devices.size) > 1
+    platform = (
+        mesh.devices.flat[0].platform if multichip
+        else jax.default_backend()
+    )
+    donate = bool(donate_keys_argnums(platform))
     step = _cw_stream_step(psr_term, evolve, donate)
     acc = jnp.zeros(batch.toas_s.shape, dtype)
     nmacros = 0
     with span(names.SPAN_CW_STREAM_RESPONSE, depth=prefetch_depth) as sp:
         gauge(names.CW_STREAM_TILES_DONE).set(0)
-        staged = prefetch_to_device(
-            macros(),
-            depth=prefetch_depth,
-            stall_timeout_s=stall_timeout_s,
-        )
+        if multichip:
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import put_sharded
+            from ..parallel.prefetch import prefetch_to_mesh
+
+            # accumulator + TOA grid live psr-sharded; the psr-plane
+            # macros (K, NC_PSR, Np, cs) shard their pulsar axis so
+            # each chip stages and accumulates only its own pulsars,
+            # while the source planes replicate to every chip
+            acc = put_sharded(acc, mesh, P("psr", None))
+            u = put_sharded(u, mesh, P("psr", None))
+            staged = prefetch_to_mesh(
+                macros(),
+                mesh,
+                specs=(P(), P(None, None, "psr", None)),
+                depth=prefetch_depth,
+                stall_timeout_s=stall_timeout_s,
+            )
+        else:
+            staged = prefetch_to_device(
+                macros(),
+                depth=prefetch_depth,
+                stall_timeout_s=stall_timeout_s,
+            )
         ntiles = 0
         for src_macro, psr_macro in staged:
             acc = step(acc, u, src_macro, psr_macro)
@@ -783,6 +819,7 @@ def cgw_catalog_delays_streamed(
     prefetch_depth: int = 2,
     tiles_per_step: int = 8,
     stall_timeout_s=900.0,
+    mesh=None,
 ):
     """Summed CW-catalog response with the full streaming pipeline:
     tiled f64 host precompute -> double-buffered host->device prefetch
@@ -806,7 +843,7 @@ def cgw_catalog_delays_streamed(
     return cw_stream_response(
         batch, tiles, evolve=evolve, psr_term=psr_term,
         prefetch_depth=prefetch_depth, tiles_per_step=tiles_per_step,
-        stall_timeout_s=stall_timeout_s,
+        stall_timeout_s=stall_timeout_s, mesh=mesh,
     )
 
 
@@ -1592,10 +1629,12 @@ def finalize_residuals(delays, batch: PulsarBatch, recipe: Recipe, fit: bool):
     return quadratic_fit_subtract(delays, batch)
 
 
-def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
+def deterministic_delays(batch: PulsarBatch, recipe: Recipe, mesh=None):
     """Realization-independent delays (CW outlier catalog, bursts, memory,
     transients): computed once per batch, shared across the whole
-    realization axis."""
+    realization axis. ``mesh`` routes the streamed CW pipeline's
+    staging per device (cw_stream_response) — the monolithic paths
+    ignore it (parallel.mesh.static_delays places their result)."""
     total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
     if recipe.cgw_params is not None:
         if recipe.cgw_stream_chunk is not None:
@@ -1616,6 +1655,7 @@ def deterministic_delays(batch: PulsarBatch, recipe: Recipe):
                 tref_s=recipe.cgw_tref_s,
                 chunk=recipe.cgw_stream_chunk,
                 prefetch_depth=recipe.cgw_prefetch_depth,
+                mesh=mesh,
             )
         else:
             total = total + cgw_catalog_delays(

@@ -220,7 +220,7 @@ class FlightRecorder:
                 pass  # transient (dir deleted mid-run); keep sampling
             self._check_watchdog()
 
-    def _sweep_block(self) -> dict:
+    def _sweep_block(self, metrics=None) -> dict:
         snap = {}
         for name, key in (
             (names.SWEEP_CHUNKS_DONE, "chunks_done"),
@@ -230,7 +230,7 @@ class FlightRecorder:
             (names.SWEEP_REALIZATIONS, "realizations"),
             (names.PIPELINE_DRAIN_TIMEOUTS, "drain_timeouts"),
         ):
-            val = _metric_value(name)
+            val = _metric_value(name, metrics=metrics)
             if val is not None:
                 snap[key] = val
         done, total = snap.get("chunks_done"), snap.get("chunks_total")
@@ -264,8 +264,13 @@ class FlightRecorder:
         # (and instantly trip the watchdog) before its first span
         return max(TRACER.last_activity, self._t_start)
 
-    def _occupancy_block(self) -> dict:
-        occ = self.occupancy.snapshot()
+    def _occupancy_block(self, emergency: bool = False) -> dict:
+        occ = self.occupancy.snapshot(timeout=1.0 if emergency else None)
+        if emergency:
+            # the postmortem embeds this block directly; skip the gauge
+            # mirroring — REGISTRY.gauge() and _mirror_lock are more
+            # locks the suspended main thread could be parked inside
+            return occ
         # mirror the live duties into gauges so metrics.json / the
         # report carry the final window's utilization after the run —
         # including zeroing stages that went idle (dropped out of the
@@ -286,7 +291,11 @@ class FlightRecorder:
             self._mirrored_stages = set(stages)
         return occ
 
-    def _heartbeat(self, finished: bool = False) -> dict:
+    def _heartbeat(self, finished: bool = False,
+                   emergency: bool = False) -> dict:
+        # one bounded registry acquire shared by every metric lookup
+        # below — a wedged registry lock costs a single timeout
+        ms = REGISTRY.metrics(timeout=1.0 if emergency else None)
         hb = {
             "schema": PROGRESS_SCHEMA_VERSION,
             "pid": os.getpid(),
@@ -297,16 +306,20 @@ class FlightRecorder:
             ),
             "open_spans": {
                 str(tid): stack
-                for tid, stack in TRACER.open_spans().items()
+                for tid, stack in TRACER.open_spans(
+                    timeout=1.0 if emergency else None
+                ).items()
             },
-            "sweep": self._sweep_block(),
-            "occupancy": self._occupancy_block(),
+            "sweep": self._sweep_block(metrics=ms),
+            "occupancy": self._occupancy_block(emergency=emergency),
             "jax": {
                 name.split(".", 1)[1]: val
                 for name in (names.JAX_COMPILES, names.JAX_TRACES)
-                if (val := _metric_value(name)) is not None
+                if (val := _metric_value(name, metrics=ms)) is not None
             },
-            "stalls": _metric_value(names.FLIGHTREC_STALLS) or 0.0,
+            "stalls": _metric_value(
+                names.FLIGHTREC_STALLS, metrics=ms
+            ) or 0.0,
             "finished": bool(finished),
         }
         mem = device_memory_snapshot()
@@ -352,10 +365,17 @@ class FlightRecorder:
         )
 
     # -- postmortem -----------------------------------------------------
-    def write_postmortem(self, reason: str, exc: BaseException = None) -> str:
+    def write_postmortem(self, reason: str, exc: BaseException = None,
+                         emergency: bool = False) -> str:
         """Flush the black box. Idempotent per recorder: only the first
         call writes (a SIGTERM racing the excepthook must not overwrite
-        the more specific report with the less specific one)."""
+        the more specific report with the less specific one).
+
+        ``emergency`` marks a flush racing a suspended main thread (the
+        signal-handler path): tracer-, registry-, and occupancy-lock
+        acquires are all bounded and degrade to best-effort snapshots,
+        because the interrupted frame may hold any of them and can
+        never release it while the handler waits on this flush."""
         with self._pm_lock:
             if self._postmortem_written:
                 return os.path.join(self.directory, "postmortem.json")
@@ -364,9 +384,12 @@ class FlightRecorder:
             "schema": PROGRESS_SCHEMA_VERSION,
             "reason": reason,
             "written_at": _utc_now(),
-            "heartbeat": self._heartbeat(finished=False),
+            "heartbeat": self._heartbeat(finished=False,
+                                         emergency=emergency),
             "ring": list(self.ring),
-            "metrics": REGISTRY.to_json(),
+            "metrics": REGISTRY.to_json(
+                timeout=1.0 if emergency else None
+            ),
         }
         if exc is not None:
             pm["exception"] = {
@@ -379,7 +402,11 @@ class FlightRecorder:
         path = os.path.join(self.directory, "postmortem.json")
         os.makedirs(self.directory, exist_ok=True)
         _atomic_json(path, pm)
-        TRACER.flush()  # events.jsonl should be complete alongside it
+        # events.jsonl should be complete alongside it; in an emergency
+        # the suspended main thread may hold the sink lock forever, so
+        # bound the wait — the sink already carries everything up to the
+        # interrupted write
+        TRACER.flush(timeout=1.0 if emergency else None)
         return path
 
 
@@ -409,11 +436,15 @@ def _clear_active(rec: FlightRecorder) -> None:
             _ACTIVE = None
 
 
-def _metric_value(name: str) -> Optional[float]:
+def _metric_value(name: str, metrics=None) -> Optional[float]:
     """Current value of a plain (unlabeled) counter/gauge, or None if it
     was never registered — reading must not CREATE the metric, or the
-    heartbeat would pollute every later metrics.json snapshot."""
-    for m in REGISTRY.metrics():
+    heartbeat would pollute every later metrics.json snapshot.
+    ``metrics`` is an already-fetched ``REGISTRY.metrics()`` list: the
+    emergency heartbeat takes ONE bounded registry-lock acquire and
+    shares the result across every lookup, so a wedged lock costs one
+    timeout, not one per metric."""
+    for m in REGISTRY.metrics() if metrics is None else metrics:
         if m.name == name and not m.labels and hasattr(m, "value"):
             return m.value
     return None
@@ -425,24 +456,32 @@ def _flush_from_signal(rec: FlightRecorder, reason: str,
 
     The handler runs on the main thread between bytecodes — the
     interrupted frame may be holding the tracer/registry locks (e.g.
-    mid-``Tracer._record``), and ``write_postmortem`` needs those same
-    non-reentrant locks for its snapshots. Acquiring them directly in
-    the handler would deadlock the process exactly when the feature
-    matters (a busy sweep being SIGTERMed). So the flush runs on a side
-    thread, which can take the locks once the (suspended) main thread's
-    critical section is NOT the lock holder — the overwhelmingly common
-    case — and the handler waits at most ``deadline_s`` before giving
-    up and letting the process die postmortem-less but dead."""
+    mid-``Tracer._record``, whose critical section includes the sink
+    write), and ``write_postmortem`` needs those same non-reentrant
+    locks for its snapshots. Acquiring them directly in the handler
+    would deadlock the process exactly when the feature matters (a busy
+    sweep being SIGTERMed). So the flush runs on a side thread in
+    ``emergency`` mode: tracer-lock acquires are bounded and fall back
+    to unlocked best-effort snapshots when the suspended frame IS the
+    holder (it is parked until this handler returns, so the structures
+    are quiescent). ``done`` is set the moment ``postmortem.json`` is
+    on disk — the trailing ``stop()`` (sampler join + listener removal,
+    which may also need the held tracer lock) continues on the daemon
+    thread and must not delay the kill; ``deadline_s`` remains the
+    last-resort bound."""
     done = threading.Event()
 
     def flush():
         try:
-            rec.write_postmortem(reason)
-            rec.stop(finished=False)
+            rec.write_postmortem(reason, emergency=True)
         except Exception:
             pass
         finally:
             done.set()
+        try:
+            rec.stop(finished=False)
+        except Exception:
+            pass
 
     threading.Thread(target=flush, name="flightrec-flush",
                      daemon=True).start()

@@ -119,8 +119,18 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
-    def snapshot(self) -> dict:
-        with self._lock:
+    def snapshot(self, timeout: float = None) -> dict:
+        """``timeout`` bounds the lock acquire for the signal-time
+        postmortem flush: the interrupted main-thread frame may be
+        suspended inside :meth:`observe`'s critical section, in which
+        case the lock can never be released while the flush is waited
+        on. The holder being parked makes an unlocked read quiescent,
+        so on acquire timeout we degrade to a possibly-torn snapshot
+        (sum updated, count not) instead of deadlocking."""
+        acquired = self._lock.acquire(
+            timeout=-1 if timeout is None else timeout
+        )
+        try:
             return {
                 "count": self._count,
                 "sum": self._sum,
@@ -133,6 +143,9 @@ class Histogram:
                     if c
                 },
             }
+        finally:
+            if acquired:
+                self._lock.release()
 
 
 def _label_key(labels: dict) -> Tuple[Tuple[str, str], ...]:
@@ -189,23 +202,46 @@ class MetricsRegistry:
         kwargs = {} if buckets is None else {"buckets": tuple(buckets)}
         return self._get(Histogram, name, labels, **kwargs)
 
-    def metrics(self):
-        with self._lock:
-            return list(self._metrics.values())
+    def metrics(self, timeout: float = None):
+        """``timeout`` bounds the lock acquire for the signal-time
+        postmortem flush (the interrupted main-thread frame may be
+        suspended inside :meth:`_get`'s critical section — sweep-loop
+        gauge lookups run every chunk). The holder being parked makes
+        an unlocked read quiescent — every other writer is blocked on
+        the same lock — so on acquire timeout we degrade to a
+        best-effort copy instead of deadlocking."""
+        acquired = self._lock.acquire(
+            timeout=-1 if timeout is None else timeout
+        )
+        try:
+            if acquired:
+                return list(self._metrics.values())
+            try:  # unlocked emergency snapshot
+                return list(self._metrics.values())
+            except RuntimeError:  # torn dict iteration
+                return []
+        finally:
+            if acquired:
+                self._lock.release()
 
     def reset(self) -> None:
         with self._lock:
             self._metrics.clear()
 
     # -- exporters ------------------------------------------------------
-    def to_json(self) -> dict:
-        """{"name": [{"labels": {...}, "kind": ..., **snapshot}, ...]}"""
+    def to_json(self, timeout: float = None) -> dict:
+        """{"name": [{"labels": {...}, "kind": ..., **snapshot}, ...]}
+
+        ``timeout`` bounds every lock acquire (registry and per-metric)
+        for the signal-time postmortem flush; see :meth:`metrics`."""
         out: Dict[str, list] = {}
-        for m in self.metrics():
+        for m in self.metrics(timeout=timeout):
+            snap = (m.snapshot(timeout=timeout)
+                    if isinstance(m, Histogram) else m.snapshot())
             out.setdefault(m.name, []).append({
                 "kind": m.kind,
                 "labels": dict(m.labels),
-                **m.snapshot(),
+                **snap,
             })
         return out
 

@@ -47,6 +47,10 @@ SPAN_SWEEP_PIPELINE = "sweep_pipeline"
 SPAN_DISPATCH = "dispatch"
 SPAN_DRAIN = "drain"
 SPAN_IO_WRITE = "io_write"
+#: phase span wrapping a whole mesh-sharded sweep (utils/sweep.py): the
+#: static precompute, the pipelined chunk loop, and consolidation — the
+#: occupancy window for multi-chip bottleneck attribution
+SPAN_MULTICHIP_SWEEP = "multichip_sweep"
 
 # streamed CW-catalog plane pipeline (parallel/prefetch.py,
 # models/batched.py cw_stream_response)
@@ -78,7 +82,7 @@ SPANS = frozenset({
     SPAN_MAKE_MESH, SPAN_SHARD_BATCH, SPAN_STATIC_DELAYS,
     SPAN_SHARDED_REALIZE, SPAN_SHARDMAP_REALIZE,
     SPAN_SWEEP_CHUNK, SPAN_READBACK_FENCE, SPAN_SWEEP_PIPELINE,
-    SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE,
+    SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE, SPAN_MULTICHIP_SWEEP,
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_INGEST, SPAN_BUILD_RECIPE,
     SPAN_COMPUTE, SPAN_WRITE_OUTPUT,
@@ -112,6 +116,10 @@ SWEEP_CHUNKS_DONE = "sweep.chunks_done"
 SWEEP_REALIZATIONS = "sweep.realizations"
 SWEEP_INFLIGHT_CHUNKS = "sweep.inflight_chunks"
 SWEEP_LAST_DISPATCHED_CHUNK = "sweep.last_dispatched_chunk"
+#: per-shard device_get copies currently in flight during a mesh-sweep
+#: chunk readback (parallel/mesh.py fetch_shard_blocks): nonzero while
+#: the overlapped D2H drains, 0 between chunks
+SWEEP_SHARDS_INFLIGHT = "sweep.shards_inflight"
 PIPELINE_DRAIN_TIMEOUTS = "pipeline.drain_timeouts"
 
 # streamed CW-catalog plane pipeline: tiles consumed by the device
@@ -145,6 +153,7 @@ METRICS = frozenset({
     MESH_DEVICES,
     SWEEP_CHUNKS_TOTAL, SWEEP_CHUNKS_DONE, SWEEP_REALIZATIONS,
     SWEEP_INFLIGHT_CHUNKS, SWEEP_LAST_DISPATCHED_CHUNK,
+    SWEEP_SHARDS_INFLIGHT,
     PIPELINE_DRAIN_TIMEOUTS,
     CW_STREAM_TILES_DONE, CW_STREAM_BYTES_STAGED,
     CW_STREAM_PREFETCH_STALL_S,

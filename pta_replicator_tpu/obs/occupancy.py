@@ -65,8 +65,15 @@ NESTED_STAGES: Dict[str, str] = {
 }
 
 #: span names that bound a whole pipelined phase — when present, the
-#: longest one defines the observation window for :func:`analyze`
-PHASE_SPANS = (names.SPAN_SWEEP_PIPELINE, names.SPAN_CW_STREAM_RESPONSE)
+#: longest one defines the observation window for :func:`analyze`.
+#: multichip_sweep encloses sweep_pipeline (it adds the sharded static
+#: precompute and consolidation), so a mesh sweep's attribution window
+#: covers the H2D staging stages too.
+PHASE_SPANS = (
+    names.SPAN_MULTICHIP_SWEEP,
+    names.SPAN_SWEEP_PIPELINE,
+    names.SPAN_CW_STREAM_RESPONSE,
+)
 
 #: duty above which a stage is called THE bottleneck, and below which
 #: (for every stage) the executor is called idle
@@ -292,23 +299,47 @@ class StageOccupancy:
             while dq and dq[0][0] < cutoff:
                 dq.popleft()
 
-    def snapshot(self) -> dict:
+    def snapshot(self, timeout: float = None) -> dict:
         """``{"stages": {name: duty}, "bottleneck": str|None}`` over the
         trailing window (clamped to the recorder's own lifetime, so the
-        first seconds of a run don't read as near-zero duty)."""
+        first seconds of a run don't read as near-zero duty).
+
+        ``timeout`` bounds the lock acquire for the signal-time
+        postmortem flush: the interrupted main-thread frame may be
+        suspended inside :meth:`observe`'s critical section (the
+        pipeline dispatcher records busy intervals on the calling
+        thread), so on acquire timeout we degrade to a best-effort
+        unlocked read — the parked holder makes it quiescent."""
         now = time.monotonic()
         horizon = max(1e-9, min(self.window_s, now - self._t0))
         cutoff = now - horizon
         duties: Dict[str, float] = {}
-        with self._lock:
-            for name, dq in self._done.items():
-                busy = 0.0
-                for end, dur in dq:
-                    if end < cutoff:
-                        continue
-                    busy += min(dur, end - cutoff)
+        acquired = self._lock.acquire(
+            timeout=-1 if timeout is None else timeout
+        )
+        try:
+            for name, dq in list(self._done.items()):
+                try:
+                    records = list(dq)
+                except RuntimeError:  # torn deque iteration (unlocked)
+                    continue
+                # union, not sum: concurrent same-stage spans (one per
+                # device from prefetch_to_mesh's stagers) overlap, and
+                # summing them would inflate duty up to N_devices x —
+                # same interval math as the post-hoc analyze() path
+                ivs = [
+                    (max(cutoff, end - dur), end)
+                    for end, dur in records
+                    if end >= cutoff
+                ]
+                busy = busy_seconds(ivs)
                 if busy > 0.0:
                     duties[name] = min(1.0, busy / horizon)
+        except RuntimeError:  # torn dict iteration (unlocked)
+            duties = {}
+        finally:
+            if acquired:
+                self._lock.release()
         return {
             "stages": {k: round(v, 3) for k, v in sorted(duties.items())},
             "bottleneck": verdict(duties),

@@ -61,6 +61,12 @@ _HIGHER_BETTER_TOKENS = (
     "value", "rate", "per_s", "speedup", "vs_baseline", "mfu",
     "tflops", "flops", "realizations", "efficiency", "reduction",
     "pct_of_roofline", "pct_of_peak",
+    # MULTICHIP series (benchmarks/multichip_scaling.py): the headline
+    # device-compute scaling efficiency per arm and the per-device
+    # throughput it derives from. "efficiency"/"per_s" already match
+    # these leaves — listed explicitly so the gate's contract for the
+    # series is spelled out, not an accident of substring overlap.
+    "scaling_efficiency", "per_device_real_per_s",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts")
@@ -73,9 +79,13 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts")
 #: null-control arm records it hovering at ~0 (SWEEP_OVERLAP_r07), where
 #: a relative-delta verdict amplifies pure noise into "regressed"; the
 #: directional score for the same property is overlap_efficiency
+#: attainable_speedup is a property of the HOST (how much parallel
+#: headroom the baseline left), not a score — "speedup" in its leaf
+#: must not read as higher-better; util_cores likewise describes the
+#: machine, not the code
 _NO_DIRECTION_FRAGMENTS = (
     "jax.cost.", "flops_per_chunk", "duty", "intensity", "ridge",
-    "wall_reduction_vs_serial",
+    "wall_reduction_vs_serial", "attainable_speedup", "util_cores",
 )
 
 

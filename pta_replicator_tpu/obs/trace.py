@@ -221,20 +221,44 @@ class Tracer:
         """The calling thread's open-span ancestry (for :meth:`inherit`)."""
         return tuple(self._stack())
 
-    def open_spans(self) -> Dict[int, list]:
+    def open_spans(self, timeout: float = None) -> Dict[int, list]:
         """Snapshot of every thread's currently-open span stack,
         ``{tid: [name, ...]}``, threads with nothing open omitted. Reads
         live per-thread lists, so a stack may be one push/pop stale —
-        fine for the heartbeat it feeds, never for accounting."""
+        fine for the heartbeat it feeds, never for accounting.
+
+        ``timeout`` bounds the lock acquire for the signal-time
+        postmortem flush: the interrupted main-thread frame may be
+        suspended *inside* ``_record``'s critical section (the sink
+        write happens under the lock), in which case the lock can never
+        be released while the flush is waited on. The holder being
+        parked also makes an unlocked read quiescent — every other
+        writer is blocked on the same lock — so on acquire timeout we
+        degrade to a best-effort copy instead of deadlocking."""
         alive = {t.ident for t in threading.enumerate()}
-        with self._lock:
-            for tid in [
-                t for t, s in self._thread_stacks.items()
-                if not s and t not in alive
-            ]:
-                del self._thread_stacks[tid]  # reap exited pool workers
-            items = list(self._thread_stacks.items())
-        return {tid: list(stack) for tid, stack in items if stack}
+        acquired = self._lock.acquire(
+            timeout=-1 if timeout is None else timeout
+        )
+        try:
+            if acquired:
+                for tid in [
+                    t for t, s in self._thread_stacks.items()
+                    if not s and t not in alive
+                ]:
+                    del self._thread_stacks[tid]  # reap exited workers
+                items = list(self._thread_stacks.items())
+            else:
+                try:  # unlocked emergency snapshot (no reaping)
+                    items = list(self._thread_stacks.items())
+                except RuntimeError:  # torn dict iteration
+                    items = []
+        finally:
+            if acquired:
+                self._lock.release()
+        try:
+            return {tid: list(stack) for tid, stack in items if stack}
+        except RuntimeError:
+            return {}
 
     def add_listener(self, fn) -> None:
         """Subscribe ``fn(record)`` to every completed span/event. The
@@ -358,10 +382,21 @@ class Tracer:
             "displayTimeUnit": "ms",
         }
 
-    def flush(self) -> None:
-        with self._lock:
+    def flush(self, timeout: float = None) -> None:
+        """Flush the JSONL sink. ``timeout`` bounds the lock acquire for
+        the signal-time postmortem path (see :meth:`open_spans`); on
+        timeout the flush is skipped — the sink is line-buffered enough
+        in practice that the black box loses at most the final lines."""
+        acquired = self._lock.acquire(
+            timeout=-1 if timeout is None else timeout
+        )
+        if not acquired:
+            return
+        try:
             if self._sink is not None:
                 self._sink.flush()
+        finally:
+            self._lock.release()
 
     def reset(self) -> None:
         """Drop buffered events and aggregates (sink file is kept open)."""

@@ -88,6 +88,10 @@ def local_realizations(global_array) -> np.ndarray:
     # pulsar-axis shards of the same realization slice concatenate along
     # axis 1, realization groups along axis 0
     unique = {starts(s): s for s in global_array.addressable_shards}
+    # issue every local D2H copy before awaiting the first, the same
+    # overlapped-drain shape as parallel.mesh.fetch_shard_blocks
+    for s in unique.values():
+        s.data.copy_to_host_async()
     rows = {}
     for key, s in sorted(unique.items()):
         rows.setdefault(key[0], []).append(np.asarray(s.data))

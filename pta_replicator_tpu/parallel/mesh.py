@@ -32,7 +32,9 @@ from ..models.batched import (
     donate_keys_argnums,
     realize_block as _realize_block,
 )
-from ..obs import gauge, instrumented_jit, record_transfer, span, tree_nbytes
+from ..obs import gauge, instrumented_jit, names, record_transfer, span, \
+    tree_nbytes
+from ..utils.sweep import ShardedBlock
 
 
 def make_mesh(
@@ -68,16 +70,100 @@ def shard_batch(batch: PulsarBatch, mesh: Mesh) -> PulsarBatch:
     def place(x):
         if hasattr(x, "ndim") and x.ndim >= 1:
             sharding = NamedSharding(mesh, P("psr", *([None] * (x.ndim - 1))))
-            # transfer accounting: only leaves that actually move — a
-            # chunked sweep re-shards the same (already placed) batch
-            # every chunk, where device_put is a no-op
-            if getattr(x, "sharding", None) != sharding:
-                record_transfer(int(x.nbytes), "h2d")
+            # fast path: a chunked sweep re-shards the same batch every
+            # chunk — an already-placed leaf is returned AS-IS (no
+            # device_put dispatch at all; at 8 devices the per-leaf
+            # no-op puts added up to a measurable per-chunk host tax)
+            # and no transfer is recorded, since no bytes move
+            if getattr(x, "sharding", None) == sharding:
+                return x
+            record_transfer(int(x.nbytes), "h2d")
             return jax.device_put(x, sharding)
         return x
 
     with span("shard_batch", npsr=batch.npsr):
         return jax.tree_util.tree_map(place, batch)
+
+
+def put_sharded(x, mesh: Mesh, spec):
+    """``device_put(x, NamedSharding(mesh, spec))`` built from explicit
+    per-device puts + ``jax.make_array_from_single_device_arrays``.
+
+    The ONE per-device placement primitive: it works on multi-host
+    meshes, where a plain ``device_put`` of a host array raises (each
+    process contributes exactly its addressable shards), and it is the
+    same assembly the per-device prefetcher (parallel.prefetch.
+    prefetch_to_mesh) fans out over its staging threads — so a single
+    eager placement and a pipelined one can never disagree about
+    layout. Transfer accounting mirrors :func:`shard_batch`: only bytes
+    that actually move are recorded.
+    """
+    sharding = NamedSharding(mesh, spec)
+    current = getattr(x, "sharding", None)
+    if current is not None:
+        try:
+            if current.is_equivalent_to(sharding, np.ndim(x)):
+                return x  # already placed (a re-sharding no-op)
+        except Exception:
+            pass  # differently-typed sharding: fall through and place
+    if isinstance(x, jax.Array) and sharding.is_fully_addressable:
+        # already on device and every target shard is ours: let XLA
+        # reshard asynchronously on-device instead of fencing compute
+        # with np.asarray + re-uploading the whole plane (no host bytes
+        # move, so no transfer is recorded)
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    idx_map = sharding.addressable_devices_indices_map(arr.shape)
+    pieces = [jax.device_put(arr[idx], d) for d, idx in idx_map.items()]
+    record_transfer(sum(int(p.nbytes) for p in pieces), "h2d")
+    return jax.make_array_from_single_device_arrays(
+        arr.shape, sharding, pieces
+    )
+
+
+def _shard_index_key(index, shape) -> tuple:
+    """A jax shard's ``index`` (tuple of slices) as concrete
+    ``((start, stop), ...)`` windows — the mesh-independent form the
+    sharded checkpoint manifest records (utils.sweep.ShardedBlock)."""
+    return tuple(
+        (sl.start or 0, sl.stop if sl.stop is not None else dim)
+        for sl, dim in zip(index, shape)
+    )
+
+
+def fetch_shard_blocks(global_array):
+    """Per-shard host readback of a committed sharded array.
+
+    Issues ``copy_to_host_async`` for every (deduplicated) addressable
+    shard BEFORE awaiting the first, so the D2H copies of all chips
+    drain concurrently instead of serializing behind one global
+    ``np.asarray`` — this is the mesh sweep's ``fetch`` stage
+    (utils.sweep passes it to the pipelined executor's reader thread).
+    Returns a :class:`~pta_replicator_tpu.utils.sweep.ShardedBlock`
+    whose ``assemble()`` is bit-identical to ``np.asarray(global_array)``
+    (each shard IS that array's slice at its index); single-shard or
+    plain-host values fall through to ``np.asarray`` unchanged. The
+    ``sweep.shards_inflight`` gauge counts copies still draining.
+    """
+    shards = getattr(global_array, "addressable_shards", None)
+    if shards is None or len(shards) <= 1:
+        return np.asarray(global_array)
+    shape = tuple(global_array.shape)
+    # replicated shards (e.g. a mesh axis the result does not use) are
+    # identical copies: fetch one per distinct index window
+    unique = {}
+    for s in shards:
+        unique.setdefault(_shard_index_key(s.index, shape), s)
+    gauge(names.SWEEP_SHARDS_INFLIGHT).set(len(unique))
+    for s in unique.values():
+        s.data.copy_to_host_async()
+    blocks = []
+    inflight = len(unique)
+    for index in sorted(unique):
+        blocks.append((index, np.asarray(unique[index].data)))
+        inflight -= 1
+        gauge(names.SWEEP_SHARDS_INFLIGHT).set(inflight)
+    return ShardedBlock(shape, np.dtype(global_array.dtype), blocks)
 
 
 def sharded_realize(
@@ -143,10 +229,12 @@ def static_delays(batch: PulsarBatch, recipe: Recipe, mesh: Optional[Mesh] = Non
     This runs once per sweep, so eager dispatch costs nothing.
     """
     with span("static_delays", npsr=batch.npsr):
-        out = deterministic_delays(batch, recipe)
+        out = deterministic_delays(batch, recipe, mesh=mesh)
         if mesh is not None:
-            out = jax.device_put(out, NamedSharding(mesh, P("psr", None)))
-            record_transfer(tree_nbytes(out), "h2d")
+            # explicit per-device placement (put_sharded): works on
+            # multi-host meshes too, and is a no-op when the streamed
+            # CW path already built the planes mesh-sharded
+            out = put_sharded(out, mesh, P("psr", None))
         return out
 
 

@@ -107,8 +107,13 @@ def run_pipelined(
 
     ``dispatch(i)`` must return an un-fetched device value (a jitted
     engine's output); ``fetch`` pulls it to host (``np.asarray`` fences
-    queued device work, including collectives); ``write(i, block)`` runs
-    on the single writer thread, strictly in ``indices`` order.
+    queued device work, including collectives — a mesh sweep passes
+    ``parallel.mesh.fetch_shard_blocks`` instead, whose per-shard D2H
+    copies overlap across chips); ``write(i, block)`` runs on the
+    single writer thread, strictly in ``indices`` order. ``block`` is
+    whatever ``fetch`` returned — the executor itself only reads its
+    ``nbytes`` (an ndarray or a ``utils.sweep.ShardedBlock`` both
+    qualify).
 
     Returns a stats dict (``chunks``, ``wall_s``, ``max_inflight``,
     ``drain_wait_s`` — time the dispatcher spent blocked on the full

@@ -183,6 +183,230 @@ def prefetch_to_device(
         raise errors[0]
 
 
+def prefetch_to_mesh(
+    tiles,
+    mesh,
+    *,
+    specs,
+    depth: int = 2,
+    stall_timeout_s: Optional[float] = 900.0,
+) -> Iterator:
+    """Per-device double-buffered staging of a host tile stream onto a
+    device mesh: ONE host producer thread runs the tile generator (the
+    f64 host math overlaps device compute, as in
+    :func:`prefetch_to_device`), and one staging queue + thread PER
+    DEVICE issues that device's own ``jax.device_put`` — so the H2D
+    copies of different chips drain concurrently instead of
+    serializing behind a single global put. The consumer receives
+    committed global arrays assembled from the per-device pieces
+    (``jax.make_array_from_single_device_arrays``), value-equal to
+    ``jax.device_put(tile, NamedSharding(mesh, spec))`` of the whole
+    tile, strictly in input order.
+
+    ``tiles`` yields pytrees (e.g. ``(src, psr)`` tuples) of host
+    arrays; ``specs`` is a matching pytree of ``PartitionSpec`` leaves
+    (``P()`` replicates a leaf to every device; a sharded axis gives
+    each device only its slice, cutting the per-chip H2D bytes by the
+    axis size). The in-flight window is bounded at ``depth`` tiles
+    past the generator, exactly the :func:`prefetch_to_device`
+    contract, so host memory stays ``depth x tile_nbytes`` no matter
+    how slow the consumer is.
+
+    Failure semantics mirror the single-device prefetcher and the
+    sweep executor: a tile-build or staging exception re-raises on the
+    consumer's thread UNCHANGED after every earlier tile has been
+    yielded (in order); any stage wedged past ``stall_timeout_s``
+    raises the same :class:`~pta_replicator_tpu.parallel.pipeline.
+    DrainTimeout` a wedged sweep readback does (all workers are
+    daemons — process exit is never held hostage).
+
+    Telemetry: a ``cw_stream_stage`` span per (tile, device) on the
+    staging threads, per-device ``cw_stream.bytes_staged{device=}``
+    counters, and per-device ``occupancy.busy_s`` gauges.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1 (got {depth})")
+    spec_leaves, _ = jax.tree_util.tree_flatten(
+        specs,
+        is_leaf=lambda x: x is None or isinstance(x, PartitionSpec),
+    )
+    shardings = [
+        NamedSharding(mesh, s if s is not None else PartitionSpec())
+        for s in spec_leaves
+    ]
+    devs = [
+        d for d in mesh.devices.flat
+        if d.process_index == jax.process_index()
+    ]
+    if not devs:
+        raise ValueError("mesh has no addressable devices in this process")
+
+    window = threading.Semaphore(depth)
+    in_qs = {d: queue.Queue() for d in devs}
+    out_qs = {d: queue.Queue() for d in devs}
+    stop = threading.Event()
+    errors: list = []  # first entry wins (workers append under the GIL)
+    produce_started = [None]  # single-writer heartbeats (owner writes)
+    stage_started = {d: [None] for d in devs}
+    busy = {d: [0.0] for d in devs}
+    treedef_box = [None]
+    stack = TRACER.current_stack()  # nest worker spans under the caller's
+
+    def _producer() -> None:
+        with TRACER.inherit(stack):
+            it = iter(tiles)
+            while not stop.is_set():
+                while not window.acquire(timeout=0.1):
+                    if stop.is_set():
+                        break
+                if stop.is_set():
+                    break
+                try:
+                    produce_started[0] = time.monotonic()
+                    try:
+                        tile = next(it)
+                    except StopIteration:
+                        produce_started[0] = None
+                        break
+                    leaves, treedef = jax.tree_util.tree_flatten(tile)
+                    leaves = [np.asarray(x) for x in leaves]
+                    if len(leaves) != len(shardings):
+                        raise ValueError(
+                            f"tile has {len(leaves)} leaves but specs "
+                            f"has {len(shardings)}"
+                        )
+                    treedef_box[0] = treedef
+                    produce_started[0] = None
+                except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
+                    produce_started[0] = None
+                    errors.append(exc)
+                    stop.set()
+                    break
+                delivered = True
+                for d in devs:
+                    if not _stop_aware_put(in_qs[d], leaves, stop):
+                        delivered = False
+                        break
+                if not delivered:
+                    break
+            for d in devs:
+                try:
+                    in_qs[d].put_nowait(_STOP)
+                except queue.Full:  # pragma: no cover — in_qs unbounded
+                    pass
+
+    def _stager(d) -> None:
+        with TRACER.inherit(stack):
+            beat = stage_started[d]
+            label = str(getattr(d, "id", d))
+            k = 0
+            while True:
+                item = in_qs[d].get()
+                # break on the sentinel ONLY (not on a bare stop): a
+                # producer error must not make one device abandon tiles
+                # its peers already staged — earlier tiles are yielded
+                # in order before the error re-raises, and the residual
+                # work is bounded by the window (<= depth tiles)
+                if item is _STOP:
+                    break
+                leaves = item
+                try:
+                    beat[0] = time.monotonic()
+                    with span(names.SPAN_CW_STREAM_STAGE, tile=k,
+                              device=label) as sp:
+                        pieces = []
+                        nbytes = 0
+                        for leaf, sharding in zip(leaves, shardings):
+                            idx = sharding.addressable_devices_indices_map(
+                                leaf.shape
+                            )[d]
+                            piece = jax.device_put(leaf[idx], d)
+                            nbytes += int(piece.nbytes)
+                            pieces.append((leaf.shape, piece))
+                        sp["nbytes"] = nbytes
+                    busy[d][0] += time.monotonic() - beat[0]
+                    beat[0] = None
+                    counter(names.CW_STREAM_BYTES_STAGED,
+                            device=label).inc(nbytes)
+                    gauge(names.OCCUPANCY_BUSY_S,
+                          stage=names.SPAN_CW_STREAM_STAGE,
+                          device=label).set(round(busy[d][0], 6))
+                except BaseException as exc:  # noqa: BLE001
+                    beat[0] = None
+                    errors.append(exc)
+                    stop.set()
+                    break
+                out_qs[d].put((k, pieces))  # unbounded: never blocks
+                k += 1
+            try:
+                out_qs[d].put_nowait(_STOP)
+            except queue.Full:  # pragma: no cover — out_qs unbounded
+                pass
+
+    workers = [
+        threading.Thread(target=_producer, name="mesh-prefetch-producer",
+                         daemon=True)
+    ] + [
+        threading.Thread(target=_stager, args=(d,),
+                         name=f"mesh-prefetch-stage-{i}", daemon=True)
+        for i, d in enumerate(devs)
+    ]
+    for w in workers:
+        w.start()
+
+    def _beats():
+        return [produce_started] + [stage_started[d] for d in devs]
+
+    try:
+        k = 0
+        while True:
+            gathered = []
+            eos = False
+            for d in devs:
+                while True:
+                    try:
+                        item = out_qs[d].get(timeout=0.1)
+                        break
+                    except queue.Empty:
+                        if any(_stage_overdue(b, stall_timeout_s)
+                               for b in _beats()):
+                            raise DrainTimeout(
+                                "per-device tile staging exceeded "
+                                f"{stall_timeout_s:.0f}s — backend wedged"
+                            )
+                if item is _STOP:
+                    eos = True
+                    break
+                kk, pieces = item
+                if kk != k:  # pragma: no cover — FIFO per device
+                    raise RuntimeError(
+                        f"device {d} staged tile {kk}, expected {k}"
+                    )
+                gathered.append(pieces)
+            if eos:
+                break
+            leaves_out = []
+            for j, sharding in enumerate(shardings):
+                shape = gathered[0][j][0]
+                leaves_out.append(
+                    jax.make_array_from_single_device_arrays(
+                        shape, sharding, [g[j][1] for g in gathered]
+                    )
+                )
+            yield jax.tree_util.tree_unflatten(treedef_box[0], leaves_out)
+            window.release()
+            k += 1
+    finally:
+        stop.set()
+        for w in workers:
+            w.join(timeout=5.0)
+    if errors:
+        raise errors[0]
+
+
 # ------------------------------------------------------------ tile cache
 
 #: archive member carrying the cache metadata (also the completeness

@@ -652,7 +652,7 @@ def simulate_pulsar(
     Reference analog: simulate.py:98-135 (obstimes in MJD, toaerr in us).
     """
     if not os.path.isfile(parfile):
-        raise FileNotFoundError("par file does not exist.")
+        raise FileNotFoundError(f"par file does not exist: {parfile}")
     par = read_par(parfile)
     model = TimingModel.from_par(par)
     toas = fabricate_toas(obstimes, toaerr, freq_mhz=freq, observatory=observatory, flags=flags)
@@ -666,9 +666,9 @@ def simulate_pulsar(
 def load_pulsar(parfile: str, timfile: str, ephem: str = "DE440") -> SimulatedPulsar:
     """Load a SimulatedPulsar from par and tim files (reference simulate.py:138-167)."""
     if not os.path.isfile(parfile):
-        raise FileNotFoundError("par file does not exist.")
+        raise FileNotFoundError(f"par file does not exist: {parfile}")
     if not os.path.isfile(timfile):
-        raise FileNotFoundError("tim file does not exist.")
+        raise FileNotFoundError(f"tim file does not exist: {timfile}")
     par = read_par(parfile)
     model = TimingModel.from_par(par)
     toas = read_tim(timfile)
@@ -698,9 +698,9 @@ def load_from_directories(
     min(8, n_pulsars); 1 = serial). Order is deterministic either way.
     """
     if not os.path.isdir(pardir):
-        raise FileNotFoundError("par directory does not exist.")
+        raise FileNotFoundError(f"par directory does not exist: {pardir}")
     if not os.path.isdir(timdir):
-        raise FileNotFoundError("tim directory does not exist.")
+        raise FileNotFoundError(f"tim directory does not exist: {timdir}")
     pars = [p for p in sorted(glob.glob(os.path.join(pardir, "*.par"))) if ".t2" not in p]
     tims = sorted(glob.glob(os.path.join(timdir, "*.tim")))
     pairs = list(zip(pars, tims))

@@ -1,0 +1,55 @@
+"""Self-describing provenance stamp for evidence JSON artifacts.
+
+Every benchmark/validation artifact the repo commits (``BENCH_r*.json``,
+``MULTICHIP_r*.json``, validate_device output, multichip_scaling output)
+carries the same three provenance fields so the ``bench-diff``
+regression gate (obs/regress.py) can align, annotate, or refuse
+cross-round comparisons: ``schema_version`` (bump when a metric keeps
+its spelling but changes meaning/units — readers refuse files stamped
+newer than they know), ``git_rev``, and a ``platform`` block. bench.py
+introduced the convention (PR 3); this module is its single shared
+implementation, so the MULTICHIP/validation series cannot drift to a
+different stamping shape than the BENCH series.
+
+stdlib-only and jax-free: callers stamp before (or regardless of
+whether) a backend ever comes up — failure JSONs carry provenance too.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+
+#: schema version of the non-bench evidence series (MULTICHIP_r*.json,
+#: validate_device, multichip_scaling). Matches bench.py's
+#: BENCH_SCHEMA_VERSION=2 convention: v2 = the first stamped version.
+EVIDENCE_SCHEMA_VERSION = 2
+
+
+def provenance_stamp(schema_version: int, repo_root: str = None) -> dict:
+    """``{"schema_version": ..., "platform": {...}, "git_rev": ...}`` —
+    the stamp every evidence JSON embeds (success AND failure paths).
+    ``git_rev`` is best-effort: its absence must never fail a bench."""
+    import platform as _plat
+
+    stamp = {
+        "schema_version": int(schema_version),
+        "platform": {
+            "python": _plat.python_version(),
+            "os": _plat.platform(),
+            "machine": _plat.machine(),
+        },
+    }
+    if repo_root is None:
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, timeout=10,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            stamp["git_rev"] = r.stdout.strip()
+    except Exception:
+        pass  # provenance is best-effort, never a bench failure
+    return stamp

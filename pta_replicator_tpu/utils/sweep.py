@@ -121,6 +121,13 @@ def _chunk_path(checkpoint_path: str, i: int) -> str:
     return f"{checkpoint_path}.chunk{i:06d}.npy"
 
 
+def _shard_chunk_path(checkpoint_path: str, i: int) -> str:
+    """A mesh sweep's per-chunk SHARDED archive (npz of per-shard
+    members + manifest) — same index space as :func:`_chunk_path`, so a
+    resume can mix chunk kinds across mesh-shape changes."""
+    return f"{checkpoint_path}.chunk{i:06d}.npz"
+
+
 def _partial_path(checkpoint_path: str) -> str:
     """The pipelined path's in-progress consolidated archive (renamed to
     ``checkpoint_path`` on completion; see _IncrementalNpz)."""
@@ -162,10 +169,12 @@ def _write_npy(path: str, arr: np.ndarray, buf=None) -> None:
 
 def _cleanup_chunks(checkpoint_path: str, nchunks: int) -> None:
     for i in range(nchunks):
-        try:
-            os.remove(_chunk_path(checkpoint_path, i))
-        except FileNotFoundError:
-            pass
+        for path in (_chunk_path(checkpoint_path, i),
+                     _shard_chunk_path(checkpoint_path, i)):
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
     # reap a partial consolidated archive orphaned by a killed
     # pipelined sweep (the rename into place never happened)
     try:
@@ -286,6 +295,114 @@ class _IncrementalNpz:
             os.remove(self._tmp)
 
 
+# ------------------------------------------------- sharded chunk blocks
+
+#: archive member carrying the shard layout (written LAST — the
+#: completeness marker, same contract as the plane-tile cache's meta
+#: member: a torn archive has no manifest and the loader refuses it)
+_SHARD_MANIFEST_MEMBER = "manifest"
+
+
+class ShardedBlock:
+    """One sweep chunk as per-device-shard host pieces (the mesh sweep's
+    readback unit, parallel.mesh.fetch_shard_blocks).
+
+    ``shards`` is ``[(index, array), ...]`` where ``index`` is a tuple of
+    ``(start, stop)`` per dimension of the global ``shape`` — the
+    concrete form of the jax shard's index, independent of any Mesh
+    object, so a checkpoint written at one mesh shape reassembles under
+    any other (or none). Plain numpy + stdlib: the writer thread and the
+    resume loader never need jax.
+    """
+
+    __slots__ = ("shape", "dtype", "shards")
+
+    def __init__(self, shape, dtype, shards):
+        self.shape = tuple(int(n) for n in shape)
+        self.dtype = np.dtype(dtype)
+        self.shards = list(shards)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(arr.nbytes for _, arr in self.shards)
+
+    def assemble(self) -> np.ndarray:
+        """The full block, bit-identical to ``np.asarray`` of the global
+        device array the shards were fetched from (each shard IS that
+        array's slice at its index). Refuses a partial cover — a
+        multi-host checkpoint only holds the local shards, and silently
+        returning uninitialized rows would corrupt a resume."""
+        volume = sum(arr.size for _, arr in self.shards)
+        expected = int(np.prod(self.shape)) if self.shape else 1
+        if volume != expected:
+            raise ValueError(
+                f"sharded block covers {volume} of {expected} elements "
+                "— partial (multi-host?) shard set cannot be assembled"
+            )
+        out = np.empty(self.shape, self.dtype)
+        for index, arr in self.shards:
+            out[tuple(slice(a, b) for a, b in index)] = arr
+        return out
+
+
+def write_shard_archive(path: str, block: ShardedBlock) -> None:
+    """Serialize ``block`` as an ``np.load``-compatible archive: one
+    ``shard{k}.npy`` member per shard (exact ``np.save`` bytes, the same
+    serialization layer as every other checkpoint artifact) plus a JSON
+    ``manifest`` member — written last — recording shape/dtype and each
+    member's global index window, so :func:`load_shard_archive` can
+    reassemble under ANY mesh shape (or none). Callers wrap this in
+    :func:`atomic_write` for the rename + durability sequence."""
+    manifest = {
+        "shape": list(block.shape),
+        "dtype": block.dtype.str,
+        "shards": [],
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED,
+                         allowZip64=True) as zf:
+        for k, (index, arr) in enumerate(block.shards):
+            member = f"shard{k:06d}"
+            with zf.open(member + ".npy", "w", force_zip64=True) as fh:
+                fh.write(_npy_bytes(np.asarray(arr)))
+            manifest["shards"].append(
+                {"member": member, "index": [[int(a), int(b)]
+                                             for a, b in index]}
+            )
+        with zf.open(_SHARD_MANIFEST_MEMBER + ".npy", "w") as fh:
+            fh.write(_npy_bytes(np.array(json.dumps(manifest))))
+
+
+def load_shard_archive(path: str) -> np.ndarray:
+    """Reassemble a :func:`write_shard_archive` chunk into the full
+    block, mesh-shape-independent (the manifest carries every shard's
+    global index window). Refuses a manifest-less (torn) archive and a
+    partial shard cover."""
+    with np.load(path) as z:
+        if _SHARD_MANIFEST_MEMBER not in z.files:
+            raise ValueError(
+                f"{path}: no '{_SHARD_MANIFEST_MEMBER}' member — "
+                "truncated or not a sharded chunk archive"
+            )
+        manifest = json.loads(str(z[_SHARD_MANIFEST_MEMBER]))
+        block = ShardedBlock(
+            manifest["shape"], manifest["dtype"],
+            [
+                (tuple((a, b) for a, b in rec["index"]), z[rec["member"]])
+                for rec in manifest["shards"]
+            ],
+        )
+    return block.assemble()
+
+
+def _load_chunk(checkpoint_path: str, i: int) -> np.ndarray:
+    """A completed chunk from disk, whatever topology wrote it: the
+    single-chip ``.npy`` or the mesh sweep's sharded ``.npz``."""
+    path = _chunk_path(checkpoint_path, i)
+    if os.path.exists(path):
+        return np.load(path)
+    return load_shard_archive(_shard_chunk_path(checkpoint_path, i))
+
+
 def sweep(
     key,
     batch,
@@ -300,6 +417,7 @@ def sweep(
     pipeline_depth: int = 2,
     drain_timeout_s: Optional[float] = 900.0,
     durable: bool = False,
+    shard_checkpoint: Optional[bool] = None,
 ) -> np.ndarray:
     """Run ``nreal`` realizations in resumable chunks.
 
@@ -322,7 +440,61 @@ def sweep(
     completed chunks survive power loss, not just process death — at
     depth >= 2 the extra disk wait rides the I/O thread, overlapped with
     device compute (benchmarks/sweep_overlap.py measures exactly this).
+
+    On a multi-device ``mesh`` the sweep runs the full multi-chip path
+    (docs/performance.md "Sharding the sweep"): chunks dispatch as
+    sharded computations, the reader drains them shard by shard with
+    the per-device D2H copies overlapped (parallel.mesh.
+    fetch_shard_blocks), and — with ``shard_checkpoint`` (default on) —
+    the writer persists each chunk as a sharded archive (one npy member
+    per device shard + a manifest member, utils.sweep.
+    write_shard_archive) instead of one monolithic ``.npy``. The
+    manifest records every shard's global index window, so a resume
+    reassembles completed chunks under ANY topology (mesh-shape change,
+    or none at all), and the consolidated checkpoint plus the returned
+    array stay bit-identical to the single-chip pipelined path.
+    ``shard_checkpoint=False`` keeps the single-chip chunk-file format
+    (the writer assembles shards first). The whole mesh sweep runs
+    under a ``multichip_sweep`` phase span — the occupancy window for
+    multi-chip bottleneck attribution (obs.occupancy).
     """
+    import contextlib
+
+    phase = contextlib.nullcontext()
+    if mesh is not None and int(mesh.devices.size) > 1:
+        from ..obs import names, span
+
+        phase = span(
+            names.SPAN_MULTICHIP_SWEEP,
+            mesh=f"{mesh.shape.get('real', 1)}x{mesh.shape.get('psr', 1)}",
+            devices=int(mesh.devices.size),
+        )
+    with phase:
+        return _sweep_impl(
+            key, batch, recipe, nreal, checkpoint_path, chunk=chunk,
+            reduce_fn=reduce_fn, fit=fit, mesh=mesh, progress=progress,
+            pipeline_depth=pipeline_depth,
+            drain_timeout_s=drain_timeout_s, durable=durable,
+            shard_checkpoint=shard_checkpoint,
+        )
+
+
+def _sweep_impl(
+    key,
+    batch,
+    recipe,
+    nreal: int,
+    checkpoint_path: str,
+    chunk: int,
+    reduce_fn: Optional[Callable],
+    fit: bool,
+    mesh,
+    progress: Optional[Callable[[int, int], None]],
+    pipeline_depth: int,
+    drain_timeout_s: Optional[float],
+    durable: bool,
+    shard_checkpoint: Optional[bool],
+) -> np.ndarray:
     import jax
 
     from ..models.batched import realize
@@ -331,6 +503,15 @@ def sweep(
     if nreal % chunk:
         raise ValueError(f"nreal={nreal} must be a multiple of chunk={chunk}")
     nchunks = nreal // chunk
+
+    n_mesh_devices = int(mesh.devices.size) if mesh is not None else 1
+    if shard_checkpoint is None:
+        shard_checkpoint = n_mesh_devices > 1
+    if shard_checkpoint and n_mesh_devices <= 1:
+        raise ValueError(
+            "shard_checkpoint=True needs a multi-device mesh — a "
+            "single-device sweep has exactly one shard per chunk"
+        )
 
     from ..models.batched import STREAM_VERSION
 
@@ -371,7 +552,9 @@ def sweep(
                 [z[f"chunk{i}"] for i in range(nchunks)], axis=0
             )
 
-    blocks = [np.load(_chunk_path(checkpoint_path, i)) for i in range(done)]
+    # completed chunks reload under ANY topology: _load_chunk reads the
+    # single-chip .npy or reassembles a sharded archive via its manifest
+    blocks = [_load_chunk(checkpoint_path, i) for i in range(done)]
 
     # the deterministic (CW-catalog/burst/memory) delays depend only on
     # (batch, recipe): compute once for the whole sweep, not per chunk
@@ -404,17 +587,34 @@ def sweep(
                           static=static)
         return reduce_fn(res, batch) if reduce_fn is not None else res
 
-    def write_chunk(i: int, block: np.ndarray, buf=None) -> None:
+    if n_mesh_devices > 1:
+        # per-shard readback: every device's D2H copy is issued before
+        # the first one is awaited, so the drain overlaps across chips
+        from ..parallel.mesh import fetch_shard_blocks as fetch_fn
+    else:
+        fetch_fn = np.asarray
+
+    def write_chunk(i: int, block, buf=None) -> None:
         """Persist chunk ``i``: chunk file first, sidecar last — a crash
         between the two only recomputes this chunk on resume. Runs on
         the caller's thread at depth 1, on the single-writer I/O thread
-        otherwise (in chunk order either way)."""
-        _atomic_write(
-            lambda p: _write_npy(p, block, buf=buf),
-            _chunk_path(checkpoint_path, i),
-            ".npy",
-            durable=durable,
-        )
+        otherwise (in chunk order either way). A :class:`ShardedBlock`
+        lands as the per-shard archive (mesh sweep, sharded
+        checkpoints); an ndarray as the single-chip ``.npy``."""
+        if isinstance(block, ShardedBlock):
+            _atomic_write(
+                lambda p: write_shard_archive(p, block),
+                _shard_chunk_path(checkpoint_path, i),
+                ".npz",
+                durable=durable,
+            )
+        else:
+            _atomic_write(
+                lambda p: _write_npy(p, block, buf=buf),
+                _chunk_path(checkpoint_path, i),
+                ".npy",
+                durable=durable,
+            )
         payload = json.dumps({**meta, "done": i + 1})
 
         def write_meta(p, payload=payload):
@@ -436,15 +636,17 @@ def sweep(
                 # the host readback is the device-sync fence: this span
                 # is where queued device work (incl. collectives) drains
                 with span(names.SPAN_READBACK_FENCE):
-                    block = np.asarray(out)
+                    block = fetch_fn(out)
+            host = (block.assemble() if isinstance(block, ShardedBlock)
+                    else block)
             # same stage span the pipelined writer thread emits, so the
             # occupancy report attributes the synchronous loop's disk
             # time too (without it an fsync-bound depth-1 run reads as
             # compute-bound)
             with span(names.SPAN_IO_WRITE, chunk=i,
                       nbytes=int(block.nbytes)):
-                write_chunk(i, block)
-            blocks.append(block)
+                write_chunk(i, block if shard_checkpoint else host)
+            blocks.append(host)
     elif done < nchunks:
         from ..parallel.pipeline import run_pipelined
 
@@ -484,15 +686,25 @@ def sweep(
         # the single writer runs callbacks in chunk order.
         catchup_done = [False]
 
-        def write_and_consolidate(i: int, block: np.ndarray) -> None:
+        def write_and_consolidate(i: int, block) -> None:
             if not catchup_done[0]:
                 catchup_done[0] = True
                 for j, b in enumerate(preloaded):
                     inc.append(j, b)
-            buf = _npy_bytes(block)  # one serialize feeds both sinks
-            write_chunk(i, block, buf=buf)
-            inc.append(i, block, buf=buf)
-            place(i, block)
+            # a mesh chunk arrives as per-shard pieces: the sharded
+            # archive gets the pieces verbatim, while the consolidated
+            # npz and the result always take the ASSEMBLED block — that
+            # is what keeps the final artifact byte-identical across
+            # every topology
+            host = (block.assemble() if isinstance(block, ShardedBlock)
+                    else block)
+            buf = _npy_bytes(host)  # one serialize feeds both sinks
+            if isinstance(block, ShardedBlock) and shard_checkpoint:
+                write_chunk(i, block)
+            else:
+                write_chunk(i, host, buf=buf)
+            inc.append(i, host, buf=buf)
+            place(i, host)
 
         try:
             with span(names.SPAN_SWEEP_PIPELINE, depth=pipeline_depth,
@@ -502,6 +714,7 @@ def sweep(
                     dispatch_chunk,
                     write_and_consolidate,
                     depth=pipeline_depth,
+                    fetch=fetch_fn,
                     drain_timeout_s=drain_timeout_s,
                 )
                 sp.update(stats)

@@ -265,6 +265,93 @@ def test_postmortem_on_injected_sigterm(tmp_path):
     assert "sweep_chunk" in (tmp_path / "events.jsonl").read_text()
 
 
+def test_emergency_postmortem_with_tracer_lock_held(tmp_path):
+    """The deadlock the SIGTERM flush must survive: the signal lands
+    while the interrupted frame is inside ``Tracer._record``'s critical
+    section (the sink write runs under the tracer lock), so the lock is
+    held by a thread that cannot run until the handler returns. The
+    emergency flush must still land the complete black box — bounded
+    acquires, unlocked fallback — instead of timing out postmortem-less
+    after the handler deadline."""
+    from pta_replicator_tpu.obs.trace import TRACER
+
+    obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)
+    try:
+        with obs.span("realize"):
+            with obs.span("compute"):
+                rec = obs.flightrec.active()
+                assert TRACER._lock.acquire(timeout=5)
+                try:
+                    t0 = time.monotonic()
+                    path = rec.write_postmortem("SIGTERM", emergency=True)
+                    took = time.monotonic() - t0
+                finally:
+                    TRACER._lock.release()
+        pm = json.loads(open(path).read())
+        assert pm["reason"] == "SIGTERM"
+        stacks = list(pm["heartbeat"]["open_spans"].values())
+        assert ["realize", "compute"] in [s[:2] for s in stacks]
+        # bounded: two 1s lock timeouts at most, nowhere near the 5s
+        # handler deadline that previously expired postmortem-less
+        assert took < 4.0
+    finally:
+        obs.finish_capture()
+
+
+def test_emergency_postmortem_with_registry_lock_held(tmp_path):
+    """Sibling of the tracer-lock deadlock: the signal may equally land
+    while the interrupted frame is inside ``MetricsRegistry._get``'s
+    critical section (sweep-loop gauge lookups run every chunk), so the
+    registry lock — hit by ``_metric_value``, the occupancy gauge
+    mirror, and ``REGISTRY.to_json`` — can never be released either.
+    The emergency flush must bound those acquires too."""
+    from pta_replicator_tpu.obs.metrics import REGISTRY
+
+    obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)
+    try:
+        obs.gauge("sweep.chunks_done").set(3.0)
+        with obs.span("realize"):
+            rec = obs.flightrec.active()
+            assert REGISTRY._lock.acquire(timeout=5)
+            try:
+                t0 = time.monotonic()
+                path = rec.write_postmortem("SIGTERM", emergency=True)
+                took = time.monotonic() - t0
+            finally:
+                REGISTRY._lock.release()
+        pm = json.loads(open(path).read())
+        assert pm["reason"] == "SIGTERM"
+        # the unlocked fallback still reads the live metric values
+        assert pm["heartbeat"]["sweep"]["chunks_done"] == 3.0
+        assert pm["metrics"]["sweep.chunks_done"][0]["value"] == 3.0
+        assert took < 4.0
+    finally:
+        obs.finish_capture()
+
+
+def test_emergency_postmortem_with_occupancy_lock_held(tmp_path):
+    """Third lock in the emergency hazard set: the pipeline dispatcher
+    records busy intervals on the calling (main) thread, so the signal
+    can land inside ``StageOccupancy.observe``'s critical section."""
+    obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)
+    try:
+        with obs.span("realize"):
+            rec = obs.flightrec.active()
+            assert rec.occupancy._lock.acquire(timeout=5)
+            try:
+                t0 = time.monotonic()
+                path = rec.write_postmortem("SIGTERM", emergency=True)
+                took = time.monotonic() - t0
+            finally:
+                rec.occupancy._lock.release()
+        pm = json.loads(open(path).read())
+        assert pm["reason"] == "SIGTERM"
+        assert "occupancy" in pm["heartbeat"]
+        assert took < 4.0
+    finally:
+        obs.finish_capture()
+
+
 def test_finish_capture_writes_postmortem_on_exception(tmp_path):
     with pytest.raises(RuntimeError):
         obs.start_capture(str(tmp_path), heartbeat_interval_s=5.0)

@@ -1,0 +1,455 @@
+"""Multi-chip sweep path: per-shard readback, per-device prefetch,
+sharded checkpoints (bit-identity, crash injection, mesh-shape-change
+resume), and the fast 8-host-device smoke that guards mesh regressions
+on CPU before a TPU tunnel window is spent.
+
+Runs on the 8 virtual CPU devices conftest.py forces for every test
+session. The strict bit-identity tests use recipes/chunk sizes in the
+regime where XLA's shape-dependent lowering is provably stable (>= 2
+realizations per shard; see test_mesh_sweep_bit_identity) — the
+documented caveat in utils.sweep covers the rest (cross-topology float
+reduction order), asserted here at f64 tightness.
+"""
+import glob
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import importlib
+
+sweep_mod = importlib.import_module("pta_replicator_tpu.utils.sweep")
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.parallel.mesh import (
+    fetch_shard_blocks,
+    make_mesh,
+    put_sharded,
+)
+from pta_replicator_tpu.parallel.pipeline import DrainTimeout
+from pta_replicator_tpu.parallel.prefetch import prefetch_to_mesh
+from pta_replicator_tpu.utils.sweep import (
+    ShardedBlock,
+    load_shard_archive,
+    sweep,
+    write_shard_archive,
+)
+
+
+@pytest.fixture()
+def white_sweep():
+    """Elementwise-only recipe (no contraction for XLA to re-tile):
+    bit-identical across every topology at >= 2 realizations/shard."""
+    b = synthetic_batch(npsr=4, ntoa=64, nbackend=2, seed=2)
+    recipe = Recipe(
+        efac=jnp.full((4, 2), 1.1),
+        log10_equad=jnp.full((4, 2), -6.5),
+    )
+    return b, recipe, jax.random.PRNGKey(5)
+
+
+@pytest.fixture()
+def rn_sweep():
+    b = synthetic_batch(npsr=4, ntoa=64, seed=2)
+    recipe = Recipe(
+        efac=jnp.ones(4),
+        rn_log10_amplitude=jnp.full(4, -14.0),
+        rn_gamma=jnp.full(4, 4.0),
+    )
+    return b, recipe, jax.random.PRNGKey(5)
+
+
+# ------------------------------------------------ per-shard readback
+
+def test_put_sharded_matches_device_put():
+    mesh = make_mesh(4, 2)
+    x = np.arange(8 * 6 * 10, dtype=np.float64).reshape(8, 6, 10)
+    spec = P("real", "psr", None)
+    a = put_sharded(x, mesh, spec)
+    b = jax.device_put(x, NamedSharding(mesh, spec))
+    assert a.sharding.is_equivalent_to(b.sharding, x.ndim)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # placing an already-placed array is a no-op (same object)
+    assert put_sharded(a, mesh, spec) is a
+
+
+def test_put_sharded_reshards_device_arrays_on_device(monkeypatch):
+    """A device-resident input (static_delays' freshly computed plane)
+    reshards via device_put — no host round-trip fencing compute."""
+    from pta_replicator_tpu.parallel import mesh as mesh_mod
+
+    mesh = make_mesh(4, 2)
+    x = np.arange(8 * 6, dtype=np.float64).reshape(8, 6)
+    spec = P("real", "psr")
+    on_dev = jax.device_put(x, jax.devices()[0])
+    want = np.asarray(put_sharded(x, mesh, spec))
+
+    def no_host(*a, **k):
+        raise AssertionError("device array took the host round-trip")
+
+    monkeypatch.setattr(mesh_mod.np, "asarray", no_host)
+    out = put_sharded(on_dev, mesh, spec)
+    monkeypatch.undo()
+    assert out.sharding.is_equivalent_to(
+        NamedSharding(mesh, spec), x.ndim)
+    np.testing.assert_array_equal(np.asarray(out), want)
+
+
+def test_fetch_shard_blocks_assembles_bit_identical():
+    mesh = make_mesh(4, 2)
+    x = np.arange(8 * 6 * 10, dtype=np.float64).reshape(8, 6, 10)
+    arr = put_sharded(x, mesh, P("real", "psr", None))
+    blk = fetch_shard_blocks(arr)
+    assert isinstance(blk, ShardedBlock)
+    assert len(blk.shards) == 8
+    assert blk.nbytes == x.nbytes  # disjoint cover, no replication
+    np.testing.assert_array_equal(blk.assemble(), np.asarray(arr))
+
+
+def test_fetch_shard_blocks_dedups_replicated_axis():
+    """A result that does not use one mesh axis carries replicated
+    shards — fetched once per distinct index window, not per device."""
+    mesh = make_mesh(4, 2)
+    x = np.arange(8 * 5, dtype=np.float64).reshape(8, 5)
+    arr = put_sharded(x, mesh, P("real", None))
+    blk = fetch_shard_blocks(arr)
+    assert len(blk.shards) == 4
+    assert blk.nbytes == x.nbytes
+    np.testing.assert_array_equal(blk.assemble(), x)
+
+
+def test_fetch_shard_blocks_single_device_passthrough():
+    x = jnp.arange(12.0)
+    out = fetch_shard_blocks(jax.device_put(x, jax.devices()[0]))
+    assert isinstance(out, np.ndarray)
+    np.testing.assert_array_equal(out, np.arange(12.0))
+
+
+def test_sharded_block_refuses_partial_cover():
+    blk = ShardedBlock((4, 2), np.float64,
+                       [(((0, 2), (0, 2)), np.zeros((2, 2)))])
+    with pytest.raises(ValueError, match="partial"):
+        blk.assemble()
+
+
+# ------------------------------------------------ shard archive format
+
+def test_shard_archive_roundtrip(tmp_path):
+    mesh = make_mesh(4, 2)
+    x = np.arange(8 * 6 * 10, dtype=np.float64).reshape(8, 6, 10)
+    blk = fetch_shard_blocks(put_sharded(x, mesh, P("real", "psr", None)))
+    path = str(tmp_path / "chunk.npz")
+    write_shard_archive(path, blk)
+    np.testing.assert_array_equal(load_shard_archive(path), x)
+    # manifest member is LAST (the completeness marker)
+    names = zipfile.ZipFile(path).namelist()
+    assert names[-1] == "manifest.npy"
+
+
+def test_shard_archive_refuses_torn_file(tmp_path):
+    """An archive without the manifest member (torn mid-write) must be
+    refused, never silently half-assembled."""
+    path = str(tmp_path / "torn.npz")
+    with zipfile.ZipFile(path, "w") as zf:
+        with zf.open("shard000000.npy", "w") as fh:
+            fh.write(sweep_mod.npy_bytes(np.zeros(3)))
+    with pytest.raises(ValueError, match="manifest"):
+        load_shard_archive(path)
+
+
+# ------------------------------------ sharded-checkpoint sweep paths
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 2), (4, 2)])
+def test_mesh_sweep_bit_identity(tmp_path, white_sweep, shape):
+    """The ISSUE's core contract at mesh shapes 1x1 / 2x2 / 4x2: the
+    mesh sweep with sharded checkpoints returns results AND writes a
+    consolidated npz bit-identical to the single-chip pipelined path."""
+    b, recipe, key = white_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ref_ck,
+                reduce_fn=None, pipeline_depth=2)
+    mesh = make_mesh(*shape)
+    ck = str(tmp_path / "mesh.npz")
+    out = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+                reduce_fn=None, mesh=mesh, pipeline_depth=2)
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+    assert glob.glob(ck + ".chunk*") == []  # consolidated away
+
+
+def test_mesh_sweep_rn_recipe_close_and_format_identical(tmp_path, rn_sweep):
+    """Red noise adds a partitioned contraction: cross-topology results
+    agree to float-reduction-order (documented caveat), while on the
+    SAME mesh the sharded-checkpoint format itself changes nothing —
+    byte-equal consolidated npz vs shard_checkpoint=False."""
+    b, recipe, key = rn_sweep
+    mesh = make_mesh(4, 2)
+    ck_s = str(tmp_path / "sharded.npz")
+    ck_p = str(tmp_path / "plain.npz")
+    out_s = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck_s,
+                  mesh=mesh, pipeline_depth=2)
+    out_p = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck_p,
+                  mesh=mesh, pipeline_depth=2, shard_checkpoint=False)
+    np.testing.assert_array_equal(out_s, out_p)
+    assert open(ck_s, "rb").read() == open(ck_p, "rb").read()
+    # cross-topology: f64-tight but not necessarily bitwise
+    ref = sweep(key, b, recipe, nreal=16, chunk=4,
+                checkpoint_path=str(tmp_path / "ref.npz"))
+    np.testing.assert_allclose(out_s, ref, rtol=1e-12, atol=1e-18)
+
+
+def test_mesh_sweep_writes_sharded_chunk_files(tmp_path, white_sweep):
+    """Mid-sweep the chunk files ARE sharded archives (npz members per
+    shard + manifest), landed through the atomic layer."""
+    b, recipe, key = white_sweep
+    mesh = make_mesh(2, 2)
+
+    class Stop(Exception):
+        pass
+
+    def bomb(done, total):
+        if done == 2:
+            raise Stop
+
+    ck = str(tmp_path / "s.npz")
+    with pytest.raises(Stop):
+        sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+              mesh=mesh, progress=bomb, pipeline_depth=2)
+    chunks = sorted(glob.glob(ck + ".chunk*"))
+    assert chunks and all(c.endswith(".npz") for c in chunks)
+    with zipfile.ZipFile(chunks[0]) as zf:
+        names = zf.namelist()
+    assert "manifest.npy" in names
+    assert sum(n.startswith("shard") for n in names) == 4  # 2x2 mesh
+
+
+def test_crash_mid_shard_write_resumes(tmp_path, white_sweep, monkeypatch):
+    """Kill between a sharded chunk archive landing and its sidecar —
+    the crash-safety window — then resume on a DIFFERENT mesh shape and
+    still match the uninterrupted single-chip run byte-for-byte."""
+    b, recipe, key = white_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ref_ck,
+                reduce_fn=None, pipeline_depth=2)
+
+    class _KillSim(BaseException):
+        pass
+
+    orig = sweep_mod._atomic_write
+    seen = {"json": 0}
+
+    def bombed(write_fn, final_path, suffix, durable=False):
+        if suffix == ".json":
+            seen["json"] += 1
+            if seen["json"] == 3:  # chunk index 2's sidecar
+                raise _KillSim()
+        return orig(write_fn, final_path, suffix, durable=durable)
+
+    monkeypatch.setattr(sweep_mod, "_atomic_write", bombed)
+    ck = str(tmp_path / "crash.npz")
+    with pytest.raises(_KillSim):
+        sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+              reduce_fn=None, mesh=make_mesh(2, 2), pipeline_depth=2)
+    monkeypatch.undo()
+
+    # chunk 2's sharded archive landed, its sidecar did not
+    assert os.path.exists(ck + ".chunk000002.npz")
+    calls = []
+    out = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+                reduce_fn=None, mesh=make_mesh(4, 2), pipeline_depth=2,
+                progress=lambda d, t: calls.append(d))
+    assert calls == [3, 4]  # chunks 0,1 reloaded from sharded archives
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+@pytest.mark.parametrize("direction", ["mesh_to_single", "single_to_mesh"])
+def test_resume_across_topology_change(tmp_path, white_sweep, direction):
+    """A sweep checkpointed under one topology resumes under another
+    (the preemption case): sharded chunks reassemble via their
+    manifests, single-chip chunks load as before, and the result +
+    consolidated npz match the uninterrupted reference bitwise."""
+    b, recipe, key = white_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ref_ck,
+                pipeline_depth=2)
+
+    class Stop(Exception):
+        pass
+
+    def bomb(done, total):
+        if done == 2:
+            raise Stop
+
+    first = make_mesh(2, 2) if direction == "mesh_to_single" else None
+    second = None if direction == "mesh_to_single" else make_mesh(4, 2)
+    ck = str(tmp_path / "topo.npz")
+    with pytest.raises(Stop):
+        sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+              mesh=first, progress=bomb)
+    calls = []
+    out = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+                mesh=second, progress=lambda d, t: calls.append(d))
+    assert calls == [3, 4]
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+def test_shard_checkpoint_requires_mesh(tmp_path, white_sweep):
+    b, recipe, key = white_sweep
+    with pytest.raises(ValueError, match="multi-device mesh"):
+        sweep(key, b, recipe, nreal=8, chunk=4,
+              checkpoint_path=str(tmp_path / "x.npz"),
+              shard_checkpoint=True)
+
+
+# --------------------------------------------- per-device prefetch
+
+def _tiles(n=5, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.random((3, 16)), rng.random((5, 8, 16)))
+            for _ in range(n)]
+
+
+def test_prefetch_to_mesh_matches_device_put():
+    mesh = make_mesh(4, 2)
+    tiles = _tiles()
+    specs = (P(), P(None, "psr", None))
+    got = list(prefetch_to_mesh(iter(tiles), mesh, specs=specs, depth=2))
+    assert len(got) == len(tiles)
+    for (src, psr), (g_src, g_psr) in zip(tiles, got):
+        ref = jax.device_put(psr, NamedSharding(mesh, P(None, "psr", None)))
+        np.testing.assert_array_equal(np.asarray(g_src), src)
+        np.testing.assert_array_equal(np.asarray(g_psr), np.asarray(ref))
+        assert g_psr.sharding.is_equivalent_to(ref.sharding, psr.ndim)
+
+
+def test_prefetch_to_mesh_error_reraises_in_order():
+    """A tile-build failure re-raises UNCHANGED on the consumer, after
+    every earlier tile was yielded — no device may abandon a tile its
+    peers already staged."""
+    mesh = make_mesh(4, 2)
+    tiles = _tiles()
+
+    class Boom(Exception):
+        pass
+
+    def gen():
+        yield tiles[0]
+        yield tiles[1]
+        raise Boom("tile build failed")
+
+    it = prefetch_to_mesh(gen(), mesh,
+                          specs=(P(), P(None, "psr", None)), depth=2)
+    got = []
+    with pytest.raises(Boom, match="tile build failed"):
+        for t in it:
+            got.append(t)
+    assert len(got) == 2
+    for (src, _), (g_src, _) in zip(tiles, got):
+        np.testing.assert_array_equal(np.asarray(g_src), src)
+
+
+def test_prefetch_to_mesh_stall_raises_drain_timeout():
+    import threading
+
+    mesh = make_mesh(2, 1)
+    hang = threading.Event()
+
+    def gen():
+        yield _tiles(1)[0]
+        hang.wait(20.0)  # wedged host precompute
+        yield _tiles(1)[0]
+
+    it = prefetch_to_mesh(gen(), mesh, specs=(P(), P()), depth=1,
+                          stall_timeout_s=0.5)
+    next(it)
+    with pytest.raises(DrainTimeout):
+        next(it)
+    hang.set()
+
+
+def test_prefetch_to_mesh_consumer_abandon_no_hang():
+    mesh = make_mesh(2, 1)
+    it = prefetch_to_mesh(iter(_tiles(8)), mesh, specs=(P(), P()), depth=2)
+    next(it)
+    it.close()  # must join workers promptly, not hang
+
+
+def test_cw_stream_response_mesh_bit_identical():
+    """The streamed CW plane build on a mesh (per-device staging,
+    psr-sharded accumulator) is bit-identical to the single-device
+    stream — per-pulsar accumulation order is unchanged."""
+    from pta_replicator_tpu.models.batched import (
+        cw_catalog_plane_tiles_for,
+        cw_stream_response,
+    )
+
+    b = synthetic_batch(npsr=4, ntoa=64, seed=3)
+    rng = np.random.default_rng(1)
+    ncw = 24
+    params = [
+        np.arccos(rng.uniform(-1, 1, ncw)),
+        rng.uniform(0, 2 * np.pi, ncw),
+        10 ** rng.uniform(8, 9.5, ncw),
+        rng.uniform(50, 1000, ncw),
+        10 ** rng.uniform(-8.8, -7.6, ncw),
+        rng.uniform(0, 2 * np.pi, ncw),
+        rng.uniform(0, np.pi, ncw),
+        np.arccos(rng.uniform(-1, 1, ncw)),
+    ]
+
+    def tiles():
+        return cw_catalog_plane_tiles_for(b, *params, chunk=8)
+
+    ref = np.asarray(cw_stream_response(b, tiles(), evolve=True))
+    for shape in [(2, 2), (4, 1)]:
+        mesh = make_mesh(*shape)
+        got = cw_stream_response(b, tiles(), evolve=True, mesh=mesh)
+        assert len(got.sharding.device_set) == shape[0] * shape[1]
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+# ------------------------------------------------ 8-device CPU smoke
+
+def test_multichip_smoke_8_devices(tmp_path, white_sweep):
+    """Fast tier-1 smoke over all 8 virtual CPU devices: a tiny mesh
+    sweep down the full multi-chip path (sharded dispatch, per-shard
+    readback, sharded checkpoints, multichip_sweep phase span) — mesh
+    regressions surface here before a TPU tunnel window is spent."""
+    assert jax.device_count() >= 8, "conftest must force 8 host devices"
+    b, recipe, key = white_sweep
+    from pta_replicator_tpu import obs
+
+    obs.reset_all()
+    mesh = make_mesh(4, 2)
+    ck = str(tmp_path / "smoke.npz")
+    out = sweep(key, b, recipe, nreal=16, chunk=8, checkpoint_path=ck,
+                mesh=mesh, pipeline_depth=2)
+    assert out.shape == (16, 4)
+    assert np.isfinite(out).all()
+    # the phase span for occupancy attribution was emitted
+    spans = [e for e in obs.TRACER.events()
+             if e.get("type") == "span" and e.get("name") == "multichip_sweep"]
+    assert len(spans) == 1
+    assert spans[0]["attrs"]["mesh"] == "4x2"
+    occ = obs.occupancy.analyze(obs.TRACER.events())
+    assert occ and "bottleneck" in occ
+
+
+# ------------------------------------------- bench-diff directions
+
+def test_regress_directions_for_multichip_series():
+    from pta_replicator_tpu.obs.regress import metric_direction
+
+    assert metric_direction("scaling_efficiency") is True
+    assert metric_direction("arms.8.scaling_efficiency") is True
+    assert metric_direction("per_device_real_per_s") is True
+    # host properties, not scores: no direction
+    assert metric_direction("arms.8.attainable_speedup") is None
+    assert metric_direction("arms.8.compute_util_cores") is None

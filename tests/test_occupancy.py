@@ -169,6 +169,22 @@ def test_stage_occupancy_empty_snapshot():
     assert snap == {"stages": {}, "bottleneck": None}
 
 
+def test_stage_occupancy_unions_concurrent_same_stage_spans():
+    """N per-device cw_stream_stage spans (prefetch_to_mesh's stagers)
+    overlap in time; live duty is their interval UNION, like the
+    post-hoc analyze() path — summing would read as saturated (duty
+    1.0) and steal the bottleneck verdict from the truly busy stage."""
+    occ = occupancy.StageOccupancy(window_s=60.0)
+    time.sleep(0.1)
+    lifetime = time.monotonic() - occ._t0
+    # 8 concurrent stagers, each busy the same ~half of the horizon:
+    # observe() stamps all of them "ending now"
+    for tid in range(8):
+        occ.observe(_span("cw_stream_stage", 0, 0.5 * lifetime, tid=tid))
+    duty = occ.snapshot()["stages"]["cw_stream_stage"]
+    assert 0.3 <= duty <= 0.75, duty  # union ~0.5; a sum would clamp to 1.0
+
+
 # -------------------------------------------------------- pipeline stats
 def test_run_pipelined_reports_stage_busy_and_occupancy(tmp_path):
     from pta_replicator_tpu.parallel.pipeline import run_pipelined
