@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
@@ -121,6 +122,25 @@ def main(argv=None):
     p.add_argument("--once", action="store_true",
                    help="print the current heartbeat and exit (for "
                         "scripts/cron: exit 3 when there is none)")
+    p.add_argument("--serve", type=int, default=None, metavar="PORT",
+                   help="also expose the live run over HTTP while "
+                        "watching: /metrics (Prometheus text), "
+                        "/progress, /series — read-only, torn-read-"
+                        "safe against the sampler (docs/observability"
+                        ".md 'Scraping a live run'). Port 0 picks an "
+                        "ephemeral port (printed). The server lives "
+                        "for the duration of the watch")
+    p.add_argument("--bind", default="127.0.0.1", metavar="HOST",
+                   help="interface for --serve (default loopback; "
+                        "0.0.0.0 exposes the run to the network)")
+    p = sub.add_parser(
+        "timeline", help="merge a capture's host spans, per-device "
+                         "stage tracks, chunk flow links, and any "
+                         "registered jax.profiler device traces into "
+                         "ONE clock-aligned chrome://tracing file")
+    p.add_argument("dir", help="the run's --telemetry directory")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="output path (default DIR/timeline.json)")
     p = sub.add_parser(
         "postmortem", help="render the black box a killed/crashed run "
                            "left in its telemetry directory")
@@ -206,10 +226,36 @@ def main(argv=None):
     if args.cmd == "watch":
         from .obs.report import watch_progress
 
-        rc = watch_progress(args.dir, interval=args.interval,
-                            once=args.once)
+        server = None
+        if args.serve is not None:
+            from .obs.serve import serve_directory, serve_url
+
+            server = serve_directory(args.dir, args.serve,
+                                     host=args.bind, background=True)
+            print(f"serving {serve_url(server)} "
+                  "(/metrics /progress /series)", file=sys.stderr)
+        try:
+            rc = watch_progress(args.dir, interval=args.interval,
+                                once=args.once)
+        finally:
+            if server is not None:
+                server.shutdown()
+                server.server_close()
         if rc:
             raise SystemExit(rc)
+        return
+    if args.cmd == "timeline":
+        from .obs.timeline import build_timeline, write_timeline
+
+        doc = build_timeline(args.dir)
+        out = write_timeline(args.dir, out=args.out, doc=doc)
+        summary = dict(doc.get("otherData") or {})
+        summary["out"] = out
+        summary["events"] = len(doc.get("traceEvents") or [])
+        print(json.dumps(summary, indent=1, sort_keys=True))
+        if summary.get("problems"):
+            for problem in summary["problems"]:
+                print(f"warning: {problem}", file=sys.stderr)
         return
     if args.cmd == "postmortem":
         from .obs.report import print_postmortem

@@ -1,0 +1,222 @@
+"""graftlint rule pack: bounded-buffer discipline in the obs subsystem.
+
+The telemetry layer runs for the LIFE of a multi-hour capture, on
+daemon threads (the flight recorder's sampler, the tracer's listeners,
+the serve endpoint's request threads). Any unbounded container on
+module or instance state there is a slow memory leak with a multi-hour
+fuse — exactly the host-RSS creep the series recorder exists to
+surface, coming from the telemetry itself. The series rings are
+*designed* bounded (fixed budget + decimation); this rule makes the
+property mechanical for the whole package:
+
+* ``obs-unbounded-buffer`` — inside ``pta_replicator_tpu/obs/`` modules
+  that use threads, flag
+
+  - ``collections.deque()`` constructed WITHOUT ``maxlen`` (an
+    unbounded deque on state is the classic accidental ring), and
+  - growth calls (``append``/``appendleft``/``extend``/``insert``) on
+    module-level or instance (``self.X``) list state,
+
+  unless the module carries **bounding evidence** for that container:
+  a ``len(<container>)`` check (the cap-and-drop idiom), a membership
+  guard (``if x not in buf`` — bounded by distinct values), or a
+  pruning operation (``pop``/``popleft``/``remove``/``clear``/``del``/
+  slice reassignment) on the same terminal name. Intentionally
+  unbounded-but-pruned structures carry an inline
+  ``# graftlint: disable=obs-unbounded-buffer`` with the reason, which
+  is the reviewer-visible record the engine's suppression mechanism
+  exists for.
+
+The evidence check is per terminal attribute/name, module-wide: it
+asks "is there ANY bounding mechanism for this container in this
+file", not "is this exact call site guarded" — a ring that prunes in
+``observe`` and appends in ``offer`` is bounded even though the append
+itself is bare. That keeps the rule quiet on correct code and loud on
+the one shape that actually leaks: a buffer that only ever grows.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional, Set
+
+from .engine import Finding, Module, Rule
+from .rules_threads import _uses_threads
+
+#: growth calls on list/deque state the rule polices
+_GROWTH_METHODS = {"append", "appendleft", "extend", "insert"}
+#: calls that count as pruning evidence for a container name
+_PRUNE_METHODS = {
+    "pop", "popleft", "popitem", "remove", "discard", "clear",
+}
+
+#: the subtree this pack polices (posix relpath prefix)
+OBS_PREFIX = "pta_replicator_tpu/obs/"
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    """Terminal identifier of a Name/Attribute chain (``self._events``
+    -> ``_events``; ``ring`` -> ``ring``), else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _tracked_containers(mod: Module) -> Set[str]:
+    """Terminal names of module-level or instance state initialized as
+    a list display or a deque() call — the containers whose growth the
+    rule polices. Plain function locals are excluded (they die with
+    the frame)."""
+    tracked: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        value = node.value
+        if value is None:
+            continue
+        is_list = isinstance(value, (ast.List, ast.ListComp))
+        is_deque = _is_deque_call(mod, value)
+        # a dict/set comprehension of deques (occupancy's per-stage
+        # table) still tracks the *constructor* rule below; here we
+        # only track direct list/deque state
+        if not (is_list or is_deque):
+            continue
+        for t in targets:
+            name = _terminal(t)
+            if name is None:
+                continue
+            if isinstance(t, ast.Attribute):
+                tracked.add(name)       # self.X / obj.X state
+            elif isinstance(t, ast.Name) and not any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a in mod.ancestors(node)
+            ):
+                tracked.add(name)       # module-level state
+    return tracked
+
+
+def _is_deque_call(mod: Module, node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and (mod.resolve(node.func) or "").endswith("deque")
+    )
+
+
+def _deque_has_maxlen(call: ast.Call) -> bool:
+    if any(kw.arg == "maxlen" for kw in call.keywords):
+        return True
+    # positional: deque(iterable, maxlen)
+    return len(call.args) >= 2
+
+
+def _bounding_evidence(mod: Module) -> Set[str]:
+    """Terminal container names with ANY bounding mechanism in this
+    module: a len() check, a membership guard, a pruning call, slice
+    reassignment/deletion, or a bounded-deque assignment."""
+    evidence: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Name) and fn.id == "len"
+                and node.args
+            ):
+                name = _terminal(node.args[0])
+                if name:
+                    evidence.add(name)
+            elif (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _PRUNE_METHODS
+            ):
+                name = _terminal(fn.value)
+                if name:
+                    evidence.add(name)
+        elif isinstance(node, ast.Compare) and any(
+            isinstance(op, (ast.In, ast.NotIn)) for op in node.ops
+        ):
+            for side in [node.left, *node.comparators]:
+                name = _terminal(side)
+                if name:
+                    evidence.add(name)
+        elif isinstance(node, (ast.Delete,)):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = _terminal(t.value)
+                    if name:
+                        evidence.add(name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                # slice reassignment prunes; a maxlen deque bounds
+                if isinstance(t, ast.Subscript):
+                    name = _terminal(t.value)
+                    if name:
+                        evidence.add(name)
+                elif node.value is not None and _is_deque_call(
+                    mod, node.value
+                ) and _deque_has_maxlen(node.value):
+                    name = _terminal(t)
+                    if name:
+                        evidence.add(name)
+    return evidence
+
+
+class UnboundedObsBuffer(Rule):
+    id = "obs-unbounded-buffer"
+    severity = "error"
+    description = (
+        "unbounded buffer on module/instance state in an obs thread/"
+        "sampler path (deque without maxlen, or list growth with no "
+        "bounding mechanism) — a slow leak over a multi-hour capture"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not mod.relpath.startswith(OBS_PREFIX):
+            return
+        if not _uses_threads(mod):
+            return
+        evidence = _bounding_evidence(mod)
+        tracked = _tracked_containers(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # unbounded deque constructor, in any context: even a
+            # "local" one is usually about to be stored on state (dict
+            # values, comprehensions) where the tracker can't follow
+            if _is_deque_call(mod, node) and not _deque_has_maxlen(node):
+                yield self.finding(
+                    mod, node.lineno,
+                    "deque() without maxlen in a threaded obs module: "
+                    "give it a maxlen, prune it explicitly (and "
+                    "suppress with the reason), or it grows for the "
+                    "life of the capture",
+                )
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _GROWTH_METHODS
+            ):
+                continue
+            name = _terminal(fn.value)
+            if name is None or name not in tracked:
+                continue
+            if name in evidence:
+                continue
+            yield self.finding(
+                mod, node.lineno,
+                f".{fn.attr}() grows {name!r} (module/instance state) "
+                "with no bounding mechanism in this module (no len() "
+                "cap, membership guard, pruning call, or maxlen) — "
+                "bound it or suppress with the reason",
+            )
+
+
+RULES = [UnboundedObsBuffer()]

@@ -244,6 +244,11 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         # text markers pin the constants' use, the f-strings themselves
         # aren't statically checkable)
         (f"{pkg}/obs/flightrec.py", "metric", n.OCCUPANCY_DUTY_CYCLE),
+        # temporal layer (PR 8): the sampler's self-accounted overhead
+        # counter (the <1%-of-wall evidence series) and the RSS-creep
+        # gauge the series recorder samples each tick
+        (f"{pkg}/obs/flightrec.py", "metric", n.OBS_OVERHEAD_S),
+        (f"{pkg}/obs/series.py", "metric", n.PROC_RSS_BYTES),
         (f"{pkg}/parallel/prefetch.py", "metric", n.OCCUPANCY_BUSY_S),
         (f"{pkg}/obs/devprof.py", "span", n.SPAN_DEVICE_TRACE),
         (f"{pkg}/obs/devprof.py", "event", n.EVENT_DEVICE_TRACE),

@@ -35,6 +35,9 @@ from . import (
     occupancy,
     regress,
     report,
+    serve,
+    series,
+    timeline,
     trace,
 )
 from .flightrec import FlightRecorder, StallWarning
@@ -58,7 +61,7 @@ __all__ = [
     "trace_count", "tree_nbytes", "start_capture", "finish_capture",
     "telemetry_summary", "reset_all", "metrics", "trace", "report",
     "jaxhooks", "flightrec", "regress", "FlightRecorder", "StallWarning",
-    "names", "devprof", "occupancy",
+    "names", "devprof", "occupancy", "series", "timeline", "serve",
 ]
 
 
@@ -101,7 +104,9 @@ def start_capture(
     # reads as dead to watch/report while it is running fine
     import os as _os
 
-    for stale_artifact in ("progress.json", "postmortem.json"):
+    for stale_artifact in ("progress.json", "postmortem.json",
+                           "series.json", "series.jsonl",
+                           "timeline.json", "metrics.prom"):
         try:
             _os.remove(_os.path.join(directory, stale_artifact))
         except OSError:

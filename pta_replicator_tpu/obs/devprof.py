@@ -405,15 +405,27 @@ def device_trace(logdir: Optional[str] = None):
                 "or call obs.start_capture first"
             )
         logdir = os.path.join(base, "xla_trace")
-    with TRACER.span(names.SPAN_DEVICE_TRACE, logdir=logdir):
+    import time as _time
+
+    with TRACER.span(names.SPAN_DEVICE_TRACE, logdir=logdir) as sp:
         jax.profiler.start_trace(logdir)
+        # correlation markers: the wall-clock instants bracketing the
+        # profiler session. obs.timeline maps the profiler's own clock
+        # onto time.time() by anchoring the trace's earliest device
+        # event at t_wall_open — without these the host and device
+        # timelines are two artifacts on two clocks.
+        sp["t_wall_open"] = _time.time()
         try:
             yield logdir
         finally:
+            t_close = _time.time()
+            sp["t_wall_close"] = t_close
             jax.profiler.stop_trace()
             with _lock:
-                _TRACE_DIRS.append(logdir)
-            TRACER.event(names.EVENT_DEVICE_TRACE, logdir=logdir)
+                _TRACE_DIRS.append(logdir)  # graftlint: disable=obs-unbounded-buffer — cleared per capture by reset(); one entry per managed trace
+            TRACER.event(names.EVENT_DEVICE_TRACE, logdir=logdir,
+                         t_wall_open=sp["t_wall_open"],
+                         t_wall_close=t_close)
 
 
 def trace_dirs(relative_to: Optional[str] = None) -> list:

@@ -60,14 +60,17 @@ import traceback
 import warnings
 from typing import Optional
 
-from . import names, occupancy
+from . import names, occupancy, series as series_mod
 from .jaxhooks import device_memory_snapshot
 from .metrics import REGISTRY
 from .trace import TRACER
 
-#: v2 adds the "occupancy" block (per-stage duty cycle over the rolling
-#: window + bottleneck verdict) — readers stay tolerant of v1 files
-PROGRESS_SCHEMA_VERSION = 2
+#: v2 added the "occupancy" block (per-stage duty cycle over the rolling
+#: window + bottleneck verdict); v3 adds the "trends" block (per-series
+#: latest value, rate/s, and rising/falling/flat direction over the
+#: trailing window, derived from the obs.series ring recorder the
+#: sampler now drives). Readers stay tolerant of older files.
+PROGRESS_SCHEMA_VERSION = 3
 
 #: Required fields (and JSON types) of progress.json — the heartbeat
 #: contract consumed by the ``watch`` subcommand and validated by
@@ -81,6 +84,7 @@ PROGRESS_SCHEMA = {
     "open_spans": dict,     # {tid: ["realize", "compute", ...]}
     "sweep": dict,          # chunks_done/chunks_total/inflight/rate/eta_s
     "occupancy": dict,      # {"stages": {name: duty}, "bottleneck": ...}
+    "trends": dict,         # {series: {latest, rate_per_s, trend}}
     "jax": dict,            # compiles / traces counters
     "stalls": float,        # flightrec.stalls counter
     "finished": bool,       # True only in the final heartbeat
@@ -102,14 +106,39 @@ class StallWarning(UserWarning):
     legitimately inside one very long uninstrumented computation."""
 
 
-def _atomic_json(path: str, payload: dict) -> None:
+def _atomic_json(path: str, payload: dict, indent: Optional[int] = 1) -> None:
     """Write ``payload`` as JSON via temp-file + rename so a concurrent
-    reader (the watch CLI, a shell watcher) can never see a torn file."""
+    reader (the watch CLI, a shell watcher) can never see a torn file.
+
+    ``indent=None`` writes compactly on the C encoder's fast path —
+    the per-tick heartbeat uses it because indented encoding runs the
+    pure-Python encoder, whose allocation churn makes the sampler
+    thread trigger (and get charged for) the process's GC cycles while
+    the workload sits in XLA C++; one-shot artifacts (postmortem) keep
+    the human-friendly indent."""
     dirname = os.path.dirname(path) or "."
     fd, tmp = tempfile.mkstemp(suffix=".json", dir=dirname)
     try:
         with os.fdopen(fd, "w") as fh:
-            json.dump(payload, fh, indent=1, sort_keys=True, default=repr)
+            json.dump(payload, fh, indent=indent,
+                      sort_keys=indent is not None, default=repr)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _atomic_text(path: str, text: str) -> None:
+    """Atomic-replace write of a plain-text artifact (metrics.prom —
+    same torn-read guarantee as the JSON heartbeat)."""
+    dirname = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(suffix=".txt", dir=dirname)
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
         os.replace(tmp, path)
     except BaseException:
         try:
@@ -149,6 +178,13 @@ class FlightRecorder:
         #: tracer listener as the ring; its snapshot (duty cycles + a
         #: bottleneck verdict) is the heartbeat's "occupancy" block
         self.occupancy = occupancy.StageOccupancy()
+        #: bounded-ring time-series recorder (obs/series.py): the
+        #: sampler tick snapshots matching counters/gauges into its
+        #: rings, the same tracer listener feeds its span-duration
+        #: percentiles, and the heartbeat's "trends" block (schema v3)
+        #: is its rate/trend derivation. Persisted as series.jsonl on
+        #: stop, and as the live series.json window every tick.
+        self.series = series_mod.SeriesRecorder()
         self._thread: Optional[threading.Thread] = None
         self._lifecycle_lock = threading.Lock()
         self._stop = threading.Event()
@@ -203,6 +239,15 @@ class FlightRecorder:
         _clear_active(self)
         try:
             self.write_heartbeat(finished=finished)
+            # the full decimated history outlives the run as a capture
+            # artifact (report/timeline render from it), and the scrape
+            # surface gets one final refresh so a post-run reader sees
+            # the closing state; best-effort — a missing series.jsonl
+            # degrades those sections, nothing else
+            self.series.write_jsonl(
+                os.path.join(self.directory, "series.jsonl")
+            )
+            self._write_live_artifacts()
         except OSError:
             pass  # capture dir deleted under us — nothing to record into
 
@@ -210,15 +255,117 @@ class FlightRecorder:
     def _on_record(self, rec: dict) -> None:
         self.ring.append(rec)
         self.occupancy.observe(rec)
+        self.series.observe_span(rec)
+
+    #: live scrape artifacts refresh every Nth sampler tick: at the 1 s
+    #: default cadence the endpoint's worst-case staleness is N seconds,
+    #: and the tick's budget stays dominated by the heartbeat it always
+    #: owed rather than by JSON encoding of series windows
+    LIVE_ARTIFACT_EVERY = 5
+
+    #: telemetry duty-cycle budget: the sampler stretches its own
+    #: interval so that (smoothed tick CPU cost) / interval stays at or
+    #: under this fraction of one core. On an idle host a tick costs a
+    #: few ms and the configured cadence holds; on a starved host (a
+    #: 2-core box mid measure-loop, every cache cold) the same tick can
+    #: cost 20-50x more — self-regulation keeps "watching the run" from
+    #: becoming a measurable tax on the run being watched. Backoff only
+    #: engages at production cadences (interval >= 0.5 s): sub-second
+    #: intervals are deliberate test/debug choices.
+    OVERHEAD_TARGET = 0.005
+    #: ceiling on the stretched interval — the heartbeat never goes
+    #: quieter than this no matter how starved the host is
+    MAX_INTERVAL_S = 30.0
 
     # -- sampler --------------------------------------------------------
     def _run(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        # telemetry self-accounting: the sampler thread does NOTHING but
+        # telemetry ticks (the wait consumes no CPU), so its cumulative
+        # THREAD CPU time is exactly the capacity the temporal layer
+        # steals from the workload — exported as the obs.overhead_s
+        # counter (itself a sampled series), the <1%-of-wall evidence.
+        # Thread CPU, not wall: while a measure loop saturates every
+        # core, the tick's wall time is dominated by scheduler
+        # contention — capacity the workload keeps. Cumulative, not
+        # per-tick deltas: CLOCK_THREAD_CPUTIME_ID reads are ~10 ms
+        # granular on older kernels, so per-tick deltas of ~5 ms ticks
+        # would quantize to zero forever; differencing one cumulative
+        # accumulator never loses what the kernel has already charged.
+        # GC pauses are EXCLUDED: CPython charges a whole collection to
+        # whichever thread's allocation trips the threshold, and while
+        # the workload sits inside XLA C++ the sampler is often the
+        # only Python allocator — so it gets billed for sweeping the
+        # workload's multi-GB heap, a whole-process cost that would be
+        # paid regardless and that made the overhead number noise
+        # (0.8%-5% run to run) instead of measurement.
+        import gc
+
+        my_ident = threading.get_ident()
+        gc_state = [0.0, 0.0]  # [t0 of an in-flight collection, total]
+
+        def _gc_cb(phase, _info):
+            # runs on the TRIGGERING thread; only meter our own
+            if threading.get_ident() != my_ident:
+                return
+            if phase == "start":
+                gc_state[0] = time.thread_time()
+            else:
+                gc_state[1] += time.thread_time() - gc_state[0]
+
+        gc.callbacks.append(_gc_cb)
+        cpu_last = time.thread_time()
+        gc_last = 0.0
+        tick = 0
+        wait_s = self.interval_s
+        cpu_ewma = 0.0
+        try:
+            while not self._stop.wait(wait_s):
+                try:
+                    self.series.sample()
+                    self.write_heartbeat()
+                    if tick % self.LIVE_ARTIFACT_EVERY == 0:
+                        self._write_live_artifacts()
+                except OSError:
+                    pass  # transient (dir deleted mid-run); keep going
+                cpu_now, gc_now = time.thread_time(), gc_state[1]
+                tick_cpu = max(
+                    0.0, (cpu_now - cpu_last) - (gc_now - gc_last)
+                )
+                REGISTRY.counter(names.OBS_OVERHEAD_S).inc(tick_cpu)
+                cpu_last, gc_last = cpu_now, gc_now
+                tick += 1
+                # duty-cycle self-regulation (see OVERHEAD_TARGET);
+                # EWMA-smoothed so one quantized/cold-cache outlier
+                # tick doesn't swing the cadence
+                cpu_ewma = 0.4 * tick_cpu + 0.6 * cpu_ewma
+                if self.interval_s >= 0.5:
+                    wait_s = min(
+                        max(self.interval_s,
+                            cpu_ewma / self.OVERHEAD_TARGET),
+                        max(self.interval_s, self.MAX_INTERVAL_S),
+                    )
+                self._check_watchdog()
+        finally:
             try:
-                self.write_heartbeat()
-            except OSError:
-                pass  # transient (dir deleted mid-run); keep sampling
-            self._check_watchdog()
+                gc.callbacks.remove(_gc_cb)
+            except ValueError:
+                pass
+
+    def _write_live_artifacts(self) -> None:
+        """Scrape surface for ``watch --serve`` (obs/serve.py): the
+        recent series window and the Prometheus exposition, both
+        atomic-replace so a concurrent HTTP read can never see a torn
+        document. Compact JSON on purpose: the machine-read artifact
+        takes the C encoder's fast path (indent forces the pure-Python
+        encoder — measured ~10x slower at bench-scale registries)."""
+        _atomic_text(
+            os.path.join(self.directory, "series.json"),
+            json.dumps(self.series.snapshot(), default=repr),
+        )
+        _atomic_text(
+            os.path.join(self.directory, "metrics.prom"),
+            REGISTRY.to_prometheus(),
+        )
 
     def _sweep_block(self, metrics=None) -> dict:
         snap = {}
@@ -312,6 +459,9 @@ class FlightRecorder:
             },
             "sweep": self._sweep_block(metrics=ms),
             "occupancy": self._occupancy_block(emergency=emergency),
+            "trends": self.series.trends(
+                timeout=1.0 if emergency else None
+            ),
             "jax": {
                 name.split(".", 1)[1]: val
                 for name in (names.JAX_COMPILES, names.JAX_TRACES)
@@ -334,7 +484,8 @@ class FlightRecorder:
 
     def write_heartbeat(self, finished: bool = False) -> dict:
         hb = self._heartbeat(finished=finished)
-        _atomic_json(os.path.join(self.directory, "progress.json"), hb)
+        _atomic_json(os.path.join(self.directory, "progress.json"), hb,
+                     indent=None)
         return hb
 
     def _check_watchdog(self) -> None:
@@ -402,6 +553,17 @@ class FlightRecorder:
         path = os.path.join(self.directory, "postmortem.json")
         os.makedirs(self.directory, exist_ok=True)
         _atomic_json(path, pm)
+        try:
+            # the black box keeps its history too: a killed multi-hour
+            # sweep's throughput decay is exactly the evidence a
+            # postmortem reader wants. Bounded locks in an emergency —
+            # the suspended main thread may hold the series lock.
+            self.series.write_jsonl(
+                os.path.join(self.directory, "series.jsonl"),
+                timeout=1.0 if emergency else None,
+            )
+        except OSError:
+            pass
         # events.jsonl should be complete alongside it; in an emergency
         # the suspended main thread may hold the sink lock forever, so
         # bound the wait — the sink already carries everything up to the

@@ -132,6 +132,14 @@ CW_STREAM_PREFETCH_STALL_S = "cw_stream.prefetch_stall_s"
 # flight recorder
 FLIGHTREC_STALLS = "flightrec.stalls"
 
+# telemetry self-accounting (obs/series.py + obs/flightrec.py): the
+# cumulative seconds the flight recorder's sampler tick spent on
+# telemetry work (heartbeat + series sampling + live artifact writes) —
+# the series that proves the temporal layer stays <1% of wall — and the
+# sampled process resident set size (host-RSS creep over a long run)
+OBS_OVERHEAD_S = "obs.overhead_s"
+PROC_RSS_BYTES = "proc.rss_bytes"
+
 # stage occupancy (obs/occupancy.py): live per-stage duty cycle over the
 # flight recorder's rolling window, and the cumulative busy seconds a
 # staged executor's worker spent inside its stage
@@ -158,6 +166,7 @@ METRICS = frozenset({
     CW_STREAM_TILES_DONE, CW_STREAM_BYTES_STAGED,
     CW_STREAM_PREFETCH_STALL_S,
     FLIGHTREC_STALLS,
+    OBS_OVERHEAD_S, PROC_RSS_BYTES,
     OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
     JAX_COMPILES, JAX_COMPILE_S, JAX_TRACES, JAX_TRACE_S, JAX_LOWERING_S,
     JAX_TRACE_COUNT,
@@ -187,6 +196,8 @@ FLIGHTREC_PREFIX = "flightrec."
 PIPELINE_PREFIX = "pipeline."
 CW_STREAM_PREFIX = "cw_stream."
 OCCUPANCY_PREFIX = "occupancy."
+OBS_PREFIX = "obs."
+PROC_PREFIX = "proc."
 
 # ----------------------------------------------- instrumented_jit labels
 JIT_REALIZE_ENGINE = "batched.realize_engine"

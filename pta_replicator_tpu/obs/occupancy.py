@@ -55,6 +55,21 @@ STAGES: Dict[str, str] = {
     names.SPAN_CW_STREAM_STAGE: "host-precompute",
 }
 
+#: dataflow order of the stage tracks in chrome-trace exports: the
+#: pipelined sweep's dispatch -> drain -> io_write first, the prefetch
+#: staging after, then the synchronous-loop stages. Tracer.chrome_trace
+#: and obs.timeline stamp ``thread_sort_index`` metadata from this
+#: tuple, so merged timelines render stages in pipeline order instead
+#: of dict/tid order.
+STAGE_SORT_ORDER: Tuple[str, ...] = (
+    names.SPAN_DISPATCH,
+    names.SPAN_DRAIN,
+    names.SPAN_IO_WRITE,
+    names.SPAN_CW_STREAM_STAGE,
+    names.SPAN_SWEEP_CHUNK,
+    names.SPAN_READBACK_FENCE,
+)
+
 #: nested stage -> the enclosing stage whose span contains it. A nested
 #: stage's busy time is already inside its parent's, so it must not be
 #: double-counted into the serial counterfactual or win the bottleneck
@@ -283,7 +298,7 @@ class StageOccupancy:
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self._done: Dict[str, collections.deque] = {
-            name: collections.deque() for name in self.stages
+            name: collections.deque() for name in self.stages  # graftlint: disable=obs-unbounded-buffer — window-pruned: observe() popleft-drops samples older than window_s every append
         }
 
     def observe(self, rec: dict) -> None:

@@ -67,9 +67,18 @@ _HIGHER_BETTER_TOKENS = (
     # these leaves — listed explicitly so the gate's contract for the
     # series is spelled out, not an accident of substring overlap.
     "scaling_efficiency", "per_device_real_per_s",
+    # series-derived trend leaves (obs/series.py): a chunk/tile rate
+    # decaying across rounds IS a throughput regression. "rate" already
+    # matches; listed for the same spelled-out-contract reason.
+    "rate_per_s",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
-_LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts")
+# percentile latencies (series.jsonl quantiles -> bench JSON leaves
+# like dispatch.p95) and the telemetry layer's own cost
+# (obs.overhead_s) are lower-better: a fatter tail or a costlier
+# sampler is a regression even when the mean moved nowhere
+_LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
+                        "p50", "p95", "p99", "overhead")
 #: name fragments with NO better direction: jax.cost.* gauges are
 #: properties of the compiled program (flops per chunk changing is a
 #: workload change, not a perf verdict — even though "flops" is a
@@ -83,9 +92,14 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts")
 #: headroom the baseline left), not a score — "speedup" in its leaf
 #: must not read as higher-better; util_cores likewise describes the
 #: machine, not the code
+#: raw ring samples and trend-direction labels are observations, not
+#: scores: a series' sampled values must never be diffed as verdicts
+#: (flatten already drops the sample LISTS; these fragments catch any
+#: scalar that rides next to them, e.g. a samples-count or stride)
 _NO_DIRECTION_FRAGMENTS = (
     "jax.cost.", "flops_per_chunk", "duty", "intensity", "ridge",
     "wall_reduction_vs_serial", "attainable_speedup", "util_cores",
+    ".samples", ".stride", "dropped_series",
 )
 
 

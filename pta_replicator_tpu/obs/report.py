@@ -10,6 +10,9 @@ A telemetry directory (written by ``--telemetry DIR`` on the CLI, or by
 * ``meta.json``      — run context (argv, backend, device memory, ...)
 * ``progress.json``  — the flight recorder's last heartbeat (live runs)
 * ``postmortem.json`` — black box flushed on SIGTERM/SIGINT/crash
+* ``series.jsonl``   — decimated time-series history + streaming
+  percentiles (obs/series.py; rendered as sparkline/percentile
+  sections below)
 
 Every artifact is optional: a killed or still-running capture has only a
 subset, and a crash can truncate any of the JSON files mid-write — the
@@ -52,7 +55,7 @@ def load_telemetry(directory: str) -> dict:
     out = {
         "directory": directory, "events": [], "metrics": None,
         "meta": None, "progress": None, "postmortem": None,
-        "problems": [],
+        "series": None, "problems": [],
     }
     if not os.path.isdir(directory):
         out["problems"].append(f"{directory}: not a directory")
@@ -60,6 +63,14 @@ def load_telemetry(directory: str) -> dict:
     ev = os.path.join(directory, "events.jsonl")
     if os.path.exists(ev):
         out["events"] = load_events(ev)
+    sp = os.path.join(directory, "series.jsonl")
+    if os.path.exists(sp):
+        from .series import load_series
+
+        try:
+            out["series"] = load_series(sp)
+        except OSError as exc:
+            out["problems"].append(f"series.jsonl: unreadable ({exc})")
     for key, fname in (
         ("metrics", "metrics.json"),
         ("meta", "meta.json"),
@@ -194,6 +205,7 @@ def render_report(
             {"spans": agg, "metrics": metrics, "meta": data["meta"],
              "progress": data["progress"],
              "postmortem": data["postmortem"],
+             "series": data["series"],
              "utilization": occupancy.analyze(data["events"]),
              "problems": data["problems"]},
             indent=1, sort_keys=True,
@@ -227,6 +239,17 @@ def render_report(
     if util:
         parts.append("")
         parts.append(render_utilization(util))
+
+    if data["series"]:
+        trends = (data["progress"] or {}).get("trends")
+        section = render_series(data["series"], trends=trends)
+        if section:
+            parts.append("")
+            parts.append(section)
+        section = render_percentiles(data["series"])
+        if section:
+            parts.append("")
+            parts.append(section)
 
     # jax.roofline.* is excluded here: those gauges render once, in the
     # dedicated roofline section below (jax.cost.* stays — these raw
@@ -305,6 +328,90 @@ def render_report(
     parts.append("")
     parts.append(f"{len(agg)} distinct stages, {nspans} spans total")
     return "\n".join(parts)
+
+
+#: unicode block ramp for the series sparklines
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[float], width: int = 32) -> str:
+    """Fixed-width unicode sparkline of ``values`` (tail-sampled when
+    longer than ``width``; flat series render as a low bar)."""
+    if not values:
+        return ""
+    if len(values) > width:
+        step = len(values) / width
+        values = [values[int(i * step)] for i in range(width)]
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK_BLOCKS[0] * len(values)
+    scale = (len(_SPARK_BLOCKS) - 1) / (hi - lo)
+    return "".join(
+        _SPARK_BLOCKS[int((v - lo) * scale)] for v in values
+    )
+
+
+def _fmt_value(v: float) -> str:
+    if abs(v) >= 1e5 or (v and abs(v) < 1e-3):
+        return f"{v:.3g}"
+    return f"{int(v)}" if float(v).is_integer() else f"{v:.4g}"
+
+
+def render_series(series: dict, trends: Optional[dict] = None,
+                  width: int = 32) -> str:
+    """The report's series section from a loaded ``series.jsonl``: one
+    sparkline per sampled series (whole-run shape at the ring's
+    decimated resolution) with the latest value and — when the final
+    heartbeat carried them — the trailing-window rate/trend."""
+    rows = []
+    trends = trends or {}
+    for s in sorted(series.get("series") or [],
+                    key=lambda s: (s.get("name"), str(s.get("labels")))):
+        samples = s.get("samples") or []
+        if not samples:
+            continue
+        name = s["name"]
+        labels = s.get("labels") or {}
+        flat = name + (
+            "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            + "}" if labels else ""
+        )
+        values = [v for _, v in samples]
+        row = (f"  {flat:<40} {sparkline(values, width)}  "
+               f"latest {_fmt_value(values[-1])}")
+        tr = trends.get(flat) or {}
+        if tr.get("rate_per_s"):
+            row += f" ({tr['rate_per_s']:+.3g}/s)"
+        if tr.get("trend") and tr["trend"] != "flat":
+            row += f" [{tr['trend']}]"
+        if s.get("stride", 1) > 1:
+            row += f" (1:{s['stride']} decimated)"
+        rows.append(row)
+    if not rows:
+        return ""
+    return "series (sampled by the flight recorder):\n" + "\n".join(rows)
+
+
+def render_percentiles(series: dict) -> str:
+    """The report's latency-percentile section: p50/p95/p99 per span
+    name (streaming P² over every completed span) and per latency
+    histogram (bucket-interpolated), from series.jsonl's ``quantiles``
+    records."""
+    rows = []
+    for q in sorted(series.get("quantiles") or [],
+                    key=lambda q: (q.get("kind"), q.get("name"))):
+        if q.get("p50") is None:
+            continue
+        rows.append(
+            f"  {q.get('name', '?'):<32} "
+            f"p50 {_fmt_s(q['p50']):>10}  p95 {_fmt_s(q['p95']):>10}  "
+            f"p99 {_fmt_s(q['p99']):>10}  ({q.get('count', 0)} "
+            f"{'spans' if q.get('kind') == 'span' else 'obs'})"
+        )
+    if not rows:
+        return ""
+    return "latency percentiles (p50/p95/p99, streaming):\n" + \
+        "\n".join(rows)
 
 
 def render_utilization(util: dict) -> str:

@@ -1,0 +1,134 @@
+"""Live scrape endpoint over a capture directory: ``watch --serve``.
+
+A long tunnel run's health lives in files the flight recorder rewrites
+atomically every tick (``progress.json``, ``series.json``,
+``metrics.prom``). ``watch`` tails them in a terminal; this module
+exposes the same artifacts over stdlib HTTP so a Prometheus scraper, a
+dashboard, or a colleague's curl can follow the run without shell
+access to the box:
+
+* ``/metrics``  — Prometheus text exposition (the sampler's live
+  ``metrics.prom``; falls back to the ``finish_capture`` snapshot
+  after the run ends)
+* ``/progress`` — the current heartbeat JSON (also ``/progress.json``)
+* ``/series``   — the recent series windows + span percentiles (also
+  ``/series.json``)
+* ``/``         — a JSON index of the above
+
+Read-only by construction: GET/HEAD only, no path component of the URL
+ever touches the filesystem (every route maps to a fixed allowlisted
+filename inside the served directory), and binding defaults to
+loopback. Torn-read safety is inherited from the writer side: every
+served artifact is written via temp-file + ``os.replace``, so a
+request that races the sampler reads either the old or the new
+document, never a splice — ``tests/test_timeline_serve.py`` hammers
+exactly this.
+
+jax-free, stdlib-only, like the rest of the watch/report tooling.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import threading
+from typing import Tuple
+
+#: route -> (filename inside the capture dir, content type). The URL
+#: path is looked up here verbatim — there is no path traversal surface.
+ROUTES = {
+    "/metrics": ("metrics.prom", "text/plain; version=0.0.4"),
+    "/progress": ("progress.json", "application/json"),
+    "/progress.json": ("progress.json", "application/json"),
+    "/series": ("series.json", "application/json"),
+    "/series.json": ("series.json", "application/json"),
+    "/postmortem": ("postmortem.json", "application/json"),
+    "/postmortem.json": ("postmortem.json", "application/json"),
+}
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # the server is an observer: it must never block the run or spam
+    # its stderr with access logs
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stdlib log
+        pass
+
+    def _respond(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("Cache-Control", "no-store")
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib handler contract
+        path = self.path.split("?", 1)[0]
+        if path in ("/", "/index.json"):
+            body = json.dumps({
+                "directory": self.server.directory,
+                "endpoints": sorted(set(ROUTES)),
+            }, indent=1).encode()
+            self._respond(200, body, "application/json")
+            return
+        route = ROUTES.get(path)
+        if route is None:
+            self._respond(404, json.dumps({
+                "error": f"unknown endpoint {path!r}",
+                "endpoints": sorted(set(ROUTES)),
+            }).encode(), "application/json")
+            return
+        fname, ctype = route
+        try:
+            # one open+read of an atomic-replace artifact: a concurrent
+            # sampler tick swaps the inode, the open handle keeps the
+            # consistent old document (POSIX rename semantics)
+            with open(os.path.join(self.server.directory, fname),
+                      "rb") as fh:
+                body = fh.read()
+        except OSError:
+            self._respond(404, json.dumps({
+                "error": f"{fname} not written yet (run not started, "
+                         "or started without a flight recorder)",
+            }).encode(), "application/json")
+            return
+        self._respond(200, body, ctype)
+
+    def do_HEAD(self) -> None:  # noqa: N802
+        self.do_GET()
+
+
+class TelemetryServer(http.server.ThreadingHTTPServer):
+    """Threaded HTTP server bound to one capture directory."""
+
+    daemon_threads = True
+
+    def __init__(self, directory: str, address: Tuple[str, int]):
+        self.directory = os.path.abspath(directory)
+        super().__init__(address, _Handler)
+
+
+def serve_directory(
+    directory: str,
+    port: int,
+    host: str = "127.0.0.1",
+    background: bool = False,
+) -> TelemetryServer:
+    """Serve ``directory``'s live telemetry artifacts on ``host:port``.
+
+    ``background=True`` (the ``watch --serve`` path: the foreground
+    keeps tailing the heartbeat) runs ``serve_forever`` on a daemon
+    thread and returns immediately; otherwise the caller drives the
+    server (``serve_forever``/``shutdown``). Port 0 binds an ephemeral
+    port — read it back from ``server.server_address``."""
+    server = TelemetryServer(directory, (host, int(port)))
+    if background:
+        threading.Thread(
+            target=server.serve_forever, name="obs-serve", daemon=True
+        ).start()
+    return server
+
+
+def serve_url(server: TelemetryServer, route: str = "/") -> str:
+    host, port = server.server_address[:2]
+    return f"http://{host}:{port}{route}"

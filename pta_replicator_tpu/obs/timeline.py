@@ -1,0 +1,317 @@
+"""One correlated host+device timeline from a capture directory.
+
+A captured run leaves its evidence on two clocks in several artifacts:
+host spans in ``events.jsonl`` (wall-clock ``t0`` stamps), the chrome
+export of the same spans, and — when a managed
+:func:`..obs.devprof.device_trace` ran — a ``jax.profiler`` trace
+directory whose events ride the profiler's own microsecond clock.
+Scrubbing a wedged shard therefore meant two viewers and a hand-held
+clock offset. This module merges everything into ONE
+``chrome://tracing`` / Perfetto file:
+
+* **host spans** — every span record becomes a phase-"X" event, with
+  the staged-executor spans lifted onto named ``stage:*`` tracks.
+  Device-labeled stage spans (``cw_stream_stage{device=}`` from the
+  per-device mesh stagers) get one track PER DEVICE, and every stage
+  track carries an explicit ``thread_sort_index`` in dataflow order
+  (``occupancy.STAGE_SORT_ORDER``), so the merged view reads dispatch
+  -> drain -> io_write -> per-device staging top to bottom.
+* **chunk flow links** — the pipelined sweep stamps ``chunk=i`` into
+  its ``dispatch``/``drain``/``io_write`` span attrs; the merger emits
+  chrome flow events (``s``/``t``/``f`` sharing one id per chunk)
+  linking each chunk's dispatch to its drain to its checkpoint write.
+  A wedged shard is then one click along its arrow, not a grep over
+  events.jsonl. Sharded-sweep chunks carry the same ``chunk`` key, so
+  shard lineage rides the same links.
+* **device trace events** — every trace dir registered in meta.json's
+  ``device_traces`` is scanned for TensorBoard-format
+  ``*.trace.json(.gz)`` files; their events are shifted onto the wall
+  clock using the **correlation markers** the managed capture recorded
+  (``t_wall_open``/``t_wall_close`` on the ``device_trace`` span): the
+  trace's earliest event is anchored at ``t_wall_open``. Alignment
+  caveat (docs/observability.md): the anchor is exact at the open
+  marker; any profiler-clock drift across the session is not
+  corrected, so treat sub-millisecond host/device coincidences near
+  the end of a long trace with suspicion.
+
+jax-free and tolerant: every artifact is optional — a capture without
+device traces still merges (host-only), a missing events.jsonl yields
+an empty timeline with a problem note.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from . import names, occupancy
+from .report import load_telemetry
+
+#: synthetic tid base for stage tracks (matches Tracer.chrome_trace)
+_STAGE_TID_BASE = 1 << 22
+#: pid offset for merged device-trace processes: far above any real pid
+_DEVICE_PID_BASE = 1 << 21
+
+
+def _stage_order() -> List[str]:
+    return list(occupancy.STAGE_SORT_ORDER) + sorted(
+        set(occupancy.STAGES) - set(occupancy.STAGE_SORT_ORDER)
+    )
+
+
+class _StageTracks:
+    """Allocates one synthetic tid per (stage, device) pair, in dataflow
+    order: stage rank majors, device label minors — so per-device
+    staging lanes group under their stage, in device order."""
+
+    def __init__(self):
+        self.order = _stage_order()
+        self._tids: Dict[Tuple[str, str], int] = {}
+
+    def tid(self, stage: str, device: str = "") -> int:
+        key = (stage, device)
+        if key not in self._tids:
+            self._tids[key] = _STAGE_TID_BASE + len(self._tids)
+        return self._tids[key]
+
+    def metadata(self, pid: int) -> List[dict]:
+        ranked = sorted(
+            self._tids.items(),
+            key=lambda kv: (self.order.index(kv[0][0]), kv[0][1]),
+        )
+        out = []
+        for sort_index, ((stage, device), tid) in enumerate(ranked):
+            label = f"stage:{stage}" + (f":dev{device}" if device else "")
+            out.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": tid, "args": {"name": label},
+            })
+            out.append({
+                "name": "thread_sort_index", "ph": "M", "pid": pid,
+                "tid": tid, "args": {"sort_index": sort_index},
+            })
+        return out
+
+
+def _host_events(events: List[dict], pid: int) -> Tuple[list, list]:
+    """(trace events, flow events) from the span records. Flow events
+    link spans sharing a ``chunk`` attr across the pipeline stages."""
+    tracks = _StageTracks()
+    out: List[dict] = []
+    # chunk id -> [(stage rank, ts_us, tid)] for flow emission
+    chunk_points: Dict[object, List[Tuple[int, float, int]]] = {}
+    flow_order = {names.SPAN_DISPATCH: 0, names.SPAN_DRAIN: 1,
+                  names.SPAN_IO_WRITE: 2}
+    for rec in events:
+        if rec.get("type") != "span":
+            continue
+        name = rec.get("name")
+        attrs = rec.get("attrs") or {}
+        ts = float(rec.get("t0", 0.0)) * 1e6
+        dur = float(rec.get("wall_s", 0.0)) * 1e6
+        if name in occupancy.STAGES:
+            tid = tracks.tid(name, str(attrs.get("device", "")))
+        else:
+            tid = rec.get("tid", 0)
+        out.append({
+            "name": name, "cat": "host", "ph": "X",
+            "ts": ts, "dur": dur, "pid": pid, "tid": tid,
+            "args": {**attrs, "path": rec.get("path", name)},
+        })
+        if name in flow_order and "chunk" in attrs:
+            chunk_points.setdefault(attrs["chunk"], []).append(
+                (flow_order[name], ts + dur / 2.0, tid)
+            )
+    flows: List[dict] = []
+    for chunk, points in chunk_points.items():
+        points.sort()
+        if len(points) < 2:
+            continue
+        for i, (_rank, ts, tid) in enumerate(points):
+            ph = "s" if i == 0 else ("f" if i == len(points) - 1 else "t")
+            flow = {
+                "name": "chunk", "cat": "chunk", "ph": ph,
+                "id": int(chunk) if isinstance(chunk, (int, float))
+                else abs(hash(chunk)) % (1 << 31),
+                "ts": ts, "pid": pid, "tid": tid,
+            }
+            if ph == "f":
+                flow["bp"] = "e"  # bind to the enclosing slice
+            flows.append(flow)
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": pid,
+         "args": {"name": "host"}},
+        {"name": "process_sort_index", "ph": "M", "pid": pid,
+         "args": {"sort_index": 0}},
+    ] + tracks.metadata(pid)
+    return meta + out, flows
+
+
+def _correlation_markers(events: List[dict]) -> Dict[str, float]:
+    """logdir -> wall-clock open instant, from the ``device_trace``
+    span attrs (falling back to the span's own t0 for captures from
+    before the markers existed)."""
+    out: Dict[str, float] = {}
+    for rec in events:
+        if rec.get("type") != "span" or \
+                rec.get("name") != names.SPAN_DEVICE_TRACE:
+            continue
+        attrs = rec.get("attrs") or {}
+        logdir = attrs.get("logdir")
+        if not logdir:
+            continue
+        out[str(logdir)] = float(
+            attrs.get("t_wall_open", rec.get("t0", 0.0))
+        )
+    return out
+
+
+def _load_trace_file(path: str) -> Optional[dict]:
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt") as fh:
+                return json.load(fh)
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError, EOFError):
+        return None
+
+
+def _device_events(
+    trace_dir: str, wall_open: Optional[float], pid: int
+) -> Tuple[List[dict], List[str]]:
+    """Merge every ``*.trace.json(.gz)`` under ``trace_dir`` onto the
+    wall clock: the file set's earliest timestamp is anchored at
+    ``wall_open`` (no marker -> events pass through unshifted, with a
+    problem note). Source pids are remapped into a private range so
+    device processes can never collide with the host pid."""
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json*"),
+                  recursive=True)
+    )
+    problems: List[str] = []
+    raw_events: List[dict] = []
+    for p in paths:
+        doc = _load_trace_file(p)
+        if doc is None:
+            problems.append(f"{p}: unreadable trace file")
+            continue
+        evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+        if isinstance(evs, list):
+            raw_events.extend(e for e in evs if isinstance(e, dict))
+    if not raw_events:
+        if not paths:
+            problems.append(
+                f"{trace_dir}: no *.trace.json(.gz) files (profiler "
+                "wrote a different format, or the trace is empty)"
+            )
+        return [], problems
+    stamped = [e for e in raw_events
+               if isinstance(e.get("ts"), (int, float))]
+    offset_us = 0.0
+    if wall_open is not None and stamped:
+        t_min = min(e["ts"] for e in stamped)
+        offset_us = wall_open * 1e6 - t_min
+    elif wall_open is None:
+        problems.append(
+            f"{trace_dir}: no correlation marker (capture predates "
+            "t_wall_open) — device events left on the profiler clock"
+        )
+    pid_map: Dict[object, int] = {}
+    out: List[dict] = []
+    for e in raw_events:
+        e = dict(e)
+        src_pid = e.get("pid", 0)
+        if src_pid not in pid_map:
+            pid_map[src_pid] = pid + len(pid_map)
+        e["pid"] = pid_map[src_pid]
+        if isinstance(e.get("ts"), (int, float)):
+            e["ts"] = e["ts"] + offset_us
+        out.append(e)
+    label = os.path.basename(trace_dir.rstrip(os.sep)) or trace_dir
+    for src_pid, new_pid in pid_map.items():
+        out.append({
+            "name": "process_sort_index", "ph": "M", "pid": new_pid,
+            "args": {"sort_index": 10 + (new_pid - _DEVICE_PID_BASE)},
+        })
+        # keep the profiler's own process_name metas (already remapped
+        # above) but make the origin unmistakable in the merged view
+        out.append({
+            "name": "process_labels", "ph": "M", "pid": new_pid,
+            "args": {"labels": f"xla:{label}"},
+        })
+    return out, problems
+
+
+def build_timeline(directory: str) -> dict:
+    """Merge a capture directory into one chrome-trace object:
+    ``{"traceEvents": [...], "displayTimeUnit": "ms", "otherData":
+    {...}}``. Never raises on missing/partial artifacts — problems are
+    listed under ``otherData.problems``."""
+    data = load_telemetry(directory)
+    events = data["events"]
+    meta = data["meta"] or {}
+    pid = 0
+    for rec in events:
+        if rec.get("type") == "meta" and isinstance(rec.get("pid"), int):
+            pid = rec["pid"]
+            break
+    problems = list(data["problems"])
+    host, flows = _host_events(events, pid)
+    merged = host + flows
+
+    markers = _correlation_markers(events)
+    n_device = 0
+    trace_dirs = meta.get("device_traces") or []
+    for k, entry in enumerate(trace_dirs):
+        tdir = str(entry)
+        if not os.path.isabs(tdir):
+            tdir = os.path.join(directory, tdir)
+        if not os.path.isdir(tdir):
+            problems.append(f"device trace {entry!r} not found")
+            continue
+        wall_open = None
+        for logdir, t in markers.items():
+            if os.path.abspath(logdir) == os.path.abspath(tdir) or \
+                    os.path.basename(logdir) == os.path.basename(tdir):
+                wall_open = t
+                break
+        dev_events, dev_problems = _device_events(
+            tdir, wall_open, _DEVICE_PID_BASE + 1000 * k
+        )
+        n_device += sum(1 for e in dev_events if e.get("ph") != "M")
+        merged.extend(dev_events)
+        problems.extend(dev_problems)
+
+    n_spans = sum(1 for e in merged
+                  if e.get("ph") == "X" and e.get("cat") == "host")
+    return {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": directory,
+            "host_spans": n_spans,
+            "flow_events": len(flows),
+            "device_events": n_device,
+            "device_traces": len(trace_dirs),
+            "problems": problems,
+        },
+    }
+
+
+def write_timeline(directory: str, out: Optional[str] = None,
+                   doc: Optional[dict] = None) -> str:
+    """The ``timeline DIR`` CLI body: build and write the merged trace
+    (default ``<dir>/timeline.json``); returns the path written. Pass
+    ``doc`` to write an already-built document (the CLI builds once for
+    its summary and delegates the write here)."""
+    if doc is None:
+        doc = build_timeline(directory)
+    path = out or os.path.join(directory, "timeline.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return path

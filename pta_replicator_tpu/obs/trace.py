@@ -344,13 +344,22 @@ class Tracer:
         lifted onto one synthetic, named track per stage — so the
         pipeline's utilization reads as contiguous per-stage lanes
         (gaps = idle) instead of being scattered across whatever worker
-        thread ids the executor happened to spawn."""
+        thread ids the executor happened to spawn.
+
+        Each used stage track also carries an explicit
+        ``thread_sort_index`` in dataflow order
+        (``occupancy.STAGE_SORT_ORDER``: dispatch -> drain -> io_write,
+        prefetch staging after), so viewers render the pipeline top to
+        bottom in pipeline order rather than dict/tid order."""
         from . import occupancy
 
         pid = os.getpid()
+        stage_order = list(occupancy.STAGE_SORT_ORDER) + sorted(
+            set(occupancy.STAGES) - set(occupancy.STAGE_SORT_ORDER)
+        )
         stage_tid = {
             name: self._STAGE_TID_BASE + i
-            for i, name in enumerate(sorted(occupancy.STAGES))
+            for i, name in enumerate(stage_order)
         }
         used_stages = set()
         trace_events = []
@@ -370,13 +379,17 @@ class Tracer:
                 "tid": tid,
                 "args": {**rec["attrs"], "path": rec["path"]},
             })
-        meta_events = [
-            {
+        meta_events = []
+        for name in sorted(used_stages, key=stage_order.index):
+            meta_events.append({
                 "name": "thread_name", "ph": "M", "pid": pid,
                 "tid": stage_tid[name], "args": {"name": f"stage:{name}"},
-            }
-            for name in sorted(used_stages)
-        ]
+            })
+            meta_events.append({
+                "name": "thread_sort_index", "ph": "M", "pid": pid,
+                "tid": stage_tid[name],
+                "args": {"sort_index": stage_order.index(name)},
+            })
         return {
             "traceEvents": meta_events + trace_events,
             "displayTimeUnit": "ms",

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# The pre-push gate: one command that runs every fast, fixture-free
+# check a builder should pass before pushing (docs/observability.md
+# "Keeping the schema honest" and docs/static-analysis.md both point
+# here).
+#
+#   scripts/check.sh            # lint changed files + schema + obs tests
+#   CHECK_FULL=1 scripts/check.sh   # lint the whole tree instead
+#
+# Exit nonzero on the first failing gate. Deliberately CPU-only and
+# reference-fixture-free: everything here runs in seconds on a laptop
+# or in CI with no TPU and no /root/reference tree.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graftlint =="
+if [ "${CHECK_FULL:-0}" = "1" ]; then
+    python -m pta_replicator_tpu lint
+else
+    python -m pta_replicator_tpu lint --changed-only
+fi
+
+echo "== telemetry schema =="
+python scripts/check_telemetry_schema.py
+
+echo "== obs/analysis test subset (fixture-free) =="
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_obs.py tests/test_flightrec.py tests/test_occupancy.py \
+    tests/test_series.py tests/test_timeline_serve.py \
+    tests/test_analysis.py tests/test_pipeline.py
+
+echo "check.sh: all gates green"

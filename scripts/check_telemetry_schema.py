@@ -129,8 +129,9 @@ def generate_sample(directory: str) -> str:
 
 #: heartbeat fields only required from the given PROGRESS_SCHEMA
 #: version on — a v1 capture (pre-occupancy) must keep validating
-#: ("readers stay tolerant of v1 files", obs/flightrec.py)
-_FIELD_SINCE_VERSION = {"occupancy": 2}
+#: ("readers stay tolerant of v1 files", obs/flightrec.py). v3 added
+#: the series-derived "trends" block.
+_FIELD_SINCE_VERSION = {"occupancy": 2, "trends": 3}
 
 
 def _validate_shape(path: str, doc, schema: dict, kind: str) -> list:
@@ -203,6 +204,79 @@ def validate_flightrec_file(path: str, kind: str) -> list:
     return problems
 
 
+def validate_series_file(path: str) -> list:
+    """Validate a ``series.jsonl`` capture artifact (obs/series.py's
+    SERIES_SCHEMA): every line is a known record kind carrying its
+    required fields, sample lists are [t, value] numeric pairs, and the
+    stream opens with the ``series_meta`` line. A truncated final line
+    (killed run caught mid-write of the postmortem series flush) is
+    legal, mirroring the events.jsonl rule."""
+    from pta_replicator_tpu.obs.series import SERIES_SCHEMA
+
+    problems = []
+    with open(path) as fh:
+        lines = fh.readlines()
+    first_kind = None
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if lineno == len(lines):
+                continue  # truncated final line of a killed run
+            problems.append(f"{path}:{lineno}: unparseable JSON")
+            continue
+        kind = rec.get("type")
+        if first_kind is None:
+            first_kind = kind
+        schema = SERIES_SCHEMA.get(kind)
+        if schema is None:
+            problems.append(
+                f"{path}:{lineno}: unknown record type {kind!r} "
+                "(add it to obs.series.SERIES_SCHEMA)"
+            )
+            continue
+        for field, ftype in schema.items():
+            if field not in rec:
+                problems.append(
+                    f"{path}:{lineno}: {kind} record missing {field!r}"
+                )
+            elif ftype is float:
+                if not isinstance(rec[field], (int, float)) or isinstance(
+                    rec[field], bool
+                ):
+                    problems.append(
+                        f"{path}:{lineno}: {kind}.{field} not numeric"
+                    )
+            elif not isinstance(rec[field], ftype) or (
+                ftype is int and isinstance(rec[field], bool)
+            ):
+                problems.append(
+                    f"{path}:{lineno}: {kind}.{field} is "
+                    f"{type(rec[field]).__name__}, expected "
+                    f"{ftype.__name__}"
+                )
+        for pair in rec.get("samples") or []:
+            if (
+                not isinstance(pair, list) or len(pair) != 2
+                or not all(isinstance(x, (int, float))
+                           and not isinstance(x, bool) for x in pair)
+            ):
+                problems.append(
+                    f"{path}:{lineno}: malformed sample {pair!r} "
+                    "(expected [t_wall, value])"
+                )
+                break
+    if first_kind is not None and first_kind != "series_meta":
+        problems.append(
+            f"{path}: first record is {first_kind!r}, expected the "
+            "series_meta header line"
+        )
+    return problems
+
+
 def validate_device_traces(directory: str) -> list:
     """A capture's meta.json may register managed jax.profiler trace
     dirs (obs.devprof.device_trace). Each registered path — relative
@@ -265,6 +339,9 @@ def main(argv=None) -> int:
                 p = os.path.join(target, fname)
                 if os.path.exists(p):
                     problems += validate_flightrec_file(p, kind)
+            series_path = os.path.join(target, "series.jsonl")
+            if os.path.exists(series_path):
+                problems += validate_series_file(series_path)
             problems += validate_device_traces(target)
             target = os.path.join(target, "events.jsonl")
         problems += validate_events(target)
@@ -274,6 +351,10 @@ def main(argv=None) -> int:
         with tempfile.TemporaryDirectory() as d:
             for path, kind in generate_flightrec_sample(d):
                 problems += validate_flightrec_file(path, kind)
+            # the postmortem flush also leaves the series history
+            series_path = os.path.join(d, "series.jsonl")
+            if os.path.exists(series_path):
+                problems += validate_series_file(series_path)
 
     if problems:
         for p in problems:

@@ -657,3 +657,147 @@ def test_shim_check_entrypoints_delegates_to_engine():
     checker = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(checker)
     assert checker.check_entrypoints() == []
+
+
+# ----------------------------------------------------------- obs pack
+
+def test_unbounded_buffer_fires_in_threaded_obs_module(tmp_path):
+    """obs-unbounded-buffer: an unbounded deque() and bare list growth
+    on module/instance state inside a threaded obs/ module both fire,
+    each anchored to its own line."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    src = """
+        import collections
+        import threading
+
+        _EVENTS = []
+
+        class Buf:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._log = []
+                self._ring = collections.deque()
+
+            def start(self):
+                threading.Thread(target=self.loop).start()
+
+            def loop(self, rec):
+                self._log.append(rec)
+                _EVENTS.append(rec)
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"pta_replicator_tpu/obs/bad.py": src},
+        rules_obs.RULES,
+    )
+    assert rule_ids(findings) == ["obs-unbounded-buffer"] * 3
+    msgs = " | ".join(f.message for f in findings)
+    assert "deque() without maxlen" in msgs
+    assert "'_log'" in msgs and "'_EVENTS'" in msgs
+
+
+def test_unbounded_buffer_respects_bounding_evidence(tmp_path):
+    """Non-firing shapes: maxlen deques, len-capped appends, membership
+    guards, pruned buffers, plain function locals — and the whole rule
+    stands down outside obs/ or in unthreaded modules."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    bounded = """
+        import collections
+        import threading
+
+        class Buf:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ring = collections.deque(maxlen=256)
+                self._events = []
+                self._listeners = []
+                self._window = []
+
+            def start(self):
+                threading.Thread(target=self.loop).start()
+
+            def loop(self, rec, fn, cutoff):
+                self._ring.append(rec)
+                if len(self._events) < 1000:
+                    self._events.append(rec)
+                if fn not in self._listeners:
+                    self._listeners.append(fn)
+                self._window.append(rec)
+                while self._window and self._window[0] < cutoff:
+                    self._window.pop(0)
+                local = []
+                local.append(rec)
+    """
+    outside_obs = """
+        import collections
+        import threading
+
+        _Q = collections.deque()
+        BUF = []
+
+        def grow(x):
+            BUF.append(x)
+
+        threading.Thread(target=grow).start()
+    """
+    unthreaded = """
+        import collections
+
+        _Q = collections.deque()
+        BUF = []
+
+        def grow(x):
+            BUF.append(x)
+    """
+    findings, _ = lint_tree(
+        tmp_path,
+        {
+            "pta_replicator_tpu/obs/bounded.py": bounded,
+            "pta_replicator_tpu/parallel/elsewhere.py": outside_obs,
+            "pta_replicator_tpu/obs/unthreaded.py": unthreaded,
+        },
+        rules_obs.RULES,
+    )
+    assert findings == []
+
+
+def test_unbounded_buffer_suppression_is_the_escape_hatch(tmp_path):
+    """The intentionally-pruned shapes in the real tree (occupancy's
+    window deques, devprof's per-capture trace list) ride inline
+    suppressions — verify the mechanism works for this rule id."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    src = """
+        import collections
+        import threading
+
+        class Win:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._dq = {k: collections.deque() for k in "ab"}  # graftlint: disable=obs-unbounded-buffer
+
+            def start(self):
+                threading.Thread(target=self.start).start()
+    """
+    findings, suppressed = lint_tree(
+        tmp_path, {"pta_replicator_tpu/obs/win.py": src}, rules_obs.RULES,
+    )
+    assert findings == []
+    assert rule_ids(suppressed) == ["obs-unbounded-buffer"]
+
+
+def test_unbounded_buffer_clean_on_real_obs_tree():
+    """The shipped obs/ package lints clean under the new rule with an
+    EMPTY baseline delta: the series rings are provably bounded, and
+    every intentionally-pruned structure carries its inline reason."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    pkg = os.path.join(REPO, "pta_replicator_tpu", "obs")
+    files = engine.iter_python_files([pkg], REPO)
+    mods, problems = engine.parse_modules(files, REPO)
+    active, suppressed = engine.run_rules(mods, rules_obs.RULES)
+    assert problems == []
+    assert active == [], [f.format() for f in active]
+    # the escape hatch is in use (occupancy/devprof), with reasons
+    assert any(f.rule == "obs-unbounded-buffer" for f in suppressed)

@@ -676,12 +676,22 @@ def test_chrome_trace_lifts_stage_spans_onto_named_tracks():
         pass
     with tracer.span("my_custom"):  # graftlint: disable=telemetry-unknown-name
         pass
+    with tracer.span("dispatch"):  # graftlint: disable=telemetry-unknown-name
+        pass
     doc = tracer.chrome_trace()
     metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
-    named = {e["tid"]: e["args"]["name"] for e in metas}
+    named = {e["tid"]: e["args"]["name"] for e in metas
+             if e["name"] == "thread_name"}
+    sort_index = {e["tid"]: e["args"]["sort_index"] for e in metas
+                  if e["name"] == "thread_sort_index"}
     spans = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
     # the stage span rides its named synthetic track...
     assert named[spans["drain"]["tid"]] == "stage:drain"
+    # ...carrying an explicit sort_index in dataflow order (dispatch
+    # before drain, whatever their tids or dict order say)
+    assert set(sort_index) == set(named)
+    assert sort_index[spans["dispatch"]["tid"]] < \
+        sort_index[spans["drain"]["tid"]]
     # ...while a non-stage span keeps its real thread id
     assert spans["my_custom"]["tid"] == threading.get_ident()
     assert spans["my_custom"]["tid"] not in named

@@ -1,0 +1,331 @@
+"""Temporal telemetry layer (obs/series.py + flightrec wiring):
+bounded decimating rings, streaming percentiles, trends in the v3
+heartbeat, series.jsonl persistence, and the telemetry-overhead
+accounting. Fixture-free and CPU-only — part of the scripts/check.sh
+pre-push subset."""
+import json
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from pta_replicator_tpu import obs
+from pta_replicator_tpu.obs import flightrec, names
+from pta_replicator_tpu.obs.metrics import MetricsRegistry
+from pta_replicator_tpu.obs.series import (
+    P2Quantile,
+    Ring,
+    SeriesRecorder,
+    load_series,
+    quantiles_from_histogram,
+)
+
+
+def _load_checker():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    return checker
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset_all()
+    yield
+    obs.reset_all()
+
+
+# ------------------------------------------------------------- rings
+
+def test_ring_hammer_stays_within_budget():
+    """10k samples into a 256-budget ring: the retained count (and the
+    derived byte estimate) never exceeds the budget at ANY point, the
+    stride is a power of two, and the retained history still spans the
+    whole input range (decimation coarsens, it does not forget)."""
+    ring = Ring(budget=256)
+    for i in range(10_000):
+        ring.offer(float(i), float(i))
+        assert len(ring) <= 256
+        assert ring.nbytes() <= 256 * Ring.SAMPLE_NBYTES
+    assert ring.stride & (ring.stride - 1) == 0  # power of two
+    ts = [t for t, _ in ring.samples]
+    assert ts[0] == 0.0                  # oldest sample survives
+    assert ts[-1] >= 10_000 - ring.stride  # newest within one stride
+    assert ts == sorted(ts)
+
+
+def test_ring_no_overflow_keeps_every_sample():
+    ring = Ring(budget=64)
+    for i in range(50):
+        ring.offer(float(i), float(2 * i))
+    assert len(ring) == 50 and ring.stride == 1
+    assert ring.samples[7] == (7.0, 14.0)
+
+
+def test_ring_rejects_tiny_budget():
+    with pytest.raises(ValueError):
+        Ring(budget=2)
+
+
+# -------------------------------------------------------- percentiles
+
+def test_p2_quantile_tracks_numpy():
+    rng = random.Random(7)
+    vals = [rng.gauss(10.0, 3.0) for _ in range(20_000)]
+    for p in (0.5, 0.95, 0.99):
+        est = P2Quantile(p)
+        for v in vals:
+            est.observe(v)
+        true = float(np.percentile(vals, 100 * p))
+        spread = float(np.std(vals))
+        assert abs(est.value - true) < 0.1 * spread, (p, est.value, true)
+
+
+def test_p2_quantile_small_counts_exact():
+    est = P2Quantile(0.5)
+    for v in (5.0, 1.0, 3.0):
+        est.observe(v)
+    assert est.value == 3.0
+    assert P2Quantile(0.5).value is None
+
+
+def test_quantiles_from_histogram_interpolates():
+    # two buckets: 50 obs uniform in (0, 1], 50 in (1, 2]
+    buckets = (1.0, 2.0)
+    counts = [50, 50, 0]  # incl. +Inf tail
+    qs = quantiles_from_histogram(buckets, counts)
+    assert qs["p50"] == pytest.approx(1.0, abs=0.05)
+    assert qs["p95"] == pytest.approx(1.9, abs=0.05)
+    assert quantiles_from_histogram(buckets, [0, 0, 0]) == {}
+
+
+# ----------------------------------------------------- series recorder
+
+def test_recorder_samples_labeled_families_and_skips_optouts():
+    reg = MetricsRegistry()
+    rec = SeriesRecorder(reg)
+    reg.gauge(names.OCCUPANCY_DUTY_CYCLE, stage="drain").set(0.8)
+    reg.gauge(names.OCCUPANCY_DUTY_CYCLE, stage="io_write").set(0.2)
+    reg.counter(names.CW_STREAM_BYTES_STAGED, device="0").inc(100)
+    reg.counter(names.IO_TIM_TOAS).inc(5)  # io.* is not opted in
+    rec.sample()
+    flat = set()
+    for (name, labels) in rec._rings:
+        flat.add((name, tuple(labels)))
+    assert (names.OCCUPANCY_DUTY_CYCLE, (("stage", "drain"),)) in flat
+    assert (names.OCCUPANCY_DUTY_CYCLE, (("stage", "io_write"),)) in flat
+    assert any(n == names.CW_STREAM_BYTES_STAGED for n, _ in flat)
+    assert not any(n == names.IO_TIM_TOAS for n, _ in flat)
+
+
+def test_recorder_byte_budget_under_hammer():
+    """10k sampling ticks over several series: total retained bytes stay
+    under the recorder's hard bound, and the per-series cap drops new
+    series instead of growing without limit."""
+    reg = MetricsRegistry()
+    rec = SeriesRecorder(reg, ring_budget=64, max_series=8)
+    g = reg.gauge(names.SWEEP_CHUNKS_DONE)
+    for d in range(12):  # 12 labeled instances > max_series 8
+        reg.counter(names.CW_STREAM_BYTES_STAGED, device=str(d)).inc()
+    for i in range(10_000):
+        g.set(i)
+        rec.sample()
+    bound = 8 * 64 * Ring.SAMPLE_NBYTES
+    assert rec.nbytes() <= bound
+    assert len(rec._rings) <= 8
+    assert rec._dropped_series > 0
+    for entry in rec._rings.values():
+        assert len(entry["ring"]) <= 64
+
+
+def test_recorder_trends_rate_and_direction():
+    reg = MetricsRegistry()
+    rec = SeriesRecorder(reg)
+    c = reg.counter(names.SWEEP_CHUNKS_DONE)
+    g = reg.gauge(names.SWEEP_INFLIGHT_CHUNKS)
+    # synthesize rising counter + falling gauge by driving sample()
+    for i in range(10):
+        c.inc(5)
+        g.set(100 - 10 * i)
+        rec.sample()
+        time.sleep(0.01)
+    trends = rec.trends(window_s=60.0)
+    up = trends[names.SWEEP_CHUNKS_DONE]
+    down = trends[names.SWEEP_INFLIGHT_CHUNKS]
+    assert up["rate_per_s"] > 0 and up["trend"] == "rising"
+    assert down["rate_per_s"] < 0 and down["trend"] == "falling"
+    assert up["latest"] == 50
+
+
+def test_recorder_span_quantiles_bounded_names():
+    rec = SeriesRecorder(MetricsRegistry())
+    for i in range(rec.MAX_SPAN_NAMES + 10):
+        rec.observe_span({"type": "span", "name": f"s{i}", "wall_s": 0.1})
+    assert len(rec._span_q) == rec.MAX_SPAN_NAMES
+    for _ in range(100):
+        rec.observe_span({"type": "span", "name": "s0", "wall_s": 0.25})
+    q = rec.span_quantiles()["s0"]
+    assert q["count"] == 101
+    assert q["p50"] == pytest.approx(0.25, rel=0.2)
+
+
+def test_series_jsonl_roundtrip_and_schema(tmp_path):
+    reg = MetricsRegistry()
+    rec = SeriesRecorder(reg)
+    g = reg.gauge(names.SWEEP_CHUNKS_DONE)
+    reg.histogram(names.JAX_COMPILE_S).observe(0.5)
+    for i in range(20):
+        g.set(i)
+        rec.sample()
+        rec.observe_span({"type": "span", "name": "dispatch",
+                          "wall_s": 0.01 * (i + 1)})
+    path = str(tmp_path / "series.jsonl")
+    rec.write_jsonl(path)
+    doc = load_series(path)
+    assert doc["meta"]["schema"] == 1
+    by_name = {s["name"]: s for s in doc["series"]}
+    assert len(by_name[names.SWEEP_CHUNKS_DONE]["samples"]) == 20
+    # wall-clock stamps (comparable with span t0), oldest first
+    ts = [t for t, _ in by_name[names.SWEEP_CHUNKS_DONE]["samples"]]
+    assert ts == sorted(ts) and abs(ts[-1] - time.time()) < 60
+    kinds = {(q["name"], q["kind"]) for q in doc["quantiles"]}
+    assert ("dispatch", "span") in kinds
+    assert (names.JAX_COMPILE_S, "histogram") in kinds
+    # and the schema checker accepts the artifact
+    checker = _load_checker()
+    assert checker.validate_series_file(path) == []
+
+
+def test_series_schema_checker_rejects_malformed(tmp_path):
+    checker = _load_checker()
+    p = tmp_path / "series.jsonl"
+    p.write_text(json.dumps({"type": "series", "name": "x"}) + "\n")
+    problems = checker.validate_series_file(str(p))
+    assert any("missing" in x for x in problems)
+    assert any("series_meta" in x for x in problems)
+    p.write_text(
+        json.dumps({"type": "series_meta", "schema": 1, "t0": 1.0,
+                    "pid": 1}) + "\n"
+        + json.dumps({"type": "series", "name": "x", "labels": {},
+                      "kind": "gauge", "stride": 1,
+                      "samples": [[1.0, "oops"]]}) + "\n"
+    )
+    problems = checker.validate_series_file(str(p))
+    assert any("malformed sample" in x for x in problems)
+
+
+# ------------------------------------------------ flightrec integration
+
+def test_heartbeat_v3_has_trends_and_validates(tmp_path):
+    d = str(tmp_path / "cap")
+    obs.start_capture(d, heartbeat_interval_s=0.05, stall_timeout_s=None)
+    try:
+        for i in range(6):
+            with obs.span(names.SPAN_DISPATCH, chunk=i):
+                obs.gauge(names.SWEEP_CHUNKS_DONE).set(i)
+            time.sleep(0.06)
+    finally:
+        obs.finish_capture()
+    hb = json.loads((tmp_path / "cap" / "progress.json").read_text())
+    assert hb["schema"] == flightrec.PROGRESS_SCHEMA_VERSION >= 3
+    assert isinstance(hb["trends"], dict)
+    assert names.SWEEP_CHUNKS_DONE in hb["trends"]
+    assert "latest" in hb["trends"][names.SWEEP_CHUNKS_DONE]
+    checker = _load_checker()
+    assert checker.validate_flightrec_file(
+        str(tmp_path / "cap" / "progress.json"), "progress") == []
+    # the capture also leaves the series history + live artifacts
+    assert checker.validate_series_file(
+        str(tmp_path / "cap" / "series.jsonl")) == []
+    assert (tmp_path / "cap" / "series.json").exists()
+    assert (tmp_path / "cap" / "metrics.prom").exists()
+
+
+def test_overhead_counter_accrues_and_stays_small(tmp_path):
+    """The sampler self-accounts its tick CPU cost into obs.overhead_s;
+    at a 50 ms cadence over ~0.6 s the counter must exist, be sampled
+    as a series, and stay far below the wall. The lower bound is >= 0
+    rather than > 0 on purpose: CLOCK_THREAD_CPUTIME_ID is ~10 ms
+    granular on older kernels, so a dozen cheap ticks can legitimately
+    read zero CPU (the <1%-of-step claim itself is measured over a
+    30 s steady-state window by bench.py)."""
+    d = str(tmp_path / "cap")
+    t0 = time.monotonic()
+    obs.start_capture(d, heartbeat_interval_s=0.05, stall_timeout_s=None)
+    try:
+        time.sleep(0.6)
+    finally:
+        obs.finish_capture()
+    wall = time.monotonic() - t0
+    metrics = json.loads((tmp_path / "cap" / "metrics.json").read_text())
+    assert names.OBS_OVERHEAD_S in metrics  # the accounting is wired
+    overhead = metrics[names.OBS_OVERHEAD_S][0]["value"]
+    assert 0.0 <= overhead < 0.5 * wall
+    # and it was itself sampled as a series
+    series = load_series(str(tmp_path / "cap" / "series.jsonl"))
+    assert any(s["name"] == names.OBS_OVERHEAD_S for s in series["series"])
+
+
+def test_postmortem_flush_writes_series(tmp_path):
+    d = str(tmp_path / "cap")
+    os.makedirs(d)
+    rec = flightrec.FlightRecorder(d, stall_timeout_s=None)
+    rec.series.sample()
+    rec.write_postmortem("test")
+    assert os.path.exists(os.path.join(d, "series.jsonl"))
+    checker = _load_checker()
+    assert checker.validate_series_file(
+        os.path.join(d, "series.jsonl")) == []
+
+
+def test_report_renders_series_sections(tmp_path):
+    d = str(tmp_path / "cap")
+    obs.start_capture(d, heartbeat_interval_s=0.05, stall_timeout_s=None)
+    try:
+        for i in range(5):
+            with obs.span(names.SPAN_DISPATCH, chunk=i):
+                obs.gauge(names.SWEEP_CHUNKS_DONE).set(i)
+            time.sleep(0.06)
+    finally:
+        obs.finish_capture()
+    from pta_replicator_tpu.obs.report import render_report
+
+    out = render_report(d)
+    assert "series (sampled by the flight recorder):" in out
+    assert names.SWEEP_CHUNKS_DONE in out
+    assert "latency percentiles" in out
+    assert "p95" in out
+    as_json = json.loads(render_report(d, as_json=True))
+    assert as_json["series"]["meta"]["schema"] == 1
+
+
+def test_sparkline_shapes():
+    from pta_replicator_tpu.obs.report import sparkline
+
+    assert sparkline([]) == ""
+    assert sparkline([1.0, 1.0, 1.0]) == "▁▁▁"
+    s = sparkline(list(range(100)), width=16)
+    assert len(s) == 16 and s[0] == "▁" and s[-1] == "█"
+
+
+# -------------------------------------------------- bench-diff classes
+
+def test_regress_directions_for_series_leaves():
+    from pta_replicator_tpu.obs.regress import metric_direction
+
+    assert metric_direction("dispatch.p95") is False
+    assert metric_direction("telemetry.quantiles.io_write.p99") is False
+    assert metric_direction("obs.overhead_s") is False
+    assert metric_direction("obs_overhead.overhead_pct_of_step") is False
+    assert metric_direction("trends.sweep.chunks_done.rate_per_s") is True
+    # raw ring observations are info, never verdicts
+    assert metric_direction("series.sweep.chunks_done.stride") is None
+    assert metric_direction("series.dropped_series") is None
