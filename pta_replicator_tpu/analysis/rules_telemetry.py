@@ -235,6 +235,27 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/parallel/mesh.py", "metric", n.SWEEP_SHARDS_INFLIGHT),
         (f"{pkg}/models/batched.py", "span", n.SPAN_CW_STREAM_RESPONSE),
         (f"{pkg}/models/batched.py", "metric", n.CW_STREAM_TILES_DONE),
+        # likelihood subsystem (ISSUE 9): the serving path's SLO
+        # telemetry (request/batch/eval counters, coalescing gauge,
+        # queue depth, the serve/batch/project spans) and the two
+        # engine jit labels — the simulate-infer loop's instrumentation
+        # must not silently un-instrument
+        (f"{pkg}/likelihood/serve.py", "span", n.SPAN_LIKELIHOOD_SERVE),
+        (f"{pkg}/likelihood/serve.py", "span", n.SPAN_LIKELIHOOD_BATCH),
+        (f"{pkg}/likelihood/serve.py", "span",
+         n.SPAN_LIKELIHOOD_PROJECT),
+        (f"{pkg}/likelihood/serve.py", "metric", n.LIKELIHOOD_REQUESTS),
+        (f"{pkg}/likelihood/serve.py", "metric", n.LIKELIHOOD_BATCHES),
+        (f"{pkg}/likelihood/serve.py", "metric",
+         n.LIKELIHOOD_BATCH_SIZE),
+        (f"{pkg}/likelihood/serve.py", "metric", n.LIKELIHOOD_EVALS),
+        (f"{pkg}/likelihood/serve.py", "metric",
+         n.LIKELIHOOD_COALESCE_EFFICIENCY),
+        (f"{pkg}/likelihood/serve.py", "metric",
+         n.LIKELIHOOD_QUEUE_DEPTH),
+        (f"{pkg}/likelihood/infer.py", "jit", n.JIT_LIKELIHOOD_ENGINE),
+        (f"{pkg}/likelihood/infer.py", "jit",
+         n.JIT_LIKELIHOOD_REDUCED_ENGINE),
         (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),
         (f"{pkg}/obs/flightrec.py", "event", n.EVENT_FLIGHTREC_STALL),
         # stage-occupancy + device-cost layer (PR 6): the heartbeat's

@@ -1,0 +1,46 @@
+"""likelihood/ — rank-reduced GP likelihood engine + simulate-infer
+serving (ROADMAP open item 1: the repo's first CONSUMER of the
+realizations it synthesizes).
+
+Three layers, bottom-up:
+
+* :mod:`.gp` — the math: the rank-reduced Gaussian-process
+  log-likelihood under the same noise model the injections use
+  (white/ECORR/red-noise/GWB, timing model marginalized analytically),
+  Woodbury-evaluated so the hot path is a small Cholesky over the
+  reduced basis; a :class:`~.gp.ReducedGP` precompute for fixed-noise
+  serving; a dense-covariance numpy oracle for tests.
+* :mod:`.infer` — drivers: vmapped hyperparameter grids (auto-routed
+  to the ReducedGP fast path), BFGS MAP fits with Fisher-matrix
+  uncertainties, realization-bank evaluation sharded across the mesh.
+* :mod:`.serve` — the service: request-batched evaluation over
+  precomputed realization banks (sweep checkpoints loaded through the
+  prefetch layer), deadline/size coalescing into device-shaped
+  batches, per-request futures, SLO telemetry (latency percentiles,
+  coalescing efficiency, evals/s) on the obs stack.
+
+docs/likelihood.md walks the math and the serving model;
+benchmarks/likelihood_serve.py is the bench ladder.
+"""
+from .gp import (
+    ReducedGP,
+    dense_loglikelihood,
+    loglikelihood,
+    phi_for_recipe,
+)
+from .infer import (
+    MapResult,
+    bank_loglikelihood,
+    grid_cartesian,
+    grid_loglikelihood,
+    map_fit,
+)
+from .serve import LikelihoodServer, RealizationBank, project_bank
+
+__all__ = [
+    "loglikelihood", "dense_loglikelihood", "ReducedGP",
+    "phi_for_recipe",
+    "grid_loglikelihood", "grid_cartesian", "bank_loglikelihood",
+    "map_fit", "MapResult",
+    "LikelihoodServer", "RealizationBank", "project_bank",
+]

@@ -1,0 +1,462 @@
+"""Rank-reduced Gaussian-process PTA log-likelihood.
+
+The simulate side of the repo injects signals whose covariance is
+exactly the rank-reduced model of ``models.batched.gls_noise_model``:
+
+    C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T
+
+with N the EFAC/EQUAD white diagonal, U_ec the disjoint ECORR epoch
+indicators, and U the stacked low-rank Fourier blocks (achromatic red
+noise, chromatic noise, the injected GWB's per-pulsar auto-term) with
+their power-law prior variances phi. This module closes the
+simulate->infer loop (ROADMAP open item 1; the lightning-fast
+rank-reduced likelihood of arXiv:2607.06834): the Gaussian
+log-likelihood of residuals under that covariance, with the timing
+model analytically marginalized, evaluated via the Woodbury identity so
+the hot path is a small Cholesky over the rank-reduced basis — batched
+(Nt x R) MXU contractions plus an (R, R) factorization per pulsar,
+never an (Nt, Nt) dense solve.
+
+Three evaluation tiers:
+
+* :func:`loglikelihood` — the direct rank-reduced evaluation, jit- and
+  vmap-safe over residuals AND over hyperparameter batches (every
+  Recipe array leaf may be traced).
+* :class:`ReducedGP` — the serving hot path: for grids/requests that
+  hold the WHITE noise fixed (the common case — hyperparameter sweeps
+  over red-noise/GWB amplitudes and slopes), every Nt-sized contraction
+  is precomputed once (``T^T C0^-1 T``, and per-residual projections
+  ``T^T C0^-1 r`` / ``r^T C0^-1 r``); each subsequent evaluation costs
+  one (R, R) Cholesky per pulsar and nothing proportional to Nt at
+  all. This is what lets a realization bank be priced at thousands of
+  hyperparameter points per second (likelihood/serve.py).
+* :func:`dense_loglikelihood` — the oracle-grade numpy float64
+  reference: builds the dense (Nt, Nt) covariance per pulsar and pays
+  the O(Nt^3) factorization. Exists for tests (the Woodbury path must
+  match it to <= 1e-8 relative — tests/test_likelihood.py) and for
+  nothing else.
+
+Timing-model marginalization uses the exact flat-prior identity (not a
+large-but-finite prior variance, which would wreck the conditioning of
+the dense oracle it must be compared against):
+
+    log L = -1/2 [ r^T C^-1 r - b^T A^-1 b + log det C + log det A
+                   + (n - k) log 2pi ],
+    A = M^T C^-1 M,  b = M^T C^-1 r
+
+with M the (column-normalized) design tensor of
+``timing.fit.design_tensor`` and k its per-pulsar non-padding column
+count. Column normalization shifts log L by a hyperparameter-
+independent constant (the flat-prior measure); both the Woodbury and
+the dense paths use the same normalization, so they agree exactly and
+likelihood *ratios* are unaffected.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_solve, solve_triangular
+
+from ..batch import PulsarBatch
+from ..models.batched import Recipe, gls_noise_model, white_ecorr_solver
+
+_LOG_2PI = float(np.log(2.0 * np.pi))
+
+#: Recipe fields that change the white/ECORR block C0 — a
+#: :class:`ReducedGP` precompute is only valid while these are fixed
+#: (likelihood/infer.py routes grids over any of them to the direct
+#: path instead)
+WHITE_NOISE_FIELDS = frozenset(
+    {"efac", "log10_equad", "log10_ecorr", "tnequad"}
+)
+
+
+def _chol_logdet(L):
+    """log det from a batched Cholesky factor: 2 sum log diag(L)."""
+    return 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1
+    )
+
+
+def _tm_columns(batch: PulsarBatch, design, dtype):
+    """Masked, column-normalized timing design: ``(Mn, zero_col)``.
+
+    Norms are UNWEIGHTED (hyperparameter-independent), so the
+    normalization constant they fold into log L cannot drift across a
+    grid; all-zero padding columns get unit norms and are neutralized
+    by the callers (unit diagonal in A, zero rhs — they solve to
+    exactly nothing and price log det 1 = 0)."""
+    M = jnp.asarray(design, dtype) * batch.mask[..., None]
+    norms = jnp.sqrt(jnp.sum(M * M, axis=-2))
+    zero_col = norms == 0.0
+    norms = jnp.where(zero_col, 1.0, norms)
+    return M / norms[:, None, :], zero_col
+
+
+def loglikelihood(
+    residuals,
+    batch: PulsarBatch,
+    recipe: Recipe,
+    design=None,
+    per_pulsar: bool = False,
+):
+    """Rank-reduced GP log-likelihood of ``residuals`` (Np, Nt) under
+    the recipe's own noise model.
+
+    ``design``: optional (Np, Nt, K) timing design tensor
+    (timing.fit.design_tensor) to marginalize analytically (flat
+    prior); padding (all-zero) columns are inert. ``per_pulsar``
+    returns the (Np,) per-pulsar terms instead of their sum (the
+    likelihood factorizes over pulsars — cross-pulsar GWB correlations
+    are not modeled, matching the GLS refit's weighting).
+
+    Pure JAX: jit it, vmap it over residual banks, vmap it over
+    hyperparameter batches (traced Recipe leaves) — likelihood/infer.py
+    wraps all three. Every contraction runs at ``precision='highest'``
+    for the same reason the GLS refit does (the TPU bf16 default leaves
+    ~1e-2 relative error on Gram entries).
+    """
+    dtype = jnp.asarray(residuals).dtype
+    sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+    _winv, c0inv, logdet_c0 = white_ecorr_solver(
+        batch, sigma2, ecorr2, dtype
+    )
+    r = jnp.asarray(residuals, dtype) * batch.mask
+    x0 = c0inv(r[..., None])[..., 0]  # C0^-1 r, (Np, Nt)
+    quad = jnp.einsum("pn,pn->p", r, x0, precision="highest")
+    logdet = logdet_c0
+
+    if U is not None:
+        # phi=0 modes must be exactly inert (same zeroing as
+        # _gls_design_system: the phi->0 limit is an infinite 1/phi
+        # prior; zeroed basis columns + unit S/Phi diagonals contribute
+        # exactly nothing to the quad or either determinant)
+        active = (phi > 0).astype(dtype)
+        U = U * active[:, None, :]
+        G = c0inv(U)  # C0^-1 U, (Np, Nt, R)
+        S = jnp.einsum("pnr,pns->prs", U, G, precision="highest")
+        phi_safe = jnp.where(phi > 0, phi, 1.0)
+        S = S + jnp.eye(U.shape[-1], dtype=dtype) / phi_safe[:, None, :]
+        L = jnp.linalg.cholesky(S)
+        b = jnp.einsum("pnr,pn->pr", U, x0, precision="highest")
+        z = solve_triangular(L, b[..., None], lower=True)[..., 0]
+        quad = quad - jnp.sum(z * z, axis=-1)
+        # log det C = log det C0 + log det S + log det Phi
+        logdet = logdet + _chol_logdet(L) + jnp.sum(
+            jnp.log(phi_safe) * active, axis=-1
+        )
+
+        def cinv_mat(X):
+            X0 = c0inv(X)
+            inner = jnp.einsum(
+                "pnr,pnq->prq", U, X0, precision="highest"
+            )
+            corr = cho_solve((L, True), inner)
+            return X0 - jnp.einsum(
+                "pnr,prq->pnq", G, corr, precision="highest"
+            )
+
+        w = x0 - jnp.einsum(
+            "pnr,pr->pn", G, cho_solve((L, True), b[..., None])[..., 0],
+            precision="highest",
+        )  # C^-1 r
+    else:
+        cinv_mat = c0inv
+        w = x0
+
+    ndof = batch.ntoas.astype(dtype)
+    if design is not None:
+        Mn, zero_col = _tm_columns(batch, design, dtype)
+        K = Mn.shape[-1]
+        CiM = cinv_mat(Mn)
+        A = jnp.einsum("pnk,pnl->pkl", Mn, CiM, precision="highest")
+        A = A + jnp.eye(K, dtype=dtype) * zero_col[:, None, :].astype(
+            dtype
+        )
+        La = jnp.linalg.cholesky(A)
+        bm = jnp.einsum("pnk,pn->pk", Mn, w, precision="highest")
+        zm = solve_triangular(La, bm[..., None], lower=True)[..., 0]
+        quad = quad - jnp.sum(zm * zm, axis=-1)
+        logdet = logdet + _chol_logdet(La)
+        ndof = ndof - jnp.sum((~zero_col).astype(dtype), axis=-1)
+
+    ll = -0.5 * (quad + logdet + ndof * dtype.type(_LOG_2PI))
+    return ll if per_pulsar else jnp.sum(ll)
+
+
+# ----------------------------------------------------- serving hot path
+
+@jax.tree_util.register_dataclass
+@dataclass
+class GPProjection:
+    """One residual vector's Nt-sized reductions against a
+    :class:`ReducedGP`'s fixed C0 and basis: everything a likelihood
+    evaluation needs that touches the TOA axis. Computed once per
+    residual vector (per bank row), reused by every hyperparameter
+    evaluation after."""
+
+    #: (Np,) r^T C0^-1 r
+    rNr: jax.Array
+    #: (Np, Q) T^T C0^-1 r over the full column stack [Mn, U]
+    d: jax.Array
+
+
+def shard_projection(proj: GPProjection, mesh) -> GPProjection:
+    """Place a bank's projections sharded along the mesh 'real' axis
+    (realization-bank parallelism). The ONE sharding layout for
+    projections — serve.project_bank and infer.bank_loglikelihood both
+    route through it, so the handle path and the raw-array path cannot
+    diverge. No-op on a single-device (or absent) mesh."""
+    if mesh is None or int(mesh.devices.size) <= 1:
+        return proj
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import put_sharded
+
+    return GPProjection(
+        rNr=put_sharded(proj.rNr, mesh, P("real", None)),
+        d=put_sharded(proj.d, mesh, P("real", None, None)),
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ReducedGP:
+    """Precomputed rank-reduced likelihood with FIXED white/ECORR noise.
+
+    Build once per (batch, recipe, design); :meth:`project` each
+    residual vector once (the only Nt-sized work); then
+    :meth:`loglikelihood` prices any (red-noise/GWB/chromatic)
+    hyperparameter point from the precomputed blocks alone — one
+    (R, R) Cholesky per pulsar, nothing proportional to Nt. A pytree,
+    so it passes through jit/vmap boundaries and shards like any other
+    operand (likelihood/infer.py places the projection bank on the
+    mesh's 'real' axis for realization-bank parallelism).
+
+    The GP blocks' BASIS is fixed at build time (mode counts, Tspan,
+    frequency grids); only the prior variances phi move with the
+    hyperparameters. That covers amplitude/slope grids — the serving
+    workload — exactly; grids over :data:`WHITE_NOISE_FIELDS` or over
+    basis shape invalidate the precompute and must use
+    :func:`loglikelihood` (infer.py enforces this).
+    """
+
+    #: (Np, Q, Q) T^T C0^-1 T over the stacked columns [Mn, U]
+    TNT: jax.Array
+    #: (Np, Nt, Q) C0^-1 T — the projector applied to residual vectors
+    CiT: jax.Array
+    #: (Np,) masked log det C0
+    logdet_c0: jax.Array
+    #: (Np, Nt) white per-TOA variance and (Np, E) per-epoch ECORR
+    #: variance (None without ECORR): the C0 inputs, retained so
+    #: :meth:`project` rebuilds the operator through the ONE shared
+    #: ``white_ecorr_solver`` instead of duplicating its algebra
+    sigma2: jax.Array
+    ecorr2: Optional[jax.Array]
+    #: (Np, ktm) True where a timing column is padding (inert)
+    zero_col: Optional[jax.Array]
+    #: (Np,) valid-TOA count minus fitted timing columns
+    ndof: jax.Array
+    #: number of leading timing-model columns in the stack
+    ktm: int = field(metadata=dict(static=True), default=0)
+
+    @classmethod
+    def build(
+        cls, batch: PulsarBatch, recipe: Recipe, design=None, dtype=None
+    ) -> "ReducedGP":
+        """Precompute every Nt-sized block. ``recipe`` fixes the white/
+        ECORR noise AND the GP basis layout; its phi values are not
+        retained (evaluations supply their own via
+        :func:`phi_for_recipe`)."""
+        if dtype is None:
+            dtype = batch.toas_s.dtype
+        sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+        _winv, c0inv, logdet_c0 = white_ecorr_solver(
+            batch, sigma2, ecorr2, dtype
+        )
+        cols = []
+        zero_col = None
+        ktm = 0
+        if design is not None:
+            Mn, zero_col = _tm_columns(batch, design, dtype)
+            ktm = Mn.shape[-1]
+            cols.append(Mn)
+        if U is not None:
+            cols.append(jnp.asarray(U, dtype))
+        if not cols:
+            raise ValueError(
+                "ReducedGP needs at least one low-rank block (a GP "
+                "noise term in the recipe or a design tensor) — a "
+                "white-noise-only likelihood has no reduced basis; "
+                "call loglikelihood directly"
+            )
+        T = jnp.concatenate(cols, axis=-1)
+        CiT = c0inv(T)
+        TNT = jnp.einsum("pnq,pns->pqs", T, CiT, precision="highest")
+        ndof = batch.ntoas.astype(dtype)
+        if zero_col is not None:
+            ndof = ndof - jnp.sum((~zero_col).astype(dtype), axis=-1)
+        return cls(
+            TNT=TNT, CiT=CiT, logdet_c0=logdet_c0,
+            sigma2=jnp.asarray(sigma2, dtype),
+            ecorr2=None if ecorr2 is None else jnp.asarray(ecorr2, dtype),
+            zero_col=zero_col, ndof=ndof, ktm=ktm,
+        )
+
+    @property
+    def ngp(self) -> int:
+        return int(self.TNT.shape[-1]) - self.ktm
+
+    def project(self, residuals, batch: PulsarBatch) -> GPProjection:
+        """The Nt-sized reductions of one (Np, Nt) residual vector.
+        vmap over the leading axis of a (R, Np, Nt) bank to project a
+        whole realization bank in one pass. The C0^-1 apply comes from
+        the same :func:`white_ecorr_solver` the build used (rebuilt
+        from the retained sigma2/ecorr2 — free under jit), so the
+        projection and the precompute cannot price different C0s."""
+        dtype = self.CiT.dtype
+        _winv, c0inv, _logdet = white_ecorr_solver(
+            batch, self.sigma2, self.ecorr2, dtype
+        )
+        r = jnp.asarray(residuals, dtype) * batch.mask
+        y = c0inv(r[..., None])[..., 0]
+        rNr = jnp.einsum("pn,pn->p", r, y, precision="highest")
+        # C0^-1 is symmetric: T^T C0^-1 r == (C0^-1 T)^T r
+        d = jnp.einsum("pnq,pn->pq", self.CiT, r, precision="highest")
+        return GPProjection(rNr=rNr, d=d)
+
+    def loglikelihood(
+        self, proj: GPProjection, phi, per_pulsar: bool = False
+    ):
+        """log L of one projected residual vector at GP prior ``phi``
+        (Np, ngp) — :func:`phi_for_recipe` evaluates it for a
+        hyperparameter point. No Nt-sized work: two small Cholesky
+        factorizations per pulsar ((R, R) and (ktm, ktm)), identical in
+        value to :func:`loglikelihood` on the raw residuals (pinned by
+        tests/test_likelihood.py)."""
+        dtype = self.TNT.dtype
+        k = self.ktm
+        phi = jnp.asarray(phi, dtype)
+        active = (phi > 0).astype(dtype)
+        phi_safe = jnp.where(phi > 0, phi, 1.0)
+        TNT_uu = self.TNT[:, k:, k:] * (
+            active[:, :, None] * active[:, None, :]
+        )
+        S = TNT_uu + jnp.eye(self.ngp, dtype=dtype) / phi_safe[:, None, :]
+        L = jnp.linalg.cholesky(S)
+        d_u = proj.d[:, k:] * active
+        z = solve_triangular(L, d_u[..., None], lower=True)[..., 0]
+        quad = proj.rNr - jnp.sum(z * z, axis=-1)
+        logdet = self.logdet_c0 + _chol_logdet(L) + jnp.sum(
+            jnp.log(phi_safe) * active, axis=-1
+        )
+        if k:
+            TNT_mu = self.TNT[:, :k, k:] * active[:, None, :]
+            X = cho_solve((L, True), jnp.swapaxes(TNT_mu, -1, -2))
+            A = self.TNT[:, :k, :k] - jnp.einsum(
+                "pkr,prl->pkl", TNT_mu, X, precision="highest"
+            )
+            A = A + jnp.eye(k, dtype=dtype) * self.zero_col[
+                :, None, :
+            ].astype(dtype)
+            La = jnp.linalg.cholesky(A)
+            bm = proj.d[:, :k] - jnp.einsum(
+                "pkr,pr->pk", TNT_mu,
+                cho_solve((L, True), d_u[..., None])[..., 0],
+                precision="highest",
+            )
+            zm = solve_triangular(La, bm[..., None], lower=True)[..., 0]
+            quad = quad - jnp.sum(zm * zm, axis=-1)
+            logdet = logdet + _chol_logdet(La)
+        ll = -0.5 * (quad + logdet + self.ndof * dtype.type(_LOG_2PI))
+        return ll if per_pulsar else jnp.sum(ll)
+
+
+def phi_for_recipe(batch: PulsarBatch, recipe: Recipe):
+    """The stacked GP prior variances (Np, R) of ``recipe``'s noise
+    model — the only piece of :func:`gls_noise_model` a hyperparameter
+    point moves when the white noise and basis layout are fixed. Under
+    jit the (Np, Nt, R) basis feeding the discarded U output is dead
+    code (phi depends only on the frequency grids), so this costs
+    O(Np x R), not O(Np x Nt x R)."""
+    _sigma2, _ecorr2, U, phi = gls_noise_model(batch, recipe)
+    if U is None:
+        raise ValueError(
+            "recipe has no GP noise block (red noise, chromatic, or "
+            "GWB) — nothing for phi_for_recipe to evaluate"
+        )
+    return phi
+
+
+# ------------------------------------------------------------- oracle
+
+def dense_loglikelihood(
+    residuals,
+    batch: PulsarBatch,
+    recipe: Recipe,
+    design=None,
+    per_pulsar: bool = False,
+):
+    """Oracle-grade dense-covariance reference: numpy float64, one
+    explicit (n, n) covariance Cholesky per pulsar.
+
+    Builds C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T from the
+    same :func:`gls_noise_model` components the Woodbury path consumes
+    — what this verifies is the ENTIRE rank-reduced evaluation
+    (analytic ECORR inversion, Woodbury quad/determinant, exact
+    timing-model marginalization), while the components themselves are
+    validated against the enterprise-convention dense oracle in
+    tests/test_batched.py. O(Nt^3): tests only.
+    """
+    sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+    sigma2 = np.asarray(sigma2, np.float64)
+    ecorr2 = None if ecorr2 is None else np.asarray(ecorr2, np.float64)
+    U = None if U is None else np.asarray(U, np.float64)
+    phi = None if phi is None else np.asarray(phi, np.float64)
+    r_all = np.asarray(residuals, np.float64)
+    mask = np.asarray(batch.mask)
+    epoch_index = np.asarray(batch.epoch_index)
+    design = None if design is None else np.asarray(design, np.float64)
+
+    out = np.zeros(batch.npsr)
+    for p in range(batch.npsr):
+        idx = np.nonzero(mask[p] > 0)[0]
+        n = idx.size
+        r = r_all[p, idx]
+        C = np.diag(sigma2[p, idx])
+        if ecorr2 is not None:
+            E = ecorr2.shape[1]
+            onehot = (
+                epoch_index[p, idx][:, None] == np.arange(E)[None, :]
+            ).astype(np.float64)
+            C = C + (onehot * ecorr2[p][None, :]) @ onehot.T
+        if U is not None:
+            Up = U[p][idx]
+            C = C + (Up * phi[p][None, :]) @ Up.T
+        L = np.linalg.cholesky(C)
+        half = np.linalg.solve(L, r)
+        quad = float(half @ half)
+        logdet = 2.0 * float(np.sum(np.log(np.diag(L))))
+        ndof = float(n)
+        if design is not None:
+            M = design[p][idx] * mask[p, idx][:, None]
+            norms = np.sqrt(np.sum((design[p] * mask[p][:, None]) ** 2,
+                                   axis=0))
+            keep = norms > 0.0
+            Mn = M[:, keep] / norms[keep][None, :]
+            k = int(keep.sum())
+            MnL = np.linalg.solve(L, Mn)
+            rL = half
+            A = MnL.T @ MnL
+            bm = MnL.T @ rL
+            La = np.linalg.cholesky(A)
+            zm = np.linalg.solve(La, bm)
+            quad -= float(zm @ zm)
+            logdet += 2.0 * float(np.sum(np.log(np.diag(La))))
+            ndof -= k
+        out[p] = -0.5 * (quad + logdet + ndof * _LOG_2PI)
+    return out if per_pulsar else float(out.sum())

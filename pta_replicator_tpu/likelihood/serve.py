@@ -1,0 +1,493 @@
+"""Request-batched likelihood serving over precomputed realization banks.
+
+The "millions of users" shape of ROADMAP open item 1: a sweep produces
+a bank of NG15-scale realizations (utils/sweep.py checkpoints — the
+consolidated npz or the per-chunk/sharded archives of a run still in
+flight); this module prices hyperparameter requests against that bank
+as a service.
+
+The economics come from :class:`~.gp.ReducedGP`: the bank is projected
+ONCE through the fixed-noise precompute (the only pass that touches
+the TOA axis, streamed chunk-by-chunk through the prefetch layer so no
+stage holds the whole bank), after which one request costs a small
+per-pulsar Cholesky — so the right execution model is request
+COALESCING, not request-at-a-time: concurrent requests queue, a worker
+collects them until a device-shaped batch fills or a deadline expires
+(size/deadline trigger, the classic dynamic-batching tradeoff:
+coalescing efficiency vs tail latency), pads the theta block to the
+fixed batch shape (one compile, ever), runs ONE vmapped evaluation
+over (batch, realizations), and resolves each request's future with
+its own (R,) log-likelihood row.
+
+SLO telemetry rides the obs stack: ``likelihood.requests`` /
+``likelihood.batches`` / ``likelihood.batch_size`` /
+``likelihood.evals`` / ``likelihood.coalesce_efficiency`` /
+``likelihood.queue_depth`` metrics, a ``likelihood_batch`` span per
+coalesced evaluation (so a capture's series layer yields batch-latency
+percentiles for free), and request-latency p50/p95/p99 tracked by the
+streaming P^2 estimators of obs/series.py — :meth:`LikelihoodServer.
+stats` returns the whole SLO block, and benchmarks/likelihood_serve.py
+commits it as the LIKELIHOOD bench series.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..batch import PulsarBatch
+from ..models.batched import Recipe
+from ..obs import counter, gauge, names, span
+from ..obs.series import SpanQuantiles
+from . import gp
+from .infer import _check_axes, _reduced_grid_engine_bank, _reducible
+
+_STOP = object()
+
+
+class RealizationBank:
+    """Host-side handle on a (R, Np, Nt) residual bank.
+
+    ``chunks`` is a list of loader callables (one per chunk) so a bank
+    larger than host memory can stream: only one chunk is resident per
+    iteration step. Build from a live array (:meth:`from_array`) or
+    from a sweep checkpoint in ANY state (:meth:`from_checkpoint` —
+    consolidated npz, or the per-chunk ``.npy``/sharded-archive files
+    of an unfinished run, reassembled under any topology).
+    """
+
+    def __init__(self, chunks: Sequence, shape: Tuple[int, ...], dtype,
+                 lengths: Optional[Sequence[int]] = None):
+        self._chunks = list(chunks)
+        self.shape = tuple(int(n) for n in shape)
+        self.dtype = np.dtype(dtype)
+        #: realizations per chunk (for single-row access without
+        #: loading the whole bank); None = unknown until iterated
+        self._lengths = None if lengths is None else [
+            int(n) for n in lengths
+        ]
+        if len(self.shape) != 3:
+            raise ValueError(
+                f"realization banks are (R, Np, Nt) residual cubes; got "
+                f"shape {self.shape} — sweeps that keep a reduce_fn "
+                "store summaries, not banks (run with reduce_fn=None)"
+            )
+
+    @property
+    def nreal(self) -> int:
+        return self.shape[0]
+
+    def row(self, i: int) -> np.ndarray:
+        """One (Np, Nt) realization, loading ONLY its containing chunk
+        (a MAP fit on row 3 of a multi-GB bank must not concatenate
+        the whole cube first)."""
+        if not 0 <= i < self.nreal:
+            raise IndexError(f"row {i} out of range (nreal={self.nreal})")
+        if self._lengths is not None:
+            lo = 0
+            for k, n in enumerate(self._lengths):
+                if i < lo + n:
+                    return np.asarray(self._chunks[k]())[i - lo]
+                lo += n
+        lo = 0
+        for block in self.iter_chunks():
+            if i < lo + block.shape[0]:
+                return block[i - lo]
+            lo += block.shape[0]
+        raise IndexError(f"row {i} beyond the bank's chunks")
+
+    @classmethod
+    def from_array(cls, arr, chunk: int = 256) -> "RealizationBank":
+        arr = np.asarray(arr)
+        loaders = [
+            (lambda lo=lo: arr[lo:lo + chunk])
+            for lo in range(0, arr.shape[0], chunk)
+        ]
+        lengths = [
+            min(chunk, arr.shape[0] - lo)
+            for lo in range(0, arr.shape[0], chunk)
+        ]
+        return cls(loaders, arr.shape, arr.dtype, lengths=lengths)
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint_path: str) -> "RealizationBank":
+        from ..utils.sweep import iter_checkpoint_chunk_infos
+
+        # header-only probe: shapes from npy headers / shard manifests,
+        # zero data bytes read — the chunks themselves stream later,
+        # on demand, through the loaders
+        probe = list(iter_checkpoint_chunk_infos(checkpoint_path))
+        if not probe:
+            raise FileNotFoundError(
+                f"no completed sweep chunks at {checkpoint_path} "
+                "(neither a consolidated archive nor chunk files)"
+            )
+        nreal = sum(shape[0] for _i, shape, _d in probe)
+        _, shape0, dtype0 = probe[0]
+
+        def loader(i):
+            def load(i=i):
+                from ..utils.sweep import load_checkpoint_chunk
+
+                return load_checkpoint_chunk(checkpoint_path, i)
+
+            return load
+
+        loaders = [loader(i) for i, _s, _d in probe]
+        return cls(loaders, (nreal,) + tuple(shape0[1:]), dtype0,
+                   lengths=[s[0] for _i, s, _d in probe])
+
+    def iter_chunks(self):
+        for load in self._chunks:
+            yield np.asarray(load())
+
+    def load(self) -> np.ndarray:
+        return np.concatenate(list(self.iter_chunks()), axis=0)
+
+
+def project_bank(
+    bank: RealizationBank,
+    reduced: gp.ReducedGP,
+    batch: PulsarBatch,
+    prefetch_depth: int = 2,
+    mesh=None,
+) -> gp.GPProjection:
+    """Project a whole bank through the ReducedGP precompute: the
+    one-time Nt-sized pass, streamed chunk-by-chunk through the
+    prefetch layer (the next chunk loads from disk and stages
+    host->device while the current one projects), returning the
+    (R, Np[, Q]) projection pytree the request path consumes. On a
+    multi-device ``mesh`` the projections land sharded along 'real'
+    (realization-bank parallelism for the evaluation engine)."""
+    from ..parallel.prefetch import prefetch_to_device
+
+    project = jax.jit(
+        lambda block: jax.vmap(lambda r: reduced.project(r, batch))(block)
+    )
+    parts = []
+    with span(names.SPAN_LIKELIHOOD_PROJECT, nreal=bank.nreal) as sp:
+        staged = prefetch_to_device(
+            bank.iter_chunks(), depth=prefetch_depth
+        )
+        for block in staged:
+            parts.append(project(block))
+        sp["chunks"] = len(parts)
+    proj = gp.GPProjection(
+        rNr=jnp.concatenate([p.rNr for p in parts], axis=0),
+        d=jnp.concatenate([p.d for p in parts], axis=0),
+    )
+    return gp.shard_projection(proj, mesh)
+
+
+@dataclass
+class _Request:
+    theta: np.ndarray
+    future: Future
+    t_submit: float  # monotonic
+
+
+class LikelihoodServer:
+    """Request-batched likelihood evaluation over a realization bank.
+
+    ``axes``: the Recipe hyperparameter fields a request supplies
+    (sorted internally; must be phi-only axes — GP amplitudes/slopes
+    of blocks the base recipe enables — because the serving engine IS
+    the fixed-noise ReducedGP path). ``max_batch`` is the device batch
+    capacity; ``max_delay_s`` the coalescing deadline measured from
+    the oldest queued request. Each :meth:`submit` returns a
+    ``concurrent.futures.Future`` resolving to the (R,) per-realization
+    total log-likelihood at that hyperparameter point.
+
+    Lifecycle: ``start()`` spawns the coalescing worker; ``stop()``
+    drains the queue (pending requests are SERVED, not dropped) and
+    joins it. Thread-safe submit from any number of client threads.
+    """
+
+    def __init__(
+        self,
+        bank: RealizationBank,
+        batch: PulsarBatch,
+        recipe: Recipe,
+        axes: Sequence[str],
+        design=None,
+        mesh=None,
+        max_batch: int = 8,
+        max_delay_s: float = 0.005,
+        prefetch_depth: int = 2,
+    ):
+        self.axes = tuple(sorted(axes))
+        _check_axes(self.axes)
+        if not _reducible(self.axes, recipe):
+            raise ValueError(
+                f"serving axes {self.axes} must be phi-only hyper"
+                "parameters (GP amplitudes/slopes of blocks the base "
+                "recipe enables) — white-noise axes invalidate the "
+                "fixed-noise precompute the serving path is built on"
+            )
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1 (got {max_batch})")
+        self.batch = batch
+        self.recipe = recipe
+        self.max_batch = int(max_batch)
+        self.max_delay_s = float(max_delay_s)
+        self.nreal = bank.nreal
+        dtype = batch.toas_s.dtype
+        self._reduced = gp.ReducedGP.build(
+            batch, recipe, design=design, dtype=dtype
+        )
+        self._proj = project_bank(
+            bank, self._reduced, batch,
+            prefetch_depth=prefetch_depth, mesh=mesh,
+        )
+        self._engine = _reduced_grid_engine_bank(self.axes)
+        self._queue: queue.Queue = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._closing = False
+        self._lock = threading.Lock()
+        self._latency = SpanQuantiles()
+        self._batch_fill = SpanQuantiles()
+        self._requests = 0
+        self._batches = 0
+        self._started_at: Optional[float] = None
+        self._busy_s = 0.0
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> "LikelihoodServer":
+        if self._worker is not None:
+            raise RuntimeError("server already started")
+        self._closing = False
+        self._started_at = time.monotonic()
+        self._worker = threading.Thread(
+            target=self._run, name="likelihood-serve", daemon=True
+        )
+        self._worker.start()
+        return self
+
+    def stop(self, timeout: Optional[float] = None) -> None:
+        """Drain and join: queued requests are served before the worker
+        exits (a shutdown must not strand client futures). ``submit``
+        raises once the shutdown begins; a request that slips through
+        the closing race is served by a final drain HERE, after the
+        join, so no accepted future is ever stranded. The default waits
+        for the drain to finish (it is bounded by the queue content);
+        with a finite ``timeout`` a still-running worker raises instead
+        of being silently abandoned (a second ``start`` on a live
+        worker would double-serve the queue)."""
+        if self._worker is None:
+            return
+        with self._lock:
+            self._closing = True
+        self._queue.put(_STOP)
+        self._worker.join(timeout=timeout)
+        if self._worker.is_alive():
+            raise RuntimeError(
+                f"likelihood-serve worker still draining after "
+                f"{timeout}s — the server is NOT stopped (retry stop() "
+                "with a longer/None timeout)"
+            )
+        self._worker = None
+        # defensive invariant: submit() enqueues atomically with the
+        # closing check, so every admitted request precedes _STOP in
+        # the FIFO queue and the worker has already served it. If that
+        # invariant is ever broken, serve the stragglers here anyway
+        # rather than strand their futures.
+        tail = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                tail.append(item)
+        for lo in range(0, len(tail), self.max_batch):
+            self._serve_batch(tail[lo:lo + self.max_batch])
+
+    def __enter__(self) -> "LikelihoodServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # --------------------------------------------------------- clients
+
+    def submit(self, **params) -> Future:
+        """Queue one hyperparameter point; returns a Future resolving
+        to the (R,) per-realization total log L."""
+        if set(params) != set(self.axes):
+            raise ValueError(
+                f"request must supply exactly {self.axes}, got "
+                f"{tuple(sorted(params))}"
+            )
+        theta = np.asarray([float(params[k]) for k in self.axes])
+        fut: Future = Future()
+        # the enqueue is atomic with the closing check: stop() flips
+        # _closing under this lock BEFORE posting the worker's _STOP,
+        # so any request admitted here is already in the queue ahead of
+        # the sentinel (FIFO) and the drain is guaranteed to serve it
+        with self._lock:
+            if self._worker is None or self._closing:
+                raise RuntimeError("server not started (or stopping)")
+            self._queue.put(_Request(theta, fut, time.monotonic()))
+        counter(names.LIKELIHOOD_REQUESTS).inc()
+        gauge(names.LIKELIHOOD_QUEUE_DEPTH).set(self._queue.qsize())
+        return fut
+
+    def evaluate(self, **params) -> np.ndarray:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        return self.submit(**params).result()
+
+    # ---------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        with span(names.SPAN_LIKELIHOOD_SERVE,
+                  max_batch=self.max_batch,
+                  max_delay_s=self.max_delay_s):
+            stopping = False
+            while not stopping:
+                item = self._queue.get()
+                if item is _STOP:
+                    break
+                reqs = [item]
+                deadline = item.t_submit + self.max_delay_s
+                while len(reqs) < self.max_batch:
+                    # backlog that accumulated while the previous batch
+                    # evaluated coalesces UNCONDITIONALLY (an expired
+                    # deadline must not ship a 1-request batch past a
+                    # full queue); the deadline only bounds how long we
+                    # WAIT for requests that have not arrived yet
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except queue.Empty:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        try:
+                            nxt = self._queue.get(timeout=remaining)
+                        except queue.Empty:
+                            break
+                    if nxt is _STOP:
+                        stopping = True
+                        break
+                    reqs.append(nxt)
+                self._serve_batch(reqs)
+            # drain anything still queued after the stop sentinel
+            tail = []
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _STOP:
+                    tail.append(item)
+            for lo in range(0, len(tail), self.max_batch):
+                self._serve_batch(tail[lo:lo + self.max_batch])
+
+    def _serve_batch(self, reqs) -> None:
+        nb = len(reqs)
+        theta = np.stack([r.theta for r in reqs])
+        if nb < self.max_batch:
+            # pad to the fixed device batch shape: ONE compiled program
+            # regardless of fill (the padding rows repeat the last
+            # request and are discarded — wasted FLOPs, not a retrace)
+            theta = np.concatenate(
+                [theta, np.repeat(theta[-1:], self.max_batch - nb,
+                                  axis=0)]
+            )
+        t0 = time.monotonic()
+        try:
+            with span(names.SPAN_LIKELIHOOD_BATCH, requests=nb,
+                      capacity=self.max_batch):
+                out = np.asarray(
+                    self._engine(
+                        jnp.asarray(theta, self.batch.toas_s.dtype),
+                        self._reduced, self._proj, self.batch,
+                        self.recipe,
+                    )
+                )
+        except BaseException as exc:  # noqa: BLE001 — delivered per-future
+            for r in reqs:
+                if not r.future.set_running_or_notify_cancel():
+                    continue
+                r.future.set_exception(exc)
+            return
+        done = time.monotonic()
+        with self._lock:
+            self._requests += nb
+            self._batches += 1
+            self._busy_s += done - t0
+            self._batch_fill.observe(nb)
+            for r in reqs:
+                self._latency.observe(done - r.t_submit)
+            eff = self._requests / (self._batches * self.max_batch)
+        counter(names.LIKELIHOOD_BATCHES).inc()
+        counter(names.LIKELIHOOD_EVALS).inc(nb * self.nreal)
+        gauge(names.LIKELIHOOD_BATCH_SIZE).set(nb)
+        gauge(names.LIKELIHOOD_COALESCE_EFFICIENCY).set(round(eff, 6))
+        gauge(names.LIKELIHOOD_QUEUE_DEPTH).set(self._queue.qsize())
+        for k, r in enumerate(reqs):
+            if not r.future.set_running_or_notify_cancel():
+                continue
+            r.future.set_result(out[k])
+
+    # ------------------------------------------------------------ SLOs
+
+    def reset_stats(self) -> None:
+        """Zero the SLO window (counts, percentile estimators, the
+        throughput clock) — so a measurement window can exclude warmup
+        (the first request pays the engine compile)."""
+        with self._lock:
+            self._latency = SpanQuantiles()
+            self._batch_fill = SpanQuantiles()
+            self._requests = 0
+            self._batches = 0
+            self._busy_s = 0.0
+            self._started_at = time.monotonic()
+
+    def stats(self) -> dict:
+        """The SLO block: request/batch counts, coalescing efficiency,
+        streaming latency percentiles, and throughput over the server's
+        lifetime so far."""
+        with self._lock:
+            requests = self._requests
+            batches = self._batches
+            busy_s = self._busy_s
+            latency = self._latency.summary()
+            fill = self._batch_fill.summary()
+        elapsed = (
+            time.monotonic() - self._started_at
+            if self._started_at is not None else 0.0
+        )
+        evals = requests * self.nreal
+        return {
+            "requests": requests,
+            "batches": batches,
+            "nreal": self.nreal,
+            "max_batch": self.max_batch,
+            "max_delay_s": self.max_delay_s,
+            "coalesce_efficiency": (
+                requests / (batches * self.max_batch) if batches else 0.0
+            ),
+            "batch_fill_mean": (
+                requests / batches if batches else 0.0
+            ),
+            "evals": evals,
+            "evals_per_s": evals / elapsed if elapsed > 0 else 0.0,
+            "requests_per_s": requests / elapsed if elapsed > 0 else 0.0,
+            "device_busy_s": round(busy_s, 6),
+            "latency": {
+                k: v for k, v in latency.items()
+                if v is not None and np.isfinite(v)
+            },
+            "batch_fill": {
+                k: v for k, v in fill.items()
+                if v is not None and np.isfinite(v)
+            },
+        }

@@ -1380,14 +1380,22 @@ def gls_noise_model(batch: PulsarBatch, recipe: "Recipe"):
     return sigma2, ecorr2, U, phi
 
 
-def _gls_design_system(batch: PulsarBatch, design, recipe: "Recipe",
-                       ridge, dtype):
-    """Shared assembly for the batched GLS refit: the column-normalized
-    normal matrix A = N^-1 (M^T C^-1 M) N^-1 (+ ridge and padding-column
-    unit rows), its normalization, and the C^-1 operator itself. Split
-    out so :func:`gls_fit_uncertainties` prices the SAME system
-    gls_fit_subtract solves — the two can never drift apart."""
-    sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype):
+    """The white+ECORR block C0 = N + U_ec diag(ecorr2) U_ec^T as an
+    inverse-applicator plus its masked log-determinant — the analytic
+    per-epoch Woodbury every consumer of the rank-reduced noise model
+    shares (the GLS refit below and the GP likelihood in
+    ``likelihood/gp.py``), so the two can never disagree about the C0
+    algebra.
+
+    Returns ``(winv, c0inv_mat, logdet_c0)``: the masked N^-1 diagonal
+    (Np, Nt), a map ``(Np, Nt, Q) -> (Np, Nt, Q)`` applying C0^-1, and
+    the (Np,) log-determinant over VALID TOAs only (padding rows, whose
+    sigma2 is zero, contribute nothing — they are excluded by the mask,
+    not priced at log 0). Epochs are disjoint, so U_ec^T N^-1 U_ec is
+    diagonal and both the solve and the determinant are exact with no
+    dense (Nt, E) one-hot ever materialized:
+    log det C0 = sum_t log sigma2_t + sum_e log(1 + ecorr2_e s_e)."""
     winv = jnp.where(batch.mask > 0, 1.0 / sigma2, 0.0)  # N^-1 diagonal
     psr_rows = jnp.arange(batch.npsr)[:, None]
 
@@ -1415,6 +1423,29 @@ def _gls_design_system(batch: PulsarBatch, design, recipe: "Recipe",
             corr, batch.epoch_index[..., None], axis=1
         )
         return y - winv[..., None] * picked
+
+    safe_sigma2 = jnp.where(batch.mask > 0, sigma2, 1.0)
+    logdet_c0 = jnp.sum(jnp.log(safe_sigma2) * batch.mask, axis=-1)
+    if ecorr2 is not None:
+        # log1p: ecorr2 is 0 at padded epochs (epoch_mask applied by
+        # gls_noise_model), so those terms vanish exactly
+        logdet_c0 = logdet_c0 + jnp.sum(
+            jnp.log1p(ecorr2 * s_e) * batch.epoch_mask, axis=-1
+        )
+    return winv, c0inv_mat, logdet_c0
+
+
+def _gls_design_system(batch: PulsarBatch, design, recipe: "Recipe",
+                       ridge, dtype):
+    """Shared assembly for the batched GLS refit: the column-normalized
+    normal matrix A = N^-1 (M^T C^-1 M) N^-1 (+ ridge and padding-column
+    unit rows), its normalization, and the C^-1 operator itself. Split
+    out so :func:`gls_fit_uncertainties` prices the SAME system
+    gls_fit_subtract solves — the two can never drift apart."""
+    sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+    _winv, c0inv_mat, _logdet = white_ecorr_solver(
+        batch, sigma2, ecorr2, dtype
+    )
 
     design = jnp.asarray(design, dtype) * batch.mask[..., None]
     K = design.shape[-1]

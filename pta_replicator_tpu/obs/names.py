@@ -57,9 +57,18 @@ SPAN_MULTICHIP_SWEEP = "multichip_sweep"
 SPAN_CW_STREAM_STAGE = "cw_stream_stage"
 SPAN_CW_STREAM_RESPONSE = "cw_stream_response"
 
+# likelihood engine + serving path (likelihood/)
+#: one coalesced device evaluation of a request batch (likelihood/serve.py)
+SPAN_LIKELIHOOD_BATCH = "likelihood_batch"
+#: server lifetime phase span (start()..stop()) — the SLO window
+SPAN_LIKELIHOOD_SERVE = "likelihood_serve"
+#: one-time bank projection pass through the ReducedGP precompute
+SPAN_LIKELIHOOD_PROJECT = "likelihood_project"
+
 # CLI runner (the top-level span is the subcommand name)
 SPAN_CLI_REALIZE = "realize"
 SPAN_CLI_INFO = "info"
+SPAN_CLI_LIKELIHOOD = "likelihood"
 SPAN_INGEST = "ingest"
 SPAN_BUILD_RECIPE = "build_recipe"
 SPAN_COMPUTE = "compute"
@@ -84,7 +93,9 @@ SPANS = frozenset({
     SPAN_SWEEP_CHUNK, SPAN_READBACK_FENCE, SPAN_SWEEP_PIPELINE,
     SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE, SPAN_MULTICHIP_SWEEP,
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
-    SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_INGEST, SPAN_BUILD_RECIPE,
+    SPAN_LIKELIHOOD_BATCH, SPAN_LIKELIHOOD_SERVE, SPAN_LIKELIHOOD_PROJECT,
+    SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_CLI_LIKELIHOOD,
+    SPAN_INGEST, SPAN_BUILD_RECIPE,
     SPAN_COMPUTE, SPAN_WRITE_OUTPUT,
     SPAN_BENCH_INGEST_B1855, SPAN_BENCH_AOT_COMPILE, SPAN_BENCH_WARMUP,
     SPAN_BENCH_MEASURE, SPAN_BENCH_SWEEP_AB,
@@ -129,6 +140,18 @@ CW_STREAM_TILES_DONE = "cw_stream.tiles_done"
 CW_STREAM_BYTES_STAGED = "cw_stream.bytes_staged"
 CW_STREAM_PREFETCH_STALL_S = "cw_stream.prefetch_stall_s"
 
+# likelihood serving path (likelihood/serve.py): requests accepted,
+# coalesced device batches run, the last batch's fill (requests per
+# batch), cumulative theta x realization likelihood evaluations, the
+# rolling coalescing efficiency (served requests / batch-slot
+# capacity), and the live request-queue depth
+LIKELIHOOD_REQUESTS = "likelihood.requests"
+LIKELIHOOD_BATCHES = "likelihood.batches"
+LIKELIHOOD_BATCH_SIZE = "likelihood.batch_size"
+LIKELIHOOD_EVALS = "likelihood.evals"
+LIKELIHOOD_COALESCE_EFFICIENCY = "likelihood.coalesce_efficiency"
+LIKELIHOOD_QUEUE_DEPTH = "likelihood.queue_depth"
+
 # flight recorder
 FLIGHTREC_STALLS = "flightrec.stalls"
 
@@ -165,6 +188,9 @@ METRICS = frozenset({
     PIPELINE_DRAIN_TIMEOUTS,
     CW_STREAM_TILES_DONE, CW_STREAM_BYTES_STAGED,
     CW_STREAM_PREFETCH_STALL_S,
+    LIKELIHOOD_REQUESTS, LIKELIHOOD_BATCHES, LIKELIHOOD_BATCH_SIZE,
+    LIKELIHOOD_EVALS, LIKELIHOOD_COALESCE_EFFICIENCY,
+    LIKELIHOOD_QUEUE_DEPTH,
     FLIGHTREC_STALLS,
     OBS_OVERHEAD_S, PROC_RSS_BYTES,
     OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
@@ -195,6 +221,7 @@ SWEEP_PREFIX = "sweep."
 FLIGHTREC_PREFIX = "flightrec."
 PIPELINE_PREFIX = "pipeline."
 CW_STREAM_PREFIX = "cw_stream."
+LIKELIHOOD_PREFIX = "likelihood."
 OCCUPANCY_PREFIX = "occupancy."
 OBS_PREFIX = "obs."
 PROC_PREFIX = "proc."
@@ -204,10 +231,16 @@ JIT_REALIZE_ENGINE = "batched.realize_engine"
 JIT_MESH_CONSTRAINT_ENGINE = "mesh.constraint_engine"
 JIT_MESH_SHARDMAP_ENGINE = "mesh.shardmap_engine"
 JIT_MESH_SHARDMAP_PSR_ENGINE = "mesh.shardmap_psr_engine"
+#: direct rank-reduced GP likelihood (full noise-model rebuild per
+#: hyperparameter point) and the ReducedGP fast path (fixed-noise
+#: precompute; the serving engine) — likelihood/infer.py
+JIT_LIKELIHOOD_ENGINE = "likelihood.gp_engine"
+JIT_LIKELIHOOD_REDUCED_ENGINE = "likelihood.reduced_engine"
 
 JIT_LABELS = frozenset({
     JIT_REALIZE_ENGINE, JIT_MESH_CONSTRAINT_ENGINE,
     JIT_MESH_SHARDMAP_ENGINE, JIT_MESH_SHARDMAP_PSR_ENGINE,
+    JIT_LIKELIHOOD_ENGINE, JIT_LIKELIHOOD_REDUCED_ENGINE,
 })
 
 #: every registered name, for membership checks that don't care about kind

@@ -71,6 +71,13 @@ _HIGHER_BETTER_TOKENS = (
     # decaying across rounds IS a throughput regression. "rate" already
     # matches; listed for the same spelled-out-contract reason.
     "rate_per_s",
+    # LIKELIHOOD series (benchmarks/likelihood_serve.py): likelihood
+    # evaluations per second and the serving path's batch-slot fill.
+    # "per_s"/"efficiency" already match; spelled out so the gate's
+    # contract for the series is explicit (ISSUE 9). The latency
+    # leaves (serve.latency.p50/p95/p99) ride the lower-better
+    # percentile tokens below; batch_overhead_ratio rides "overhead".
+    "evals_per_s", "coalesce_efficiency",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves

@@ -403,6 +403,109 @@ def _load_chunk(checkpoint_path: str, i: int) -> np.ndarray:
     return load_shard_archive(_shard_chunk_path(checkpoint_path, i))
 
 
+def load_checkpoint_chunk(checkpoint_path: str, i: int) -> np.ndarray:
+    """Load exactly ONE completed chunk of a sweep checkpoint, wherever
+    it lives: a member of the finished consolidated ``.npz``, or the
+    in-progress per-chunk ``.npy``/sharded archive. The random-access
+    twin of :func:`iter_checkpoint_chunks` (the likelihood serving
+    path's bank loaders re-read single chunks without walking the whole
+    archive)."""
+    if os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path) as z:
+            member = f"chunk{i}"
+            if member not in z.files:
+                raise FileNotFoundError(
+                    f"{checkpoint_path} has no member {member!r}"
+                )
+            return z[member]
+    return _load_chunk(checkpoint_path, i)
+
+
+def _npy_header(fh):
+    """(shape, dtype) from an open .npy stream, data bytes untouched."""
+    version = np.lib.format.read_magic(fh)
+    if version == (1, 0):
+        shape, _fortran, dtype = np.lib.format.read_array_header_1_0(fh)
+    else:
+        shape, _fortran, dtype = np.lib.format.read_array_header_2_0(fh)
+    return shape, dtype
+
+
+def iter_checkpoint_chunk_infos(checkpoint_path: str):
+    """Yield ``(i, shape, dtype)`` per completed chunk WITHOUT reading
+    any data bytes: npy headers for plain chunks/consolidated members,
+    the JSON manifest for sharded archives. The cheap probe
+    RealizationBank.from_checkpoint sizes a multi-GB bank with
+    (loading every chunk just to learn its shape would double the
+    bank's I/O before the first request)."""
+    if os.path.exists(checkpoint_path):
+        with zipfile.ZipFile(checkpoint_path) as zf:
+            members = [
+                m for m in zf.namelist()
+                if m.startswith("chunk") and m.endswith(".npy")
+            ]
+            idx = sorted(
+                int(m[len("chunk"):-len(".npy")]) for m in members
+            )
+            for i in idx:
+                with zf.open(f"chunk{i}.npy") as fh:
+                    shape, dtype = _npy_header(fh)
+                yield i, shape, dtype
+        return
+    i = 0
+    while True:
+        path = _chunk_path(checkpoint_path, i)
+        if os.path.exists(path):
+            with open(path, "rb") as fh:
+                shape, dtype = _npy_header(fh)
+        else:
+            shard_path = _shard_chunk_path(checkpoint_path, i)
+            if not os.path.exists(shard_path):
+                break
+            with np.load(shard_path) as z:
+                if _SHARD_MANIFEST_MEMBER not in z.files:
+                    break  # torn archive: nothing after it is durable
+                manifest = json.loads(str(z[_SHARD_MANIFEST_MEMBER]))
+            shape = tuple(manifest["shape"])
+            dtype = np.dtype(manifest["dtype"])
+        yield i, shape, dtype
+        i += 1
+
+
+def iter_checkpoint_chunks(checkpoint_path: str):
+    """Yield ``(i, array)`` for every completed chunk of a sweep
+    checkpoint, one chunk resident at a time, whatever state and
+    topology wrote it: the finished consolidated ``.npz`` (lazy member
+    reads — the archive is never loaded whole), or the in-progress
+    per-chunk files (single-chip ``.npy`` and/or mesh-sweep sharded
+    archives, in any mix a cross-topology resume leaves behind).
+
+    The bounded-memory feed of the likelihood serving path
+    (likelihood/serve.py loads realization banks through this, staging
+    chunks via parallel.prefetch) — and usable by any other consumer
+    that wants a sweep's results without 8 x chunk x cube bytes of
+    peak host memory."""
+    if os.path.exists(checkpoint_path):
+        with np.load(checkpoint_path) as z:
+            idx = sorted(
+                int(m[len("chunk"):]) for m in z.files
+                if m.startswith("chunk")
+            )
+            for i in idx:
+                yield i, z[f"chunk{i}"]
+        return
+    i = 0
+    while True:
+        try:
+            block = _load_chunk(checkpoint_path, i)
+        except (FileNotFoundError, ValueError):
+            # ValueError = a torn sharded archive (no manifest): the
+            # chunks after the tear are not durable either way
+            break
+        yield i, block
+        i += 1
+
+
 def sweep(
     key,
     batch,

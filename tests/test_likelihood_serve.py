@@ -1,0 +1,405 @@
+"""likelihood/serve.py: realization banks from sweep checkpoints (all
+on-disk states), the request-batched server (coalescing, drain
+semantics, SLO stats, telemetry names), the CLI subcommand, and the
+bench-diff direction contract for the LIKELIHOOD series."""
+import dataclasses
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models.batched import Recipe, realize
+from pta_replicator_tpu import likelihood as lk
+from pta_replicator_tpu.likelihood import gp
+
+
+@pytest.fixture(scope="module")
+def setup():
+    batch = synthetic_batch(
+        npsr=6, ntoa=128, nbackend=2, seed=2, dtype=jnp.float64
+    )
+    recipe = Recipe(
+        efac=jnp.asarray(1.1),
+        log10_equad=jnp.asarray(-6.6),
+        log10_ecorr=jnp.asarray(-6.8),
+        rn_log10_amplitude=jnp.asarray(-13.5),
+        rn_gamma=jnp.asarray(4.0),
+        rn_nmodes=10,
+        gwb_log10_amplitude=jnp.asarray(-14.2),
+        gwb_gamma=jnp.asarray(13.0 / 3.0),
+        gwb_gls_nmodes=8,
+    )
+    bank = np.asarray(
+        realize(jax.random.PRNGKey(0), batch, recipe, nreal=16)
+    )
+    return batch, recipe, bank
+
+
+# ------------------------------------------------------------- banks
+
+def test_bank_from_consolidated_checkpoint(tmp_path, setup):
+    from pta_replicator_tpu.utils.sweep import sweep
+
+    batch, recipe, _bank = setup
+    ckpt = str(tmp_path / "sweep.npz")
+    ref = sweep(
+        jax.random.PRNGKey(4), batch, recipe, nreal=8, chunk=4,
+        checkpoint_path=ckpt, reduce_fn=None,
+    )
+    bank = lk.RealizationBank.from_checkpoint(ckpt)
+    assert bank.nreal == 8
+    np.testing.assert_array_equal(bank.load(), ref)
+    # chunk-at-a-time iteration covers the same bytes
+    np.testing.assert_array_equal(
+        np.concatenate(list(bank.iter_chunks())), ref
+    )
+
+
+def test_bank_from_inflight_chunk_files(tmp_path, setup):
+    """An unfinished sweep's per-chunk .npy files serve as a bank too
+    (the serving path does not wait for consolidation)."""
+    batch, _recipe, bank_arr = setup
+    ckpt = str(tmp_path / "sweep.npz")
+    for i in range(3):
+        np.save(f"{ckpt}.chunk{i:06d}.npy", bank_arr[i * 4:(i + 1) * 4])
+    bank = lk.RealizationBank.from_checkpoint(ckpt)
+    assert bank.nreal == 12
+    np.testing.assert_array_equal(bank.load(), bank_arr[:12])
+
+
+def test_bank_refuses_missing_and_reduced(tmp_path, setup):
+    batch, _recipe, _bank = setup
+    with pytest.raises(FileNotFoundError):
+        lk.RealizationBank.from_checkpoint(str(tmp_path / "nope.npz"))
+    with pytest.raises(ValueError, match="reduce_fn"):
+        lk.RealizationBank.from_array(np.zeros((4, 6)))
+
+
+def test_iter_checkpoint_chunks_public_helper(tmp_path, setup):
+    from pta_replicator_tpu.utils.sweep import (
+        iter_checkpoint_chunks,
+        load_checkpoint_chunk,
+        sweep,
+    )
+
+    batch, recipe, _bank = setup
+    ckpt = str(tmp_path / "s.npz")
+    ref = sweep(
+        jax.random.PRNGKey(5), batch, recipe, nreal=8, chunk=4,
+        checkpoint_path=ckpt, reduce_fn=None,
+    )
+    got = dict(iter_checkpoint_chunks(ckpt))
+    assert sorted(got) == [0, 1]
+    np.testing.assert_array_equal(
+        np.concatenate([got[0], got[1]]), ref
+    )
+    np.testing.assert_array_equal(load_checkpoint_chunk(ckpt, 1), got[1])
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint_chunk(ckpt, 7)
+    # header-only probe agrees with the loaded chunks, consolidated
+    # AND in-flight layouts
+    from pta_replicator_tpu.utils.sweep import iter_checkpoint_chunk_infos
+
+    infos = list(iter_checkpoint_chunk_infos(ckpt))
+    assert [(i, s) for i, s, _d in infos] == [
+        (i, got[i].shape) for i in (0, 1)
+    ]
+    assert all(d == got[i].dtype for i, _s, d in infos)
+    ckpt2 = str(tmp_path / "inflight.npz")
+    np.save(f"{ckpt2}.chunk000000.npy", got[0])
+    infos2 = list(iter_checkpoint_chunk_infos(ckpt2))
+    assert infos2 == [(0, got[0].shape, got[0].dtype)]
+
+
+def test_bank_handle_streams_and_rows(setup):
+    """bank_loglikelihood accepts the RealizationBank handle directly
+    (projections stream through the prefetch layer — no full-cube
+    materialization) and agrees with the array path; row() loads a
+    single realization from its containing chunk only."""
+    batch, recipe, bank_arr = setup
+    bank = lk.RealizationBank.from_array(bank_arr, chunk=4)
+    grid = {"gwb_log10_amplitude": np.linspace(-14.6, -13.9, 3)}
+    ll_handle = np.asarray(
+        lk.bank_loglikelihood(bank, batch, recipe, grid=grid)
+    )
+    ll_array = np.asarray(
+        lk.bank_loglikelihood(bank_arr, batch, recipe, grid=grid)
+    )
+    np.testing.assert_allclose(ll_handle, ll_array, rtol=1e-12)
+    for i in (0, 5, 15):
+        np.testing.assert_array_equal(bank.row(i), bank_arr[i])
+    with pytest.raises(IndexError):
+        bank.row(16)
+    with pytest.raises(IndexError):
+        bank.row(-1)
+
+
+# ------------------------------------------------------------- server
+
+def test_server_results_match_direct_path(setup):
+    batch, recipe, bank_arr = setup
+    bank = lk.RealizationBank.from_array(bank_arr, chunk=8)
+    server = lk.LikelihoodServer(
+        bank, batch, recipe,
+        axes=("gwb_log10_amplitude", "gwb_gamma"),
+        max_batch=4, max_delay_s=0.01,
+    )
+    with server:
+        futs = [
+            server.submit(gwb_log10_amplitude=-14.2 - 0.05 * i,
+                          gwb_gamma=4.0 + 0.1 * i)
+            for i in range(7)
+        ]
+        outs = [f.result(timeout=60) for f in futs]
+    for i in (0, 3, 6):
+        r2 = dataclasses.replace(
+            recipe,
+            gwb_log10_amplitude=jnp.asarray(-14.2 - 0.05 * i),
+            gwb_gamma=jnp.asarray(4.0 + 0.1 * i),
+        )
+        direct = np.asarray(jax.vmap(
+            lambda r: gp.loglikelihood(r, batch, r2)
+        )(jnp.asarray(bank_arr)))
+        np.testing.assert_allclose(outs[i], direct, rtol=1e-9)
+    stats = server.stats()
+    assert stats["requests"] == 7
+    assert stats["batches"] >= 2  # 7 requests through capacity-4 batches
+    assert 0 < stats["coalesce_efficiency"] <= 1.0
+    assert stats["latency"]["count"] == 7
+    assert set(stats["latency"]) >= {"p50", "p95", "p99"}
+    assert stats["evals"] == 7 * 16
+
+
+def test_server_coalesces_concurrent_clients(setup):
+    """Concurrent submissions coalesce: far fewer batches than
+    requests (the deadline/size trigger doing its job)."""
+    batch, recipe, bank_arr = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank_arr), batch, recipe,
+        axes=("gwb_log10_amplitude",),
+        max_batch=8, max_delay_s=0.05,
+    )
+    results = [None] * 24
+
+    def client(k):
+        results[k] = server.submit(
+            gwb_log10_amplitude=-14.0 - 0.01 * k
+        ).result(timeout=60)
+
+    with server:
+        server.evaluate(gwb_log10_amplitude=-14.2)  # compile warmup
+        server.reset_stats()
+        threads = [
+            threading.Thread(target=client, args=(k,)) for k in range(24)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = server.stats()
+    assert all(r is not None for r in results)
+    assert stats["requests"] == 24
+    assert stats["batches"] < 24  # actually coalesced
+    assert stats["batch_fill_mean"] > 1.0
+
+
+def test_server_drains_queue_on_stop(setup):
+    """stop() serves queued requests instead of stranding futures."""
+    batch, recipe, bank_arr = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank_arr), batch, recipe,
+        axes=("rn_log10_amplitude",), max_batch=4, max_delay_s=10.0,
+    )
+    server.start()
+    futs = [
+        server.submit(rn_log10_amplitude=-13.5 + 0.01 * i)
+        for i in range(6)
+    ]
+    server.stop()
+    for f in futs:
+        assert np.isfinite(f.result(timeout=5)).all()
+
+
+def test_server_validates_axes_and_requests(setup):
+    batch, recipe, bank_arr = setup
+    bank = lk.RealizationBank.from_array(bank_arr)
+    with pytest.raises(ValueError, match="phi-only"):
+        lk.LikelihoodServer(bank, batch, recipe, axes=("efac",))
+    with pytest.raises(ValueError, match="max_batch"):
+        lk.LikelihoodServer(bank, batch, recipe,
+                            axes=("rn_gamma",), max_batch=0)
+    server = lk.LikelihoodServer(bank, batch, recipe,
+                                 axes=("rn_gamma",))
+    with pytest.raises(RuntimeError, match="not started"):
+        server.submit(rn_gamma=4.0)
+    with server:
+        with pytest.raises(ValueError, match="exactly"):
+            server.submit(rn_log10_amplitude=-13.0)
+
+
+def test_server_emits_registered_telemetry(setup):
+    """The SLO metrics land in the registry under their names.py
+    constants (the coverage rows in rules_telemetry pin the producer
+    side)."""
+    from pta_replicator_tpu import obs
+    from pta_replicator_tpu.obs import names
+
+    obs.reset_all()
+    batch, recipe, bank_arr = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank_arr), batch, recipe,
+        axes=("gwb_gamma",), max_batch=2, max_delay_s=0.005,
+    )
+    with server:
+        for _ in range(3):
+            server.evaluate(gwb_gamma=4.33)
+    snap = obs.REGISTRY.to_json()
+    assert snap[names.LIKELIHOOD_REQUESTS][0]["value"] == 3
+    assert snap[names.LIKELIHOOD_BATCHES][0]["value"] >= 1
+    assert snap[names.LIKELIHOOD_EVALS][0]["value"] == 3 * 16
+    assert 0 < snap[names.LIKELIHOOD_COALESCE_EFFICIENCY][0]["value"] <= 1
+    # spans: the serve phase span and at least one batch span
+    paths = {e["name"] for e in obs.TRACER.events()}
+    assert names.SPAN_LIKELIHOOD_SERVE in paths
+    assert names.SPAN_LIKELIHOOD_BATCH in paths
+    assert names.SPAN_LIKELIHOOD_PROJECT in paths
+    obs.reset_all()
+
+
+def test_project_bank_streams_through_prefetch(setup):
+    """project_bank == per-row projection, chunked through the
+    prefetch layer: bitwise identical ACROSS depths (the window is
+    scheduling, not math), and equal to the full-width vmap at float
+    tolerance (XLA fuses the ECORR scatter differently per vmap
+    width — a 1-ulp reduction-order effect, same caveat as
+    cross-topology sweep resume)."""
+    batch, recipe, bank_arr = setup
+    reduced = gp.ReducedGP.build(batch, recipe)
+    ref = jax.vmap(lambda r: reduced.project(r, batch))(
+        jnp.asarray(bank_arr)
+    )
+    projs = [
+        lk.project_bank(
+            lk.RealizationBank.from_array(bank_arr, chunk=4),
+            reduced, batch, prefetch_depth=depth,
+        )
+        for depth in (1, 2, 3)
+    ]
+    for proj in projs:
+        np.testing.assert_array_equal(
+            np.asarray(proj.rNr), np.asarray(projs[0].rNr)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(proj.d), np.asarray(projs[0].d)
+        )
+        np.testing.assert_allclose(
+            np.asarray(proj.rNr), np.asarray(ref.rNr), rtol=1e-13
+        )
+        ref_d = np.asarray(ref.d)
+        np.testing.assert_allclose(
+            np.asarray(proj.d), ref_d, rtol=1e-12,
+            atol=1e-12 * np.abs(ref_d).max(),
+        )
+
+
+# ---------------------------------------------------------------- CLI
+
+def test_cli_likelihood_grid_map_and_serve(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    batch = synthetic_batch(npsr=4, ntoa=96, seed=7)
+    recipe = Recipe(
+        efac=jnp.asarray(1.1),
+        rn_log10_amplitude=jnp.asarray(-13.5),
+        rn_gamma=jnp.asarray(4.0),
+        rn_nmodes=8,
+    )
+    bank_arr = np.asarray(
+        realize(jax.random.PRNGKey(0), batch, recipe, nreal=6)
+    )
+    bank_path = tmp_path / "bank.npy"
+    np.save(bank_path, bank_arr)
+    recipe_path = tmp_path / "recipe.json"
+    recipe_path.write_text(json.dumps({
+        "efac": 1.1, "rn_log10_amplitude": -13.5, "rn_gamma": 4.0,
+        "rn_nmodes": 8, "orf": "none",
+    }))
+    out = tmp_path / "result.json"
+    main([
+        "likelihood", "--bank", str(bank_path),
+        "--recipe", str(recipe_path),
+        "--synthetic", "4x96", "--synthetic-seed", "7",
+        "--grid", "rn_log10_amplitude=-14.0:-13.0:5",
+        "--map", "rn_log10_amplitude=-13.8",
+        "--out", str(out),
+    ])
+    doc = json.loads(out.read_text())
+    assert doc["nreal"] == 6
+    assert doc["grid"]["shape"] == [5]
+    assert len(doc["grid"]["loglikelihood_mean"]) == 5
+    assert "rn_log10_amplitude" in doc["grid"]["best"]
+    assert doc["map"]["names"] == ["rn_log10_amplitude"]
+    # serving demo prints SLO stats
+    main([
+        "likelihood", "--bank", str(bank_path),
+        "--recipe", str(recipe_path),
+        "--synthetic", "4x96", "--synthetic-seed", "7",
+        "--grid", "rn_log10_amplitude=-14.0:-13.0:5",
+        "--serve", "12", "--clients", "3", "--max-batch", "4",
+    ])
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["serve"]["requests"] == 12
+    assert "failures" not in doc["serve"]
+    assert doc["serve"]["latency"]["count"] == 12
+    # --grid and --serve coexist: the scan the user asked for is not
+    # silently dropped by the serving demo
+    assert doc["grid"]["shape"] == [5]
+
+
+def test_cli_likelihood_rejects_shape_mismatch(tmp_path):
+    from pta_replicator_tpu.__main__ import main
+
+    np.save(tmp_path / "bank.npy", np.zeros((2, 3, 50)))
+    recipe_path = tmp_path / "recipe.json"
+    recipe_path.write_text(json.dumps({"efac": 1.0, "orf": "none"}))
+    with pytest.raises(SystemExit, match="different dataset"):
+        main([
+            "likelihood", "--bank", str(tmp_path / "bank.npy"),
+            "--recipe", str(recipe_path), "--synthetic", "4x96",
+        ])
+
+
+# ------------------------------------------------- bench-diff contract
+
+def test_likelihood_bench_diff_directions():
+    """The LIKELIHOOD series' leaves classify the way the gate
+    promises: evals_per_s / coalesce_efficiency higher-better, latency
+    percentiles lower-better — and the committed round JSON diffs
+    cleanly against itself (exit 0, nothing regressed)."""
+    import os
+
+    from pta_replicator_tpu.obs.regress import bench_diff, metric_direction
+
+    assert metric_direction("raw_eval.evals_per_s") is True
+    assert metric_direction("serve.evals_per_s") is True
+    assert metric_direction("serve.coalesce_efficiency") is True
+    assert metric_direction("serve.requests_per_s") is True
+    assert metric_direction("serve.latency.p50") is False
+    assert metric_direction("serve.latency.p95") is False
+    assert metric_direction("serve.latency.p99") is False
+    assert metric_direction("raw_eval.reduced_speedup") is True
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "LIKELIHOOD_r09_cpu.json")
+    assert os.path.exists(path), (
+        "LIKELIHOOD_r09_cpu.json must be committed with the likelihood "
+        "bench evidence"
+    )
+    _table, summary, rc = bench_diff([path, path])
+    assert rc == 0 and summary["regressed"] == 0
+    assert summary["comparable"] > 10
