@@ -812,12 +812,20 @@ def main():
     # a v5e's HBM; if a future backend/shape OOMs, halve and retry so the
     # unattended end-of-round run still records a number instead of a
     # failure JSON. A user-set BENCH_CHUNK pins the ladder to that value.
-    # The child probes in-process (probe-and-hold): exit 3 = backend
-    # init wedged or failed fast, exit 4 = silent fallback to the wrong
-    # backend. Both are the flapping tunnel's transient signatures, so
-    # both retry the SAME chunk with backoff (bounded by tries) instead
-    # of failing the round — the retry semantics the old probe
-    # subprocess had, kept on the held-client path.
+    # The child probes in-process (probe-and-hold): the transient exit
+    # codes (3 = backend init wedged or failed fast, 4 = silent fallback
+    # to the wrong backend) and the backoff ladder are the SHARED tunnel
+    # policy in pta_replicator_tpu.faults.retry — one classifier, one
+    # backoff shape (20 s then 40 s, jittered) for bench AND the
+    # production supervisors (docs/robustness.md). Transient exits retry
+    # the SAME chunk (the probe failed, not the workload), bounded by
+    # tries.
+    from pta_replicator_tpu.faults.retry import (
+        TRANSIENT_EXIT_CODES,
+        TUNNEL_POLICY,
+        backoff_delay,
+    )
+
     chunks = (
         [os.environ["BENCH_CHUNK"]]
         if os.environ.get("BENCH_CHUNK")
@@ -852,7 +860,7 @@ def main():
                 + (f" after earlier attempts {tried[:-1]}" if tried[:-1] else "")
             )
             return
-        if r.returncode in (3, 4):
+        if r.returncode in TRANSIENT_EXIT_CODES:
             tail = (r.stderr or r.stdout or "").strip()[-300:]
             wedges += 1
             if wedges >= tries:
@@ -861,7 +869,7 @@ def main():
                     f"probes: {tail}"
                 )
                 return
-            time.sleep(20.0 * wedges)
+            time.sleep(backoff_delay(wedges, TUNNEL_POLICY))
             continue  # same chunk — the probe failed, not the workload
         lines = [l for l in r.stdout.splitlines() if l.strip().startswith("{")]
         if r.returncode == 0 and lines:

@@ -1,0 +1,357 @@
+"""Chaos bench: the flagship-shaped sweep under seeded fault schedules,
+pinned byte-identical to the fault-free run — plus a serving-saturation
+arm proving admission control sheds load instead of growing the queue.
+
+The robustness contract PR 11 ships (docs/robustness.md) is only worth
+committing if it is *measured*: this bench runs the pipelined sweep
+(reduce_fn=None — full residual cubes through readback + checkpoint
+I/O, the I/O-heavy flagship shape) fault-free once, then under several
+RANDOMIZED-BUT-SEEDED fault schedules, each containing at least
+
+* one transient chunk failure (``drain:raise@chunk=K``),
+* one injected stall long enough to trip the executor's
+  ``DrainTimeout`` (``drain:stall=S@chunk=K2`` with S > the arm's
+  drain deadline), and
+* one torn checkpoint write (``checkpoint_write:torn@call=N`` — the
+  in-flight temp file is truncated mid-write and the write raises,
+  exactly the artifact an interrupted write leaves),
+
+and asserts every chaos arm (a) completes — the supervised-recovery
+loop absorbs all of it, (b) produces a consolidated checkpoint
+BYTE-IDENTICAL to the fault-free run (sha256 over the file), and
+(c) shows its retries in telemetry (``sweep.chunk_retries`` advanced —
+a recovery nobody can see is indistinguishable from a wedge). The
+headline ``fault_overhead`` is the median faulted wall over the
+fault-free wall, minus one: what surviving this schedule *costs*.
+
+The server arm floods a deadline-bounded, queue-bounded
+``LikelihoodServer`` far past its capacity and asserts rejects
+(``ServerSaturated``) and deadline expiries happened instead of
+unbounded queue growth, and that every admitted future resolved
+(result or exception — never stranded) after ``stop()``.
+
+Prints one JSON line; committed as ``CHAOS_r11_cpu.json``. Exit 1 when
+any gate fails, so CI can run a small configuration directly.
+
+Usage: python benchmarks/chaos_sweep.py [--fast]
+  env: CHAOS_NREAL/CHAOS_CHUNK/CHAOS_NPSR/CHAOS_NTOA/CHAOS_ARMS/
+  CHAOS_SEED/CHAOS_SERVE_N reshape the workload (--fast presets a
+  seconds-scale CI configuration).
+"""
+import hashlib
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from pta_replicator_tpu import likelihood as lk  # noqa: E402
+from pta_replicator_tpu.batch import synthetic_batch  # noqa: E402
+from pta_replicator_tpu.faults import inject  # noqa: E402
+from pta_replicator_tpu.faults.retry import RetryPolicy  # noqa: E402
+from pta_replicator_tpu.models.batched import Recipe  # noqa: E402
+from pta_replicator_tpu.obs import REGISTRY, counter, names  # noqa: E402
+from pta_replicator_tpu.utils.provenance import (  # noqa: E402
+    EVIDENCE_SCHEMA_VERSION,
+    provenance_stamp,
+)
+from pta_replicator_tpu.utils.sweep import sweep  # noqa: E402
+
+#: the per-arm drain deadline; injected stalls exceed it so every chaos
+#: arm exercises the DrainTimeout -> classify-transient -> resume path
+DRAIN_TIMEOUT_S = 2.0
+STALL_S = 2 * DRAIN_TIMEOUT_S
+
+#: fast in-process recovery for a bench that injects its own faults
+#: (production default backoff is 0.5 s base — here that would just
+#: pad fault_overhead with sleep)
+RETRY_POLICY = RetryPolicy(max_attempts=5, base_delay_s=0.1,
+                           multiplier=2.0, max_delay_s=2.0, jitter=0.25)
+
+
+def _sha(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for blk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(blk)
+    return h.hexdigest()
+
+
+def _faults_injected_total() -> float:
+    """Sum of the labeled faults.injected counters (site= x kind=)."""
+    return sum(
+        m.value for m in REGISTRY.metrics()
+        if getattr(m, "name", None) == names.FAULTS_INJECTED
+    )
+
+
+def make_schedule(rng: random.Random, nchunks: int) -> str:
+    """One randomized schedule satisfying the chaos gate: >=1 transient
+    chunk failure, >=1 DrainTimeout-tripping stall, >=1 torn checkpoint
+    write — plus an optional seeded device-lost extra."""
+    chunks = rng.sample(range(1, nchunks), 2)
+    specs = [
+        f"drain:raise@chunk={chunks[0]}",
+        f"drain:stall={STALL_S:g}@chunk={chunks[1]}",
+        # every chunk issues two checkpoint_write calls (chunk file +
+        # meta sidecar): any call index lands on a real write
+        f"checkpoint_write:torn@call={rng.randint(2, 2 * nchunks - 1)}",
+    ]
+    if rng.random() < 0.5:
+        specs.append(f"dispatch:device_lost@chunk={rng.randint(1, nchunks - 1)}")
+    return ";".join(specs)
+
+
+def run_sweep_arm(key, batch, recipe, nreal, chunk, path,
+                  schedule=None, seed=0):
+    """One sweep run (optionally under an armed schedule); returns
+    (wall_s, sha256, chunk_retries_delta, faults_fired)."""
+    retries0 = counter(names.SWEEP_CHUNK_RETRIES).value
+    injected0 = _faults_injected_total()
+    fired = []
+    t0 = time.monotonic()
+    if schedule is None:
+        sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+              checkpoint_path=path, reduce_fn=None,
+              drain_timeout_s=DRAIN_TIMEOUT_S,
+              retry_policy=RETRY_POLICY)
+    else:
+        with inject.armed(schedule, seed=seed):
+            sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+                  checkpoint_path=path, reduce_fn=None,
+                  drain_timeout_s=DRAIN_TIMEOUT_S, chunk_retries=4,
+                  retry_policy=RETRY_POLICY)
+            fired = inject.fired()
+    wall = time.monotonic() - t0
+    return (
+        wall, _sha(path),
+        counter(names.SWEEP_CHUNK_RETRIES).value - retries0,
+        fired if schedule is not None
+        else _faults_injected_total() - injected0,
+    )
+
+
+def run_server_arm(ckpt, batch, recipe, serve_n: int) -> dict:
+    """Flood a bounded/deadline'd server far past capacity from
+    closed-loop-free submitters: the point is saturation, so clients
+    do NOT wait between submits."""
+    import threading
+
+    bank = lk.RealizationBank.from_checkpoint(ckpt)
+    # a 10 ms deadline against a 16-deep queue and ~ms engine batches:
+    # requests admitted near the back of a full queue expire before
+    # their batch forms — the bench shows BOTH shedding mechanisms
+    server = lk.LikelihoodServer(
+        bank, batch, recipe, axes=("rn_log10_amplitude",),
+        max_batch=4, max_delay_s=0.002,
+        max_queue=16, request_deadline_s=0.01,
+    )
+    futs = []
+    futs_lock = threading.Lock()
+
+    def flood(lo, hi):
+        rng = np.random.default_rng(lo)
+        for _ in range(lo, hi):
+            try:
+                f = server.submit(
+                    rn_log10_amplitude=float(rng.uniform(-14.5, -13.0))
+                )
+            except lk.ServerSaturated:
+                continue  # shed; counted server-side in stats()
+            with futs_lock:
+                futs.append(f)
+
+    with server:
+        server.evaluate(rn_log10_amplitude=-13.5)  # compile warmup
+        server.reset_stats()
+        # exact partition: all serve_n submits are attempted, so the
+        # reported "submitted" reconciles with admitted + rejected
+        bounds = [k * serve_n // 4 for k in range(5)]
+        threads = [
+            threading.Thread(target=flood,
+                             args=(bounds[k], bounds[k + 1]))
+            for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    # stats AFTER stop(): the flood outruns the worker, so a snapshot
+    # taken at join time misses the queued tail the drain still serves
+    stats = server.stats()
+    served = expired = stranded = 0
+    for f in futs:
+        if not f.done():
+            stranded += 1
+            continue
+        if f.exception() is None:
+            served += 1
+        elif isinstance(f.exception(), lk.DeadlineExpired):
+            expired += 1
+    return {
+        "submitted": serve_n,
+        "admitted": len(futs),
+        "served": served,
+        "rejected": stats["rejected"],
+        "deadline_expired": stats["deadline_expired"],
+        "expired_futures": expired,
+        "stranded_futures": stranded,
+        "max_queue": server.max_queue,
+        "request_deadline_s": server.request_deadline_s,
+        "latency": stats["latency"],
+        "coalesce_efficiency": round(stats["coalesce_efficiency"], 4),
+        # the gate: under ~serve_n requests against a 16-deep queue,
+        # load was SHED (rejects and/or expiries), nothing stranded
+        "queue_bounded": bool(
+            stats["rejected"] > 0 and stranded == 0
+        ),
+    }
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv[1:]
+    nreal = int(os.environ.get("CHAOS_NREAL", "96" if fast else "256"))
+    chunk = int(os.environ.get("CHAOS_CHUNK", "16" if fast else "32"))
+    npsr = int(os.environ.get("CHAOS_NPSR", "4" if fast else "8"))
+    ntoa = int(os.environ.get("CHAOS_NTOA", "1024" if fast else "4096"))
+    arms = int(os.environ.get("CHAOS_ARMS", "1" if fast else "3"))
+    seed = int(os.environ.get("CHAOS_SEED", "11"))
+    serve_n = int(os.environ.get("CHAOS_SERVE_N", "200" if fast else "400"))
+
+    nchunks = nreal // chunk
+    if nreal % chunk or nchunks < 3:
+        raise SystemExit(
+            f"chaos_sweep needs nreal a multiple of chunk and >= 3 "
+            f"chunks to place a raise + a stall on distinct non-zero "
+            f"chunks (got CHAOS_NREAL={nreal}, CHAOS_CHUNK={chunk} -> "
+            f"{nchunks} chunks)"
+        )
+
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=3,
+                            dtype=np.float64)
+    recipe = Recipe(
+        efac=jnp.ones(npsr),
+        rn_log10_amplitude=jnp.full(npsr, -13.5),
+        rn_gamma=jnp.full(npsr, 4.0),
+    )
+    key = jax.random.PRNGKey(7)
+    rng = random.Random(seed)
+
+    d = tempfile.mkdtemp(prefix="chaos_sweep_")
+    failures = []
+    try:
+        # warmup: compile outside every timed arm
+        sweep(key, batch, recipe, nreal=chunk, chunk=chunk,
+              checkpoint_path=os.path.join(d, "warm.npz"),
+              reduce_fn=None)
+
+        ref_ck = os.path.join(d, "ref.npz")
+        ref_wall, ref_sha, _r, _f = run_sweep_arm(
+            key, batch, recipe, nreal, chunk, ref_ck
+        )
+
+        chaos = []
+        for a in range(arms):
+            schedule = make_schedule(rng, nchunks)
+            ck = os.path.join(d, f"chaos{a}.npz")
+            try:
+                wall, sha, retries, fired = run_sweep_arm(
+                    key, batch, recipe, nreal, chunk, ck,
+                    schedule=schedule, seed=seed + a,
+                )
+            except BaseException as exc:  # noqa: BLE001 — the bench verdict
+                failures.append(
+                    f"arm {a} ({schedule}) did not recover: {exc!r}"
+                )
+                chaos.append({"schedule": schedule, "recovered": False,
+                              "error": repr(exc)[:300]})
+                continue
+            arm_rec = {
+                "schedule": schedule,
+                "recovered": True,
+                "wall_s": round(wall, 3),
+                "byte_identical": sha == ref_sha,
+                "chunk_retries": retries,
+                "faults_fired": len(fired),
+                "fired": fired,
+            }
+            chaos.append(arm_rec)
+            if not arm_rec["byte_identical"]:
+                failures.append(f"arm {a} checkpoint diverged")
+            if retries < 1:
+                failures.append(f"arm {a} recovered with no visible retry")
+            if len(fired) < 3:
+                failures.append(
+                    f"arm {a} fired only {len(fired)} of >=3 faults"
+                )
+
+        server = run_server_arm(ref_ck, batch, recipe, serve_n)
+        if not server["queue_bounded"]:
+            failures.append(
+                "server arm: no rejects under saturation, or stranded "
+                f"futures ({server})"
+            )
+
+        recovered = sum(1 for c in chaos if c.get("recovered"))
+        walls = [c["wall_s"] for c in chaos if c.get("recovered")]
+        rec = {
+            "bench": "chaos_sweep",
+            "backend": jax.default_backend(),
+            "nreal": nreal, "chunk": chunk, "nchunks": nchunks,
+            "npsr": npsr, "ntoa": ntoa,
+            "drain_timeout_s": DRAIN_TIMEOUT_S,
+            "stall_s": STALL_S,
+            "seed": seed,
+            "fault_free_s": round(ref_wall, 3),
+            "chaos_runs": arms,
+            "recovered_runs": recovered,
+            "byte_identical_all": all(
+                c.get("byte_identical") for c in chaos
+            ),
+            # what surviving a schedule costs: median faulted wall over
+            # the fault-free wall, minus one (ratio), and the absolute
+            # seconds. On this seconds-scale CPU workload the absolute
+            # number is the honest one — it is dominated by the
+            # injected stall's drain deadline + the backoff ladder,
+            # fixed costs the ratio amortizes away as the workload
+            # grows to flagship scale
+            "fault_overhead": (
+                round(float(np.median(walls)) / ref_wall - 1.0, 3)
+                if walls else None
+            ),
+            "fault_overhead_s": (
+                round(float(np.median(walls)) - ref_wall, 3)
+                if walls else None
+            ),
+            "chaos": chaos,
+            "server": server,
+            "ok": not failures,
+            "failures": failures,
+            **provenance_stamp(
+                EVIDENCE_SCHEMA_VERSION,
+                repo_root=os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                ),
+            ),
+        }
+        print(json.dumps(rec))
+        return 1 if failures else 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -151,6 +151,15 @@ def main(argv=None):
     p.add_argument("--clients", type=int, default=4)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-delay-ms", type=float, default=5.0)
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="bounded request queue: submissions past this "
+                        "are rejected (ServerSaturated) instead of "
+                        "growing the queue — the serving demo reports "
+                        "the rejected count in its SLO block")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request queue deadline: a request unserved "
+                        "past this raises DeadlineExpired instead of "
+                        "being evaluated late")
     p.add_argument("--telemetry", default=None, metavar="DIR")
     p.add_argument("--out", default=None,
                    help="write the result JSON here instead of stdout")
@@ -254,6 +263,11 @@ def main(argv=None):
                         "(wedged tunnel/filesystem). Raise it for "
                         "legitimately slow large-chunk readbacks; "
                         "<= 0 disables the deadline")
+    p.add_argument("--chunk-retries", type=int, default=2,
+                   help="transient chunk failures absorbed per failing "
+                        "chunk by resuming from the checkpoint sidecar "
+                        "(exponential backoff; docs/robustness.md). 0 "
+                        "restores fail-fast")
     p.add_argument("--write-partim", default=None, metavar="DIR",
                    help="also materialize realizations as par/tim datasets "
                         "under DIR/real{r:05d}/ (pre-fit injected delays, "
@@ -262,12 +276,31 @@ def main(argv=None):
                    help="cap on datasets written by --write-partim")
     for sp in sub.choices.values():
         sp.add_argument(
+            "--faults", default=None, metavar="SCHEDULE",
+            help="arm a fault-injection schedule (chaos testing, "
+                 "docs/robustness.md), e.g. 'drain:raise@chunk=2;"
+                 "checkpoint_write:torn@call=3'. Equivalent env: "
+                 "PTA_FAULTS")
+        sp.add_argument("--faults-seed", type=int, default=0,
+                        help="seed for probabilistic fault triggers")
+        sp.add_argument(
             "--platform", default=None,
             help="force a jax platform (e.g. 'cpu'); default: the "
                  "session's backend. Deliberately not read from "
                  "JAX_PLATFORMS (hosted environments preset it to a "
                  "remote plugin that hangs when unreachable)")
     args = ap.parse_args(argv)
+
+    # chaos arming: the --faults flag wins, the PTA_FAULTS env var
+    # covers entry points that never parse flags (tests, benches).
+    # Disarmed (the overwhelmingly common case) this is one None check
+    # per injection site at runtime (faults/inject.py)
+    from .faults import inject as _faults_inject
+
+    if getattr(args, "faults", None):
+        _faults_inject.arm(args.faults, seed=args.faults_seed)
+    else:
+        _faults_inject.arm_from_env()
 
     if args.cmd == "report":
         from .obs.report import print_report
@@ -514,6 +547,10 @@ def _serve_demo(args, bank, batch, recipe, grid_axes):
         bank, batch, recipe, axes=tuple(grid_axes),
         max_batch=args.max_batch,
         max_delay_s=args.max_delay_ms / 1e3,
+        max_queue=args.max_queue,
+        request_deadline_s=(
+            None if args.deadline_ms is None else args.deadline_ms / 1e3
+        ),
     )
     rng = np.random.default_rng(0)
     points = {
@@ -522,13 +559,21 @@ def _serve_demo(args, bank, batch, recipe, grid_axes):
     failures = []
 
     def client(lo, hi):
-        futs = [
-            server.submit(**{k: points[k][i] for k in points})
-            for i in range(lo, hi)
-        ]
+        futs = []
+        for i in range(lo, hi):
+            try:
+                futs.append(
+                    server.submit(**{k: points[k][i] for k in points})
+                )
+            except lk.ServerSaturated:
+                # admission control shed the request — exactly what
+                # --max-queue asks for; counted in stats()["rejected"]
+                continue
         for f in futs:
             try:
                 f.result(timeout=120)
+            except lk.DeadlineExpired:
+                pass  # shed by deadline; counted in stats()
             except Exception as exc:  # noqa: BLE001 — reported below
                 failures.append(repr(exc))
 
@@ -625,6 +670,7 @@ def _run_command(args):
                         drain_timeout_s=(args.drain_timeout
                                          if args.drain_timeout > 0
                                          else None),
+                        chunk_retries=args.chunk_retries,
                         progress=lambda d, t: print(f"chunk {d}/{t}",
                                                     file=sys.stderr))
         elif args.sharded or args.mesh_shape:

@@ -184,13 +184,20 @@ class Rule:
 
 def default_rules() -> List[Rule]:
     """The shipped rule packs (imported lazily to avoid cycles)."""
-    from . import rules_jax, rules_obs, rules_telemetry, rules_threads
+    from . import (
+        rules_jax,
+        rules_obs,
+        rules_robust,
+        rules_telemetry,
+        rules_threads,
+    )
 
     return [
         *rules_jax.RULES,
         *rules_threads.RULES,
         *rules_telemetry.RULES,
         *rules_obs.RULES,
+        *rules_robust.RULES,
     ]
 
 

@@ -1,0 +1,146 @@
+"""graftlint rule pack: robustness discipline in threaded/pipeline code.
+
+PR 11 made the production paths fault-tolerant: errors are CLASSIFIED
+(faults/retry.py), retried when transient, and always *visible* — a
+counter bump, a ``faults.retry`` event, a recorded ``errors.append``,
+a re-raise. The one shape that silently defeats all of that is the
+broad swallowed handler::
+
+    except Exception:
+        pass            # the fault never happened, as far as anyone knows
+
+In a threaded executor that's not just lost information — it's a hang
+factory: a worker that swallows its failure keeps its queue peers
+waiting forever, and the flight recorder's stall watchdog is the only
+thing left to notice. Hence:
+
+* ``robust-swallowed-exception`` — inside package modules that use
+  threads (the pipeline/prefetch/serving/obs executors — the same
+  ``_uses_threads`` gate the thread rules key on), flag an
+  ``except Exception:`` / ``except BaseException:`` / bare ``except:``
+  handler whose body does none of the following:
+
+  - **re-raises** (any ``raise``),
+  - **records the exception object** (the handler binds ``as exc`` and
+    the body *uses* that name — ``errors.append(exc)``,
+    ``fut.set_exception(exc)``, ``_fail(stage, exc)``,
+    ``repr(exc)`` in a log line all count: the error object went
+    somewhere a human or supervisor can see),
+  - **logs or counts** (a call to ``print`` / a ``logging``-style
+    method / ``counter(...).inc`` / ``event(...)`` inside the body),
+  - **degrades to an explicit fallback value** (``return {}`` /
+    ``return False`` — the caller-visible "unavailable" contract the
+    obs probes document; the degradation is in the API, not invisible).
+
+The firing shape is the pure swallow: ``pass``, ``continue``, or a
+bare fallback assignment with nothing observable.
+
+Narrow handlers (``except OSError:`` cleanup) are out of scope by
+design — the rule polices *indiscriminate* swallowing, not considered
+error handling. Intentional broad-and-silent sites (best-effort close
+on an error path that re-raises the ORIGINAL exception one frame up)
+carry an inline ``# graftlint: disable=robust-swallowed-exception``
+with the reason, which is the reviewer-visible record the suppression
+mechanism exists for.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .engine import Finding, Module, Rule
+from .rules_threads import _uses_threads
+
+#: the subtree this pack polices (posix relpath prefix)
+PKG_PREFIX = "pta_replicator_tpu/"
+
+#: broad exception type names that make a handler a candidate
+_BROAD = {"Exception", "BaseException", "builtins.Exception",
+          "builtins.BaseException"}
+
+#: call terminals that count as making the failure visible even when
+#: the exception object itself isn't referenced (a counter bump or a
+#: log line IS the visibility)
+_VISIBILITY_CALLS = {
+    "print", "log", "debug", "info", "warning", "warn", "error",
+    "exception", "critical", "counter", "inc", "event", "write",
+}
+
+
+def _is_broad(mod: Module, handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for node in types:
+        resolved = mod.resolve(node) or ""
+        if resolved in _BROAD:
+            return True
+    return False
+
+
+def _call_terminal(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def _handled(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body re-raises, uses the bound exception
+    name, or calls something on the visibility list."""
+    bound = handler.name  # "exc" in `except Exception as exc`
+    for node in ast.walk(ast.Module(body=handler.body,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Return) and node.value is not None:
+            return True  # explicit fallback value: a documented degrade
+        if (
+            bound
+            and isinstance(node, ast.Name)
+            and node.id == bound
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True  # the exception object went somewhere
+        if isinstance(node, ast.Call) and (
+            _call_terminal(node) in _VISIBILITY_CALLS
+        ):
+            return True
+    return False
+
+
+class SwallowedException(Rule):
+    id = "robust-swallowed-exception"
+    severity = "error"
+    description = (
+        "broad except handler in a threaded/pipeline module that "
+        "neither re-raises, records the exception, logs, nor bumps a "
+        "counter — an invisible fault in exactly the code where "
+        "invisible faults become hangs"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not mod.relpath.startswith(PKG_PREFIX):
+            return
+        if not _uses_threads(mod):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(mod, node):
+                continue
+            if _handled(node):
+                continue
+            yield self.finding(
+                mod, node.lineno,
+                "broad except swallows the error silently: re-raise, "
+                "record the exception object (errors.append / "
+                "set_exception / a log line), bump a counter — or "
+                "suppress inline with the reason",
+            )
+
+
+RULES = [SwallowedException()]

@@ -256,6 +256,21 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/likelihood/infer.py", "jit", n.JIT_LIKELIHOOD_ENGINE),
         (f"{pkg}/likelihood/infer.py", "jit",
          n.JIT_LIKELIHOOD_REDUCED_ENGINE),
+        # robustness layer (PR 11): fault firings must stay countable
+        # and event-visible (a chaos run with silent faults proves
+        # nothing), the supervised-recovery retries must stay
+        # distinguishable from wedges in watch, and the serving path's
+        # admission-control/deadline SLO counters must not silently
+        # un-instrument
+        (f"{pkg}/faults/inject.py", "metric", n.FAULTS_INJECTED),
+        (f"{pkg}/faults/inject.py", "event", n.EVENT_FAULT_FIRED),
+        (f"{pkg}/faults/retry.py", "event", n.EVENT_FAULT_RETRY),
+        (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_CHUNK_RETRIES),
+        (f"{pkg}/parallel/prefetch.py", "metric",
+         n.CW_STREAM_STAGE_RETRIES),
+        (f"{pkg}/likelihood/serve.py", "metric", n.LIKELIHOOD_REJECTED),
+        (f"{pkg}/likelihood/serve.py", "metric",
+         n.LIKELIHOOD_DEADLINE_EXPIRED),
         (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),
         (f"{pkg}/obs/flightrec.py", "event", n.EVENT_FLIGHTREC_STALL),
         # stage-occupancy + device-cost layer (PR 6): the heartbeat's

@@ -1,0 +1,32 @@
+"""Fault injection + supervised recovery (docs/robustness.md).
+
+Two halves, deliberately in one package so the machinery and the thing
+that exercises it can never drift apart:
+
+* :mod:`.inject` — deterministic, seedable fault injection at named
+  sites inside the existing stage spans (zero overhead disarmed;
+  armed by schedule string, env var, or CLI flag).
+* :mod:`.retry` — the ONE transient-vs-fatal classifier and
+  exponential-backoff policy shared by the sweep's chunk-retry
+  supervision (utils/sweep.py), the prefetch staging retry
+  (parallel/prefetch.py), the serving engine retry
+  (likelihood/serve.py), and bench.py's tunnel ladder.
+
+stdlib-only and jax-free end to end.
+"""
+from . import inject, retry
+from .inject import InjectedFault, arm, arm_from_env, armed, disarm, fire
+from .retry import (
+    DEFAULT_POLICY,
+    TUNNEL_POLICY,
+    RetryPolicy,
+    backoff_delay,
+    is_transient,
+    retry_call,
+)
+
+__all__ = [
+    "inject", "retry", "InjectedFault", "arm", "arm_from_env", "armed",
+    "disarm", "fire", "RetryPolicy", "DEFAULT_POLICY", "TUNNEL_POLICY",
+    "backoff_delay", "is_transient", "retry_call",
+]

@@ -1,0 +1,376 @@
+"""Deterministic, seedable fault injection at named sites.
+
+The production paths this repo ships — the pipelined/mesh sweep
+(parallel/pipeline.py + utils/sweep.py), the host->device prefetch
+stream (parallel/prefetch.py), and the likelihood serving loop
+(likelihood/serve.py) — were fail-fast end to end until PR 11: one
+transient device hiccup killed a multi-hour run. The supervised
+recovery machinery that fixed that (faults/retry.py + the sweep's
+chunk-retry loop) is only trustworthy if it can be *exercised on
+demand*: this module plants named injection sites inside the existing
+stage spans and fires scheduled faults through them, deterministically,
+so a chaos run is reproducible down to the chunk index
+(benchmarks/chaos_sweep.py pins the recovered checkpoint byte-identical
+to the fault-free run).
+
+Design constraints, in order:
+
+* **Zero overhead disarmed.** Every site is one module-global ``None``
+  check (:func:`fire` returns immediately); no schedule parsing, no
+  telemetry, no locks ever run in a production process that didn't opt
+  in. Arming is explicit: :func:`arm` / :func:`armed` in code, or the
+  ``PTA_FAULTS`` env var / ``--faults`` CLI flag via
+  :func:`arm_from_env`.
+* **Deterministic.** Triggers are by chunk index (``chunk=K``), by nth
+  call at the site (``call=N``), or seeded-probabilistic (``p=P`` with
+  the schedule seed) — same schedule + seed + workload => same faults
+  at the same points, every run.
+* **Observable.** Every firing bumps the ``faults.injected`` counter
+  (labeled ``site=``/``kind=``) and emits a ``faults.fired`` event, so
+  the flight recorder's ring and ``watch`` distinguish "retrying
+  through injected faults" from "wedged" (docs/robustness.md).
+
+Injection sites (the ``SITES`` table) sit inside the stage spans they
+perturb, so a fault is attributed to the stage it would naturally occur
+in: ``dispatch`` / ``drain`` / ``io_write`` (the sweep executor),
+``cw_stream_stage`` (prefetch H2D staging), ``checkpoint_write`` /
+``checkpoint_fsync`` (the atomic checkpoint layer — the only sites that
+support ``torn``, which truncates the in-flight temp file before
+raising, leaving exactly the torn artifact a mid-write crash leaves),
+and ``likelihood_batch`` (the server's engine call).
+
+Schedule grammar (one spec per fault, ``;``-separated)::
+
+    site:kind[=param]@trigger[xN]
+
+    kinds    raise | fatal | stall=SECONDS | torn | enospc | device_lost
+    triggers chunk=K | call=N | p=P        (p uses the schedule seed)
+    xN       fire up to N times (default 1 — one-shot, recoverable)
+
+Examples: ``drain:raise@chunk=2`` (transient exception on chunk 2's
+readback), ``checkpoint_write:torn@call=3`` (truncate the 3rd
+checkpoint temp file mid-write), ``drain:stall=4@chunk=1`` (wedge chunk
+1's readback long enough to trip the sweep's ``DrainTimeout``),
+``cw_stream_stage:device_lost@p=0.1x3`` (seeded 10% device-lost per
+staged tile, at most 3 firings).
+
+stdlib-only and jax-free; telemetry imports are deferred to the firing
+branch so a disarmed process never pays them.
+"""
+from __future__ import annotations
+
+import errno
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Union
+
+#: the named injection sites wired into the library. Sites named after
+#: stage spans fire inside that span; the checkpoint sites fire inside
+#: utils/sweep's atomic-write/fsync layer (where ``torn`` has a temp
+#: file to tear).
+SITE_DISPATCH = "dispatch"
+SITE_DRAIN = "drain"
+SITE_IO_WRITE = "io_write"
+SITE_PREFETCH_STAGE = "cw_stream_stage"
+SITE_CHECKPOINT_WRITE = "checkpoint_write"
+SITE_CHECKPOINT_FSYNC = "checkpoint_fsync"
+SITE_SERVER_ENGINE = "likelihood_batch"
+
+SITES = frozenset({
+    SITE_DISPATCH, SITE_DRAIN, SITE_IO_WRITE, SITE_PREFETCH_STAGE,
+    SITE_CHECKPOINT_WRITE, SITE_CHECKPOINT_FSYNC, SITE_SERVER_ENGINE,
+})
+
+KINDS = frozenset({
+    "raise", "fatal", "stall", "torn", "enospc", "device_lost",
+})
+
+
+class InjectedFault(RuntimeError):
+    """A scheduled fault fired. ``transient`` drives the shared
+    classifier (faults/retry.py): transient faults are what the
+    supervised-recovery machinery must absorb; fatal ones must
+    re-raise through every retry layer unchanged."""
+
+    def __init__(self, site: str, kind: str, transient: bool = True,
+                 detail: str = ""):
+        self.site = site
+        self.kind = kind
+        self.transient = transient
+        msg = f"injected fault at {site!r}: {kind}"
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+
+
+@dataclass
+class FaultSpec:
+    """One scheduled fault: where, what, and when."""
+
+    site: str
+    kind: str
+    stall_s: float = 0.0          # kind == "stall"
+    chunk: Optional[int] = None   # trigger: ctx chunk/tile index == K
+    call: Optional[int] = None    # trigger: Nth call at the site (1-based)
+    p: Optional[float] = None     # trigger: seeded probability per call
+    max_fires: int = 1
+    # runtime state (owned by the armed schedule, mutated under its lock)
+    calls: int = field(default=0, repr=False)
+    fires: int = field(default=0, repr=False)
+
+    def spec_str(self) -> str:
+        kind = self.kind
+        if self.kind == "stall":
+            kind = f"stall={self.stall_s:g}"
+        if self.chunk is not None:
+            trig = f"chunk={self.chunk}"
+        elif self.call is not None:
+            trig = f"call={self.call}"
+        else:
+            trig = f"p={self.p:g}"
+        tail = f"x{self.max_fires}" if self.max_fires != 1 else ""
+        return f"{self.site}:{kind}@{trig}{tail}"
+
+
+def parse_schedule(text: str) -> List[FaultSpec]:
+    """Parse the ``;``-separated schedule grammar into specs.
+
+    Raises ``ValueError`` with the offending spec on any malformed
+    entry — a chaos run with a typo'd schedule must refuse to start,
+    not silently run fault-free."""
+    specs: List[FaultSpec] = []
+    for raw in text.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            head, trig = raw.split("@", 1)
+            site, kind = head.split(":", 1)
+            site = site.strip()
+            kind = kind.strip()
+            stall_s = 0.0
+            if "=" in kind:
+                kind, param = kind.split("=", 1)
+                if kind != "stall":
+                    raise ValueError(f"kind {kind!r} takes no parameter")
+                stall_s = float(param)
+            if site not in SITES:
+                raise ValueError(
+                    f"unknown site {site!r} (sites: {sorted(SITES)})"
+                )
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown kind {kind!r} (kinds: {sorted(KINDS)})"
+                )
+            if kind == "torn" and site not in (
+                SITE_CHECKPOINT_WRITE, SITE_CHECKPOINT_FSYNC
+            ):
+                raise ValueError(
+                    "torn faults need a file to tear — only the "
+                    "checkpoint_write/checkpoint_fsync sites support them"
+                )
+            max_fires = 1
+            trig = trig.strip()
+            if "x" in trig.rsplit("=", 1)[-1]:
+                trig, n = trig.rsplit("x", 1)
+                max_fires = int(n)
+            tkey, _, tval = trig.partition("=")
+            tkey = tkey.strip()
+            spec = FaultSpec(site=site, kind=kind, stall_s=stall_s,
+                             max_fires=max_fires)
+            if tkey == "chunk":
+                spec.chunk = int(tval)
+            elif tkey == "call":
+                spec.call = int(tval)
+                if spec.call < 1:
+                    raise ValueError("call trigger is 1-based")
+            elif tkey == "p":
+                spec.p = float(tval)
+                if not 0.0 < spec.p <= 1.0:
+                    raise ValueError("p must be in (0, 1]")
+            else:
+                raise ValueError(
+                    f"unknown trigger {tkey!r} (chunk=K | call=N | p=P)"
+                )
+        except ValueError as exc:
+            raise ValueError(f"bad fault spec {raw!r}: {exc}") from None
+        specs.append(spec)
+    return specs
+
+
+class _Schedule:
+    """The armed schedule: specs + seeded RNG + the fired-fault log."""
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self.lock = threading.Lock()
+        # one independent seeded stream per spec: firing order at one
+        # site can't perturb another spec's draws
+        self.rngs = [
+            random.Random(self.seed * 1_000_003 + i)
+            for i in range(len(specs))
+        ]
+        self.log: List[dict] = []  # bounded: see _record
+
+    def _record(self, rec: dict) -> None:
+        # bounded evidence ring (chaos benches read it back): cap, drop
+        # oldest — a runaway p-trigger must not grow host memory
+        self.log.append(rec)
+        if len(self.log) > 256:
+            del self.log[0]
+
+
+#: the armed schedule, or None (the zero-overhead disarmed state)
+_STATE: Optional[_Schedule] = None
+
+
+def arm(schedule: Union[str, Sequence[FaultSpec]], seed: int = 0) -> None:
+    """Arm a fault schedule process-wide. ``schedule`` is either the
+    grammar string or pre-built specs."""
+    global _STATE
+    specs = (
+        parse_schedule(schedule) if isinstance(schedule, str)
+        else list(schedule)
+    )
+    _STATE = _Schedule(specs, seed)
+
+
+def disarm() -> None:
+    global _STATE
+    _STATE = None
+
+
+def is_armed() -> bool:
+    return _STATE is not None
+
+
+def fired() -> List[dict]:
+    """Records of every fault fired since arming (site, kind, trigger
+    context) — the chaos bench's evidence trail."""
+    state = _STATE
+    if state is None:
+        return []
+    with state.lock:
+        return list(state.log)
+
+
+class armed:
+    """Context manager: arm for the block, restore on exit (tests)."""
+
+    def __init__(self, schedule, seed: int = 0):
+        self._schedule = schedule
+        self._seed = seed
+
+    def __enter__(self):
+        self._saved = _STATE
+        arm(self._schedule, seed=self._seed)
+        return _STATE
+
+    def __exit__(self, *exc):
+        global _STATE
+        _STATE = self._saved
+
+
+def arm_from_env(env: str = "PTA_FAULTS",
+                 seed_env: str = "PTA_FAULTS_SEED") -> bool:
+    """Arm from ``PTA_FAULTS`` / ``PTA_FAULTS_SEED`` when set; returns
+    whether a schedule was armed. Called by the CLI entry point so any
+    subcommand can be chaos'd without code changes."""
+    text = os.environ.get(env)
+    if not text:
+        return False
+    arm(text, seed=int(os.environ.get(seed_env, "0")))
+    return True
+
+
+def _tear(path: str) -> None:
+    """Truncate ``path`` to half its size — the torn artifact an
+    interrupted write leaves. The caller's atomic-write layer never
+    renamed it into place, so the *final* checkpoint stays consistent;
+    what this exercises is the retry overwriting the torn temp."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            fh.truncate(size // 2)
+    except OSError:
+        pass  # the raise below is the fault either way
+
+
+def fire(site: str, **ctx) -> None:
+    """The injection point. Disarmed: one ``None`` check, returns.
+
+    Armed: match ``ctx`` against every spec for this site and perform
+    the first matching fault. ``ctx`` carries the trigger inputs —
+    ``chunk=`` (or ``tile=``, which the chunk trigger also matches) —
+    plus anything site-specific (``path=`` for the torn kinds)."""
+    state = _STATE
+    if state is None:
+        return
+    index = ctx.get("chunk", ctx.get("tile"))
+    action = None
+    with state.lock:
+        # every matching spec's call counter advances for every call at
+        # its site, INDEPENDENT of whether some other spec fires on
+        # this call — a firing must not shift later specs' "Nth call"
+        # triggers (two call=N specs at one site fire at exactly N)
+        for spec in state.specs:
+            if spec.site == site:
+                spec.calls += 1
+        for k, spec in enumerate(state.specs):
+            if spec.site != site or spec.fires >= spec.max_fires:
+                continue
+            if spec.chunk is not None:
+                hit = index is not None and int(index) == spec.chunk
+            elif spec.call is not None:
+                hit = spec.calls == spec.call
+            else:
+                hit = state.rngs[k].random() < spec.p
+            if not hit:
+                continue
+            spec.fires += 1
+            action = spec
+            state._record({
+                "site": site, "kind": spec.kind, "spec": spec.spec_str(),
+                "chunk": None if index is None else int(index),
+                "call": spec.calls,
+            })
+            break
+    if action is None:
+        return
+    _emit(site, action, index)
+    if action.kind == "stall":
+        time.sleep(action.stall_s)
+        return
+    if action.kind == "torn":
+        path = ctx.get("path")
+        if path:
+            _tear(str(path))
+        raise InjectedFault(site, "torn", transient=True,
+                            detail=f"truncated {ctx.get('path')}")
+    if action.kind == "enospc":
+        raise OSError(errno.ENOSPC, "No space left on device (injected)")
+    if action.kind == "device_lost":
+        raise InjectedFault(
+            site, "device_lost", transient=True,
+            detail="DEVICE_LOST: simulated device failure",
+        )
+    if action.kind == "fatal":
+        raise InjectedFault(site, "fatal", transient=False)
+    raise InjectedFault(site, "raise", transient=True)
+
+
+def _emit(site: str, spec: FaultSpec, index) -> None:
+    """Telemetry for one firing — deferred import so the disarmed path
+    never touches obs."""
+    from ..obs import counter, event, names
+
+    counter(names.FAULTS_INJECTED, site=site, kind=spec.kind).inc()
+    event(
+        names.EVENT_FAULT_FIRED,
+        site=site, kind=spec.kind, spec=spec.spec_str(),
+        chunk=None if index is None else int(index),
+    )

@@ -1,0 +1,167 @@
+"""Shared transient-vs-fatal classification and backoff retry.
+
+Before PR 11 the repo had exactly one retry ladder — bench.py's
+hand-rolled probe-and-hold loop (``time.sleep(20 * wedges)`` on exit
+codes 3/4) — and every production path was fail-fast. This module is
+the ONE policy both now share:
+
+* :func:`is_transient` — the error classifier. Transient means "the
+  same operation, retried as-is, can plausibly succeed": a wedged
+  readback (``DrainTimeout``), a dropped tunnel/device
+  (jaxlib's DEVICE_LOST/UNAVAILABLE message shapes, connection
+  errors), interrupted/timed-out syscalls, and a full scratch disk
+  (``ENOSPC`` — space is routinely reclaimed by cleanup/rotation, and
+  the bounded attempt budget keeps a genuinely full disk from looping
+  forever). Everything else — shape errors, fingerprint mismatches,
+  OOM (bench handles that by *changing* the chunk size, not
+  retrying it) — is fatal and re-raises through every retry layer
+  unchanged.
+* :class:`RetryPolicy` + :func:`backoff_delay` — exponential backoff
+  with seeded jitter. ``TUNNEL_POLICY`` reproduces bench.py's proven
+  20 s/40 s ladder (base 20, multiplier 2); the in-process supervisors
+  (sweep chunk retry, prefetch staging, server engine) use the faster
+  ``DEFAULT_POLICY``.
+* :func:`retry_call` — the helper the serving path and prefetch use:
+  call, classify, back off, re-call, bounded by the policy. Every
+  retry emits a ``faults.retry`` event so a retrying run is
+  distinguishable from a wedged one in ``watch``.
+
+The sweep's chunk-level supervision lives in utils/sweep.py (it retries
+by *resuming from the checkpoint sidecar*, which is stronger than
+re-calling a function — the existing crash-resume tests are its
+contract) but classifies and backs off through exactly these helpers.
+
+stdlib-only; the pipeline/obs imports are deferred into the functions
+that need them so this module can't cycle with the executors that
+import it.
+"""
+from __future__ import annotations
+
+import errno
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .inject import InjectedFault
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: attempt ``k`` (1-based) sleeps
+    ``min(max_delay_s, base_delay_s * multiplier**(k-1))``, jittered by
+    ``+/- jitter`` (fraction). ``max_attempts`` counts total tries
+    including the first."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.5
+    multiplier: float = 2.0
+    max_delay_s: float = 30.0
+    jitter: float = 0.5
+
+
+#: in-process supervisors (sweep chunk retry, prefetch staging, server
+#: engine): fail fast enough that a fatal misdiagnosis costs seconds
+DEFAULT_POLICY = RetryPolicy()
+
+#: bench.py's probe-and-hold ladder, unchanged in shape: the tunnel
+#: flaps on a minutes cadence, so the first retry waits 20 s and the
+#: second 40 s (base 20 x multiplier 2), +/-25% jitter to avoid
+#: re-probing in lockstep with a flapping keepalive
+TUNNEL_POLICY = RetryPolicy(
+    max_attempts=3, base_delay_s=20.0, multiplier=2.0,
+    max_delay_s=120.0, jitter=0.25,
+)
+
+#: bench child exit codes that are the flapping tunnel's transient
+#: signature (3 = backend init wedged/failed fast, 4 = silent fallback
+#: to the wrong backend) — the subprocess-level twin of
+#: :func:`is_transient`, shared so bench.py and any future child-runner
+#: classify identically
+TRANSIENT_EXIT_CODES = frozenset({3, 4})
+
+#: syscall errnos a retry can plausibly outlive (see module doc for the
+#: ENOSPC rationale)
+_TRANSIENT_ERRNOS = frozenset({
+    errno.EINTR, errno.EAGAIN, errno.ETIMEDOUT, errno.ECONNRESET,
+    errno.ECONNREFUSED, errno.EPIPE, errno.ENOSPC,
+})
+
+#: message shapes of the tunnel/device failure modes jaxlib surfaces as
+#: bare RuntimeErrors (no typed hierarchy to catch) — lowercase substrings
+_TRANSIENT_PATTERNS = (
+    "device_lost", "data_loss", "unavailable", "aborted",
+    "failed to connect", "connection reset", "socket closed",
+    "deadline exceeded",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when retrying the same operation can plausibly succeed."""
+    if isinstance(exc, InjectedFault):
+        return exc.transient
+    # DrainTimeout imported lazily: pipeline.py imports this package's
+    # injection sites, so a module-level import here would cycle
+    from ..parallel.pipeline import DrainTimeout
+
+    if isinstance(exc, DrainTimeout):
+        return True
+    if isinstance(exc, ConnectionError):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in _TRANSIENT_ERRNOS
+    if isinstance(exc, (RuntimeError, SystemError)):
+        msg = str(exc).lower()
+        return any(p in msg for p in _TRANSIENT_PATTERNS)
+    return False
+
+
+def backoff_delay(attempt: int, policy: RetryPolicy = DEFAULT_POLICY,
+                  seed: Optional[int] = None) -> float:
+    """Delay before retry ``attempt`` (1-based). ``seed`` makes the
+    jitter deterministic (chaos benches pin wall overhead); None draws
+    from the process RNG."""
+    base = min(
+        policy.max_delay_s,
+        policy.base_delay_s * policy.multiplier ** (attempt - 1),
+    )
+    if policy.jitter <= 0:
+        return base
+    rng = (
+        random.Random(seed * 1_000_003 + attempt)
+        if seed is not None else random
+    )
+    return base * (1.0 + policy.jitter * (2.0 * rng.random() - 1.0))
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    policy: RetryPolicy = DEFAULT_POLICY,
+    classify: Callable[[BaseException], bool] = is_transient,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    seed: Optional[int] = None,
+    scope: str = "retry_call",
+):
+    """Call ``fn()`` under the policy: a fatal error re-raises
+    immediately and unchanged; a transient one backs off and retries
+    until the attempt budget is spent (then the LAST error re-raises).
+    Each retry emits a ``faults.retry`` event (``scope`` labels whose
+    retry it was) and calls ``on_retry(attempt, exc)`` — the hook
+    supervisors use to bump their own counters."""
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except BaseException as exc:  # noqa: BLE001 — classified, then re-raised
+            if attempt >= policy.max_attempts or not classify(exc):
+                raise
+            from ..obs import event, names
+
+            event(names.EVENT_FAULT_RETRY, scope=scope, attempt=attempt,
+                  error=repr(exc)[:200])
+            if on_retry is not None:
+                on_retry(attempt, exc)
+            sleep(backoff_delay(attempt, policy, seed=seed))
+            attempt += 1

@@ -35,7 +35,13 @@ from .infer import (
     grid_loglikelihood,
     map_fit,
 )
-from .serve import LikelihoodServer, RealizationBank, project_bank
+from .serve import (
+    DeadlineExpired,
+    LikelihoodServer,
+    RealizationBank,
+    ServerSaturated,
+    project_bank,
+)
 
 __all__ = [
     "loglikelihood", "dense_loglikelihood", "ReducedGP",
@@ -43,4 +49,5 @@ __all__ = [
     "grid_loglikelihood", "grid_cartesian", "bank_loglikelihood",
     "map_fit", "MapResult",
     "LikelihoodServer", "RealizationBank", "project_bank",
+    "ServerSaturated", "DeadlineExpired",
 ]

@@ -19,10 +19,20 @@ fixed batch shape (one compile, ever), runs ONE vmapped evaluation
 over (batch, realizations), and resolves each request's future with
 its own (R,) log-likelihood row.
 
+Serving hardening (PR 11, docs/robustness.md): a bounded request queue
+with reject-on-saturation admission control (``max_queue`` —
+:class:`ServerSaturated` instead of unbounded queue growth under
+overload), per-request deadlines (``request_deadline_s`` /
+``submit(deadline_s=)`` — an expired future raises
+:class:`DeadlineExpired`, it is never served late and never stranded),
+and a single in-place retry of transiently-failed engine calls through
+the shared faults/retry policy.
+
 SLO telemetry rides the obs stack: ``likelihood.requests`` /
 ``likelihood.batches`` / ``likelihood.batch_size`` /
 ``likelihood.evals`` / ``likelihood.coalesce_efficiency`` /
-``likelihood.queue_depth`` metrics, a ``likelihood_batch`` span per
+``likelihood.queue_depth`` / ``likelihood.rejected`` /
+``likelihood.deadline_expired`` metrics, a ``likelihood_batch`` span per
 coalesced evaluation (so a capture's series layer yields batch-latency
 percentiles for free), and request-latency p50/p95/p99 tracked by the
 streaming P^2 estimators of obs/series.py — :meth:`LikelihoodServer.
@@ -43,6 +53,8 @@ import jax
 import jax.numpy as jnp
 
 from ..batch import PulsarBatch
+from ..faults import inject as faults
+from ..faults.retry import RetryPolicy, is_transient, retry_call
 from ..models.batched import Recipe
 from ..obs import counter, gauge, names, span
 from ..obs.series import SpanQuantiles
@@ -50,6 +62,27 @@ from . import gp
 from .infer import _check_axes, _reduced_grid_engine_bank, _reducible
 
 _STOP = object()
+
+#: one in-place retry of a transiently-failed engine evaluation (a
+#: flapped device call fails one coalesced batch = up to max_batch
+#: client futures at once; the retry costs milliseconds) — fatal errors
+#: still fail every future in the batch immediately
+_ENGINE_RETRY = RetryPolicy(max_attempts=2, base_delay_s=0.05,
+                            max_delay_s=1.0)
+
+
+class ServerSaturated(RuntimeError):
+    """Admission control refused the request: the bounded queue is at
+    ``max_queue``. Shed load upstream (back off and resubmit) — an
+    unbounded queue under sustained overload turns every latency SLO
+    into heap growth and multi-second tails."""
+
+
+class DeadlineExpired(TimeoutError):
+    """The request's deadline passed while it was still queued; its
+    future raises this instead of being served late (the client
+    already gave up — evaluating it would burn device time on an
+    answer nobody reads)."""
 
 
 class RealizationBank:
@@ -191,6 +224,7 @@ class _Request:
     theta: np.ndarray
     future: Future
     t_submit: float  # monotonic
+    deadline: Optional[float] = None  # monotonic; None = no deadline
 
 
 class LikelihoodServer:
@@ -221,6 +255,8 @@ class LikelihoodServer:
         max_batch: int = 8,
         max_delay_s: float = 0.005,
         prefetch_depth: int = 2,
+        max_queue: Optional[int] = None,
+        request_deadline_s: Optional[float] = None,
     ):
         self.axes = tuple(sorted(axes))
         _check_axes(self.axes)
@@ -233,10 +269,21 @@ class LikelihoodServer:
             )
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1 (got {max_batch})")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1 (got {max_queue})")
         self.batch = batch
         self.recipe = recipe
         self.max_batch = int(max_batch)
         self.max_delay_s = float(max_delay_s)
+        #: admission control: queued-but-unserved requests are capped at
+        #: this; submit() past it raises ServerSaturated instead of
+        #: growing the queue (None = unbounded, the pre-PR-11 behavior)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        #: default per-request deadline measured from submit (a request
+        #: may override per call); None = no deadline
+        self.request_deadline_s = (
+            None if request_deadline_s is None else float(request_deadline_s)
+        )
         self.nreal = bank.nreal
         dtype = batch.toas_s.dtype
         self._reduced = gp.ReducedGP.build(
@@ -257,6 +304,9 @@ class LikelihoodServer:
         self._batches = 0
         self._started_at: Optional[float] = None
         self._busy_s = 0.0
+        self._pending = 0   # admitted, not yet picked up by the worker
+        self._rejected = 0
+        self._deadline_expired = 0
 
     # ------------------------------------------------------- lifecycle
 
@@ -318,9 +368,17 @@ class LikelihoodServer:
 
     # --------------------------------------------------------- clients
 
-    def submit(self, **params) -> Future:
+    def submit(self, deadline_s: Optional[float] = None,
+               **params) -> Future:
         """Queue one hyperparameter point; returns a Future resolving
-        to the (R,) per-realization total log L."""
+        to the (R,) per-realization total log L.
+
+        ``deadline_s`` (default: the server's ``request_deadline_s``)
+        bounds how long the request may wait in the queue: a request
+        still unserved when it expires has its future raise
+        :class:`DeadlineExpired` instead of being evaluated late.
+        Raises :class:`ServerSaturated` — without enqueueing — when
+        the bounded queue (``max_queue``) is full."""
         if set(params) != set(self.axes):
             raise ValueError(
                 f"request must supply exactly {self.axes}, got "
@@ -328,14 +386,33 @@ class LikelihoodServer:
             )
         theta = np.asarray([float(params[k]) for k in self.axes])
         fut: Future = Future()
+        now = time.monotonic()
+        if deadline_s is None:
+            deadline_s = self.request_deadline_s
+        deadline = None if deadline_s is None else now + float(deadline_s)
         # the enqueue is atomic with the closing check: stop() flips
         # _closing under this lock BEFORE posting the worker's _STOP,
         # so any request admitted here is already in the queue ahead of
-        # the sentinel (FIFO) and the drain is guaranteed to serve it
+        # the sentinel (FIFO) and the drain is guaranteed to serve it.
+        # Admission control shares the same critical section, so the
+        # pending count can never over-admit under concurrent submits
+        # (the worker only ever SHRINKS it concurrently — a race there
+        # rejects one request early, never admits one past the bound).
         with self._lock:
             if self._worker is None or self._closing:
                 raise RuntimeError("server not started (or stopping)")
-            self._queue.put(_Request(theta, fut, time.monotonic()))
+            if (
+                self.max_queue is not None
+                and self._pending >= self.max_queue
+            ):
+                self._rejected += 1
+                counter(names.LIKELIHOOD_REJECTED).inc()
+                raise ServerSaturated(
+                    f"request queue at max_queue={self.max_queue} — "
+                    "load shed; back off and resubmit"
+                )
+            self._pending += 1
+            self._queue.put(_Request(theta, fut, now, deadline=deadline))
         counter(names.LIKELIHOOD_REQUESTS).inc()
         gauge(names.LIKELIHOOD_QUEUE_DEPTH).set(self._queue.qsize())
         return fut
@@ -390,7 +467,43 @@ class LikelihoodServer:
             for lo in range(0, len(tail), self.max_batch):
                 self._serve_batch(tail[lo:lo + self.max_batch])
 
+    def _expire(self, reqs) -> list:
+        """Split off requests whose deadline passed while queued: their
+        futures raise DeadlineExpired (never strand, never burn device
+        time on an answer the client stopped waiting for); returns the
+        still-live requests. A request that makes the cut is evaluated
+        even if it expires mid-batch — the deadline bounds QUEUE time,
+        the engine latency is bounded by the batch itself."""
+        now = time.monotonic()
+        live = []
+        expired = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                expired.append(r)
+            else:
+                live.append(r)
+        if expired:
+            with self._lock:
+                self._deadline_expired += len(expired)
+            counter(names.LIKELIHOOD_DEADLINE_EXPIRED).inc(len(expired))
+            for r in expired:
+                if r.future.set_running_or_notify_cancel():
+                    r.future.set_exception(DeadlineExpired(
+                        f"request expired after {now - r.t_submit:.3f}s "
+                        "in the queue (deadline "
+                        f"{r.deadline - r.t_submit:.3f}s)"
+                    ))
+        return live
+
     def _serve_batch(self, reqs) -> None:
+        with self._lock:
+            # every dequeued request leaves the admission window here,
+            # served or expired (submit's bound counts queued-only)
+            self._pending -= len(reqs)
+        reqs = self._expire(reqs)
+        if not reqs:
+            gauge(names.LIKELIHOOD_QUEUE_DEPTH).set(self._queue.qsize())
+            return
         nb = len(reqs)
         theta = np.stack([r.theta for r in reqs])
         if nb < self.max_batch:
@@ -402,16 +515,25 @@ class LikelihoodServer:
                                   axis=0)]
             )
         t0 = time.monotonic()
+
+        def _eval():
+            faults.fire(faults.SITE_SERVER_ENGINE, requests=nb)
+            return np.asarray(
+                self._engine(
+                    jnp.asarray(theta, self.batch.toas_s.dtype),
+                    self._reduced, self._proj, self.batch,
+                    self.recipe,
+                )
+            )
+
         try:
             with span(names.SPAN_LIKELIHOOD_BATCH, requests=nb,
                       capacity=self.max_batch):
-                out = np.asarray(
-                    self._engine(
-                        jnp.asarray(theta, self.batch.toas_s.dtype),
-                        self._reduced, self._proj, self.batch,
-                        self.recipe,
-                    )
-                )
+                # one in-place retry of a transient engine failure: a
+                # flapped device call must not fail max_batch client
+                # futures at once (fatal errors still do, immediately)
+                out = retry_call(_eval, policy=_ENGINE_RETRY,
+                                 classify=is_transient, scope="serve")
         except BaseException as exc:  # noqa: BLE001 — delivered per-future
             for r in reqs:
                 if not r.future.set_running_or_notify_cancel():
@@ -449,6 +571,8 @@ class LikelihoodServer:
             self._requests = 0
             self._batches = 0
             self._busy_s = 0.0
+            self._rejected = 0
+            self._deadline_expired = 0
             self._started_at = time.monotonic()
 
     def stats(self) -> dict:
@@ -459,6 +583,8 @@ class LikelihoodServer:
             requests = self._requests
             batches = self._batches
             busy_s = self._busy_s
+            rejected = self._rejected
+            deadline_expired = self._deadline_expired
             latency = self._latency.summary()
             fill = self._batch_fill.summary()
         elapsed = (
@@ -482,6 +608,12 @@ class LikelihoodServer:
             "evals_per_s": evals / elapsed if elapsed > 0 else 0.0,
             "requests_per_s": requests / elapsed if elapsed > 0 else 0.0,
             "device_busy_s": round(busy_s, 6),
+            # admission-control / deadline SLO counters (PR 11): load
+            # shed instead of queue growth, expiries instead of strands
+            "rejected": rejected,
+            "deadline_expired": deadline_expired,
+            "max_queue": self.max_queue,
+            "request_deadline_s": self.request_deadline_s,
             "latency": {
                 k: v for k, v in latency.items()
                 if v is not None and np.isfinite(v)

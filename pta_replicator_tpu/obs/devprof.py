@@ -377,8 +377,8 @@ def capture_pending(force: bool = False) -> Dict[str, dict]:
             finally:
                 _CAPTURING.active = False
             out[label] = record_compiled(label, compiled)
-        except Exception:
-            continue  # a dead/shape-mismatched label is not evidence
+        except Exception:  # graftlint: disable=robust-swallowed-exception — best-effort cost probe: a dead/shape-mismatched label is not evidence, and failing the capture over it would cost the round
+            continue
     return out
 
 

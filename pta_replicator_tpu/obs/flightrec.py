@@ -636,13 +636,13 @@ def _flush_from_signal(rec: FlightRecorder, reason: str,
     def flush():
         try:
             rec.write_postmortem(reason, emergency=True)
-        except Exception:
+        except Exception:  # graftlint: disable=robust-swallowed-exception — dying-process flush: raising here would lose the signal re-delivery below, the postmortem is already best-effort
             pass
         finally:
             done.set()
         try:
             rec.stop(finished=False)
-        except Exception:
+        except Exception:  # graftlint: disable=robust-swallowed-exception — same dying-process path: stop() failure must not block signal re-delivery
             pass
 
     threading.Thread(target=flush, name="flightrec-flush",
@@ -655,7 +655,7 @@ def _signal_handler(signum, frame):
     if rec is not None:
         try:
             _flush_from_signal(rec, signal.Signals(signum).name)
-        except Exception:
+        except Exception:  # graftlint: disable=robust-swallowed-exception — signal handler: an exception here would mask the signal itself; re-delivery below is the observable outcome
             pass
     prev = _prev_handlers.get(signum, signal.SIG_DFL)
     if callable(prev):
@@ -678,7 +678,7 @@ def _excepthook(exc_type, exc, tb):
             exc = exc if isinstance(exc, BaseException) else exc_type(exc)
             exc.__traceback__ = tb
             rec.write_postmortem("exception", exc=exc)
-        except Exception:
+        except Exception:  # graftlint: disable=robust-swallowed-exception — excepthook: the ORIGINAL exception is re-reported to the chained hook on the next line; a postmortem-write failure must not replace it
             pass
     (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
 

@@ -107,8 +107,19 @@ EVENT_FLIGHTREC_STALL = "flightrec.stall"
 #: a managed jax.profiler trace finished and registered its directory
 #: as a capture artifact (obs/devprof.py)
 EVENT_DEVICE_TRACE = "devprof.device_trace"
+#: a scheduled fault fired at an injection site (faults/inject.py) —
+#: the ring-buffer breadcrumb that makes a chaos run's faults visible
+#: in `watch`/postmortem
+EVENT_FAULT_FIRED = "faults.fired"
+#: a supervised-recovery retry happened (faults/retry.py retry_call,
+#: or the sweep's chunk-retry loop) — a retrying run emits these where
+#: a wedged one goes silent
+EVENT_FAULT_RETRY = "faults.retry"
 
-EVENTS = frozenset({EVENT_FLIGHTREC_STALL, EVENT_DEVICE_TRACE})
+EVENTS = frozenset({
+    EVENT_FLIGHTREC_STALL, EVENT_DEVICE_TRACE,
+    EVENT_FAULT_FIRED, EVENT_FAULT_RETRY,
+})
 
 # ------------------------------------------------------------- metrics
 # io / ingest counters
@@ -132,6 +143,10 @@ SWEEP_LAST_DISPATCHED_CHUNK = "sweep.last_dispatched_chunk"
 #: the overlapped D2H drains, 0 between chunks
 SWEEP_SHARDS_INFLIGHT = "sweep.shards_inflight"
 PIPELINE_DRAIN_TIMEOUTS = "pipeline.drain_timeouts"
+#: transient chunk failures absorbed by the sweep's supervised-recovery
+#: loop (utils/sweep.py): each bump is one resume-from-sidecar retry of
+#: a failed chunk, bounded by the sweep's chunk_retries budget
+SWEEP_CHUNK_RETRIES = "sweep.chunk_retries"
 
 # streamed CW-catalog plane pipeline: tiles consumed by the device
 # accumulator, bytes staged host->device by the prefetcher, and the
@@ -139,6 +154,9 @@ PIPELINE_DRAIN_TIMEOUTS = "pipeline.drain_timeouts"
 CW_STREAM_TILES_DONE = "cw_stream.tiles_done"
 CW_STREAM_BYTES_STAGED = "cw_stream.bytes_staged"
 CW_STREAM_PREFETCH_STALL_S = "cw_stream.prefetch_stall_s"
+#: transient staging failures retried once in place by the prefetch
+#: workers (parallel/prefetch.py) before escalating to the caller
+CW_STREAM_STAGE_RETRIES = "cw_stream.stage_retries"
 
 # likelihood serving path (likelihood/serve.py): requests accepted,
 # coalesced device batches run, the last batch's fill (requests per
@@ -151,6 +169,15 @@ LIKELIHOOD_BATCH_SIZE = "likelihood.batch_size"
 LIKELIHOOD_EVALS = "likelihood.evals"
 LIKELIHOOD_COALESCE_EFFICIENCY = "likelihood.coalesce_efficiency"
 LIKELIHOOD_QUEUE_DEPTH = "likelihood.queue_depth"
+#: server SLO counters (PR 11 hardening): requests refused by the
+#: bounded-queue admission control, and futures failed with
+#: DeadlineExpired instead of being served past their deadline
+LIKELIHOOD_REJECTED = "likelihood.rejected"
+LIKELIHOOD_DEADLINE_EXPIRED = "likelihood.deadline_expired"
+
+#: fault-injection layer (faults/inject.py): scheduled faults fired,
+#: labeled site=/kind= — zero in any run that didn't arm a schedule
+FAULTS_INJECTED = "faults.injected"
 
 # flight recorder
 FLIGHTREC_STALLS = "flightrec.stalls"
@@ -184,13 +211,15 @@ METRICS = frozenset({
     MESH_DEVICES,
     SWEEP_CHUNKS_TOTAL, SWEEP_CHUNKS_DONE, SWEEP_REALIZATIONS,
     SWEEP_INFLIGHT_CHUNKS, SWEEP_LAST_DISPATCHED_CHUNK,
-    SWEEP_SHARDS_INFLIGHT,
+    SWEEP_SHARDS_INFLIGHT, SWEEP_CHUNK_RETRIES,
     PIPELINE_DRAIN_TIMEOUTS,
     CW_STREAM_TILES_DONE, CW_STREAM_BYTES_STAGED,
-    CW_STREAM_PREFETCH_STALL_S,
+    CW_STREAM_PREFETCH_STALL_S, CW_STREAM_STAGE_RETRIES,
     LIKELIHOOD_REQUESTS, LIKELIHOOD_BATCHES, LIKELIHOOD_BATCH_SIZE,
     LIKELIHOOD_EVALS, LIKELIHOOD_COALESCE_EFFICIENCY,
-    LIKELIHOOD_QUEUE_DEPTH,
+    LIKELIHOOD_QUEUE_DEPTH, LIKELIHOOD_REJECTED,
+    LIKELIHOOD_DEADLINE_EXPIRED,
+    FAULTS_INJECTED,
     FLIGHTREC_STALLS,
     OBS_OVERHEAD_S, PROC_RSS_BYTES,
     OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
@@ -222,6 +251,7 @@ FLIGHTREC_PREFIX = "flightrec."
 PIPELINE_PREFIX = "pipeline."
 CW_STREAM_PREFIX = "cw_stream."
 LIKELIHOOD_PREFIX = "likelihood."
+FAULTS_PREFIX = "faults."
 OCCUPANCY_PREFIX = "occupancy."
 OBS_PREFIX = "obs."
 PROC_PREFIX = "proc."

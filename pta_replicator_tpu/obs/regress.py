@@ -78,6 +78,10 @@ _HIGHER_BETTER_TOKENS = (
     # leaves (serve.latency.p50/p95/p99) ride the lower-better
     # percentile tokens below; batch_overhead_ratio rides "overhead".
     "evals_per_s", "coalesce_efficiency",
+    # CHAOS series (benchmarks/chaos_sweep.py): runs that completed
+    # through injected faults — fewer recovered runs means the
+    # supervised-recovery machinery regressed (ISSUE 11)
+    "recovered_runs",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves
@@ -85,7 +89,17 @@ _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # (obs.overhead_s) are lower-better: a fatter tail or a costlier
 # sampler is a regression even when the mean moved nowhere
 _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
-                        "p50", "p95", "p99", "overhead")
+                        "p50", "p95", "p99", "overhead",
+                        # CHAOS / robustness series (ISSUE 11): retries
+                        # absorbed, requests shed, futures expired, and
+                        # the faulted-vs-fault-free wall ratio are all
+                        # costs — a rising trend is a robustness
+                        # regression even when every run still recovers
+                        # ("fault_overhead" also rides "overhead";
+                        # spelled out for the explicit-contract reason
+                        # above)
+                        "chunk_retries", "stage_retries", "rejected",
+                        "deadline_expired", "fault_overhead")
 #: name fragments with NO better direction: jax.cost.* gauges are
 #: properties of the compiled program (flops per chunk changing is a
 #: workload change, not a perf verdict — even though "flops" is a

@@ -13,6 +13,10 @@ access to the box:
 * ``/progress`` — the current heartbeat JSON (also ``/progress.json``)
 * ``/series``   — the recent series windows + span percentiles (also
   ``/series.json``)
+* ``/healthz``  — health/readiness verdict computed from the artifacts
+  (200 while the heartbeat is fresh; 503 on no heartbeat, a stale one,
+  or a postmortem — what a load balancer or the chaos bench polls to
+  decide the run is alive, docs/robustness.md)
 * ``/``         — a JSON index of the above
 
 Read-only by construction: GET/HEAD only, no path component of the URL
@@ -32,6 +36,7 @@ import http.server
 import json
 import os
 import threading
+import time
 from typing import Tuple
 
 #: route -> (filename inside the capture dir, content type). The URL
@@ -62,20 +67,53 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if self.command != "HEAD":
             self.wfile.write(body)
 
+    def _healthz(self) -> None:
+        """Health/readiness verdict from the capture artifacts: 200
+        while the heartbeat is fresh, 503 otherwise — truthful for a
+        run that never started a flight recorder (no heartbeat = not
+        ready) and for one that died (postmortem = not healthy)."""
+        directory = self.server.directory
+        doc = {"ok": False}
+        if os.path.exists(os.path.join(directory, "postmortem.json")):
+            doc["state"] = "postmortem"
+        else:
+            try:
+                mtime = os.path.getmtime(
+                    os.path.join(directory, "progress.json")
+                )
+            except OSError:
+                doc["state"] = "no-heartbeat"
+            else:
+                # heartbeat mtimes are wall clock; nothing monotonic
+                # can be compared against them
+                age = time.time() - mtime  # graftlint: disable=thread-walltime-duration — file mtime is wall-clock by definition
+                doc["heartbeat_age_s"] = round(age, 3)
+                if age <= self.server.stale_after_s:
+                    doc.update(ok=True, state="live")
+                else:
+                    doc["state"] = "stale"
+        self._respond(
+            200 if doc["ok"] else 503,
+            json.dumps(doc).encode(), "application/json",
+        )
+
     def do_GET(self) -> None:  # noqa: N802 — stdlib handler contract
         path = self.path.split("?", 1)[0]
         if path in ("/", "/index.json"):
             body = json.dumps({
                 "directory": self.server.directory,
-                "endpoints": sorted(set(ROUTES)),
+                "endpoints": sorted(set(ROUTES) | {"/healthz"}),
             }, indent=1).encode()
             self._respond(200, body, "application/json")
+            return
+        if path in ("/healthz", "/readyz"):
+            self._healthz()
             return
         route = ROUTES.get(path)
         if route is None:
             self._respond(404, json.dumps({
                 "error": f"unknown endpoint {path!r}",
-                "endpoints": sorted(set(ROUTES)),
+                "endpoints": sorted(set(ROUTES) | {"/healthz"}),
             }).encode(), "application/json")
             return
         fname, ctype = route
@@ -103,8 +141,13 @@ class TelemetryServer(http.server.ThreadingHTTPServer):
 
     daemon_threads = True
 
-    def __init__(self, directory: str, address: Tuple[str, int]):
+    def __init__(self, directory: str, address: Tuple[str, int],
+                 stale_after_s: float = 150.0):
         self.directory = os.path.abspath(directory)
+        #: /healthz freshness bound: the flight recorder's sampler
+        #: self-stretches its interval up to 30 s under load, so the
+        #: default leaves a generous 5x margin before declaring stale
+        self.stale_after_s = float(stale_after_s)
         super().__init__(address, _Handler)
 
 

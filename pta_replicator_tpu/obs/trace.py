@@ -181,7 +181,7 @@ class Tracer:
         for fn in listeners:
             try:
                 fn(rec)
-            except Exception:
+            except Exception:  # graftlint: disable=robust-swallowed-exception — a listener (heartbeat sampler) must never throw through span recording; its own failure telemetry is its job
                 pass
 
     @contextlib.contextmanager

@@ -55,6 +55,7 @@ from typing import Callable, Iterable, Optional
 
 import numpy as np
 
+from ..faults import inject as faults
 from ..obs import counter, gauge, names, occupancy, span
 from ..obs.trace import TRACER
 
@@ -210,6 +211,7 @@ def run_pipelined(
                 try:
                     fetch_started[0] = time.monotonic()
                     with span(names.SPAN_DRAIN, chunk=i):
+                        faults.fire(names.SPAN_DRAIN, chunk=i)
                         block = fetch(dev)
                     _busy(names.SPAN_DRAIN,
                           time.monotonic() - fetch_started[0])
@@ -248,6 +250,7 @@ def run_pipelined(
                     write_started[0] = time.monotonic()
                     with span(names.SPAN_IO_WRITE, chunk=i,
                               nbytes=int(block.nbytes)):
+                        faults.fire(names.SPAN_IO_WRITE, chunk=i)
                         write(i, block)
                     _busy(names.SPAN_IO_WRITE,
                           time.monotonic() - write_started[0])
@@ -281,6 +284,7 @@ def run_pipelined(
             try:
                 t_disp = time.monotonic()
                 with span(names.SPAN_DISPATCH, chunk=i):
+                    faults.fire(names.SPAN_DISPATCH, chunk=i)
                     dev = dispatch(i)
                 _busy(names.SPAN_DISPATCH, time.monotonic() - t_disp)
             except BaseException as exc:  # noqa: BLE001

@@ -52,7 +52,9 @@ from typing import Callable, Iterable, Iterator, Optional
 
 import numpy as np
 
-from ..obs import counter, gauge, names, span, tree_nbytes
+from ..faults import inject as faults
+from ..faults.retry import is_transient
+from ..obs import counter, event, gauge, names, span, tree_nbytes
 from ..obs.trace import TRACER
 from ..utils.sweep import durable_replace, npy_bytes
 from .pipeline import DrainTimeout, _stage_overdue, _stop_aware_put
@@ -64,6 +66,27 @@ def _default_place(tile):
     import jax
 
     return jax.device_put(tile)
+
+
+def _stage_with_retry(stage_once, *, tile: int, device=None):
+    """Run one staging operation, retrying a *transient* failure once
+    in place before escalating (docs/robustness.md): a flapped H2D
+    copy costs one extra device_put; tearing down the whole stream and
+    resuming the sweep costs minutes. The single bounded retry keeps
+    the worker's in-order yield contract trivially intact — a second
+    failure (or any fatal one) re-raises unchanged on the consumer's
+    thread exactly as before. ``cw_stream.stage_retries`` counts the
+    absorbed retries; a ``faults.retry`` event marks each in the
+    flight recorder's ring."""
+    try:
+        return stage_once()
+    except BaseException as exc:  # noqa: BLE001 — classified, then re-raised
+        if not is_transient(exc):
+            raise
+        counter(names.CW_STREAM_STAGE_RETRIES).inc()
+        event(names.EVENT_FAULT_RETRY, scope="prefetch", tile=tile,
+              device=device, attempt=1, error=repr(exc)[:200])
+        return stage_once()
 
 
 def prefetch_to_device(
@@ -122,7 +145,13 @@ def prefetch_to_device(
                             stage_started[0] = None
                             break
                         nbytes = tree_nbytes(tile)
-                        staged = place(tile)
+
+                        def _stage_once(tile=tile, i=i):
+                            faults.fire(faults.SITE_PREFETCH_STAGE,
+                                        tile=i)
+                            return place(tile)
+
+                        staged = _stage_with_retry(_stage_once, tile=i)
                         sp["nbytes"] = nbytes
                     busy_s[0] += time.monotonic() - stage_started[0]
                     stage_started[0] = None
@@ -317,15 +346,31 @@ def prefetch_to_mesh(
                     beat[0] = time.monotonic()
                     with span(names.SPAN_CW_STREAM_STAGE, tile=k,
                               device=label) as sp:
-                        pieces = []
-                        nbytes = 0
-                        for leaf, sharding in zip(leaves, shardings):
-                            idx = sharding.addressable_devices_indices_map(
-                                leaf.shape
-                            )[d]
-                            piece = jax.device_put(leaf[idx], d)
-                            nbytes += int(piece.nbytes)
-                            pieces.append((leaf.shape, piece))
+
+                        def _stage_once(leaves=leaves, k=k):
+                            faults.fire(faults.SITE_PREFETCH_STAGE,
+                                        tile=k, device=label)
+                            pieces = []
+                            nbytes = 0
+                            for leaf, sharding in zip(leaves, shardings):
+                                idx = (
+                                    sharding
+                                    .addressable_devices_indices_map(
+                                        leaf.shape
+                                    )[d]
+                                )
+                                piece = jax.device_put(leaf[idx], d)
+                                nbytes += int(piece.nbytes)
+                                pieces.append((leaf.shape, piece))
+                            return pieces, nbytes
+
+                        # transient per-device staging failures retry
+                        # once in place (device_put is idempotent);
+                        # peers stay untouched and the in-order yield
+                        # contract holds
+                        pieces, nbytes = _stage_with_retry(
+                            _stage_once, tile=k, device=label
+                        )
                         sp["nbytes"] = nbytes
                     busy[d][0] += time.monotonic() - beat[0]
                     beat[0] = None
@@ -460,7 +505,7 @@ def save_plane_tiles(
     except BaseException:
         try:
             zf.close()
-        except Exception:
+        except Exception:  # graftlint: disable=robust-swallowed-exception — best-effort close on the error path; the ORIGINAL exception re-raises below
             pass
         import os
 

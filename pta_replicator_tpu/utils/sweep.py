@@ -45,6 +45,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ..faults import inject as faults
+
 
 def _default_reduce(res, batch):
     import jax.numpy as jnp
@@ -184,6 +186,7 @@ def _cleanup_chunks(checkpoint_path: str, nchunks: int) -> None:
 
 
 def _fsync_path(path: str) -> None:
+    faults.fire(faults.SITE_CHECKPOINT_FSYNC, path=path)
     fd = os.open(path, os.O_RDONLY)
     try:
         os.fsync(fd)
@@ -217,6 +220,12 @@ def _atomic_write(write_fn, final_path: str, suffix: str,
     os.close(fd)
     try:
         write_fn(tmp)
+        # torn-write injection point: fires AFTER the temp file is
+        # written and BEFORE the rename — a "torn" fault truncates the
+        # temp and raises, leaving exactly the artifact an interrupted
+        # write leaves (the final path is never touched, so the
+        # checkpoint stays consistent and a retry overwrites cleanly)
+        faults.fire(faults.SITE_CHECKPOINT_WRITE, path=tmp)
         _durable_replace(tmp, final_path, durable)
     finally:
         if os.path.exists(tmp):
@@ -506,6 +515,17 @@ def iter_checkpoint_chunks(checkpoint_path: str):
         i += 1
 
 
+def _read_done_marker(meta_path: str) -> int:
+    """Completed-chunk count from the sidecar, 0 when absent/corrupt —
+    the supervision loop's progress probe (a torn sidecar means the
+    chunk never completed, which resume already treats as 0)."""
+    try:
+        with open(meta_path) as fh:
+            return int(json.load(fh).get("done", 0))
+    except (OSError, ValueError):
+        return 0
+
+
 def sweep(
     key,
     batch,
@@ -521,6 +541,8 @@ def sweep(
     drain_timeout_s: Optional[float] = 900.0,
     durable: bool = False,
     shard_checkpoint: Optional[bool] = None,
+    chunk_retries: int = 2,
+    retry_policy=None,
 ) -> np.ndarray:
     """Run ``nreal`` realizations in resumable chunks.
 
@@ -560,8 +582,28 @@ def sweep(
     (the writer assembles shards first). The whole mesh sweep runs
     under a ``multichip_sweep`` phase span — the occupancy window for
     multi-chip bottleneck attribution (obs.occupancy).
+
+    **Supervised recovery** (``chunk_retries``, docs/robustness.md): a
+    chunk failure classified *transient* by the shared classifier
+    (faults.retry.is_transient — a wedged readback's ``DrainTimeout``,
+    a dropped device/tunnel, an interrupted or out-of-space write) is
+    absorbed by resuming from the checkpoint sidecar after an
+    exponential backoff, instead of killing a multi-hour run. The
+    budget is per *failing chunk*: any completed chunk since the last
+    failure resets it, so N isolated transients across a long sweep
+    each get the full budget, while one persistently failing chunk
+    exhausts it and re-raises. Recovery IS the crash-resume path the
+    tests pin byte-identical, so checkpoint ordering, file contents,
+    and the returned array are unchanged by any number of absorbed
+    retries (``sweep.chunk_retries`` counter + ``faults.retry`` events
+    make them visible in ``watch``). ``chunk_retries=0`` restores the
+    old fail-fast behavior; fatal errors (shape/fingerprint/OOM/user
+    aborts) always re-raise immediately, on the first occurrence.
     """
     import contextlib
+    import time as _time
+
+    from ..faults.retry import DEFAULT_POLICY, backoff_delay, is_transient
 
     phase = contextlib.nullcontext()
     if mesh is not None and int(mesh.devices.size) > 1:
@@ -572,14 +614,39 @@ def sweep(
             mesh=f"{mesh.shape.get('real', 1)}x{mesh.shape.get('psr', 1)}",
             devices=int(mesh.devices.size),
         )
+    policy = retry_policy if retry_policy is not None else DEFAULT_POLICY
+    meta_path = checkpoint_path + ".meta.json"
+    attempts = 0       # consecutive failures of the CURRENT chunk
+    last_done = -1
     with phase:
-        return _sweep_impl(
-            key, batch, recipe, nreal, checkpoint_path, chunk=chunk,
-            reduce_fn=reduce_fn, fit=fit, mesh=mesh, progress=progress,
-            pipeline_depth=pipeline_depth,
-            drain_timeout_s=drain_timeout_s, durable=durable,
-            shard_checkpoint=shard_checkpoint,
-        )
+        while True:
+            try:
+                return _sweep_impl(
+                    key, batch, recipe, nreal, checkpoint_path,
+                    chunk=chunk, reduce_fn=reduce_fn, fit=fit, mesh=mesh,
+                    progress=progress, pipeline_depth=pipeline_depth,
+                    drain_timeout_s=drain_timeout_s, durable=durable,
+                    shard_checkpoint=shard_checkpoint,
+                )
+            except BaseException as exc:  # noqa: BLE001 — classified, then re-raised
+                if chunk_retries <= 0 or not is_transient(exc):
+                    raise
+                done = _read_done_marker(meta_path)
+                if done > last_done:
+                    attempts = 0  # progress since the last failure:
+                    last_done = done  # a NEW chunk gets a fresh budget
+                attempts += 1
+                if attempts > chunk_retries:
+                    raise
+                from ..obs import counter, event, names
+
+                counter(names.SWEEP_CHUNK_RETRIES).inc()
+                event(
+                    names.EVENT_FAULT_RETRY, scope="sweep",
+                    attempt=attempts, done=done,
+                    error=repr(exc)[:200],
+                )
+                _time.sleep(backoff_delay(attempts, policy))
 
 
 def _sweep_impl(
@@ -735,10 +802,14 @@ def _sweep_impl(
         # behavior every pipelined run must reproduce byte-for-byte
         for i in range(done, nchunks):
             with span(names.SPAN_SWEEP_CHUNK, chunk=i, nreal=chunk):
+                # same injection sites the pipelined executor fires, so
+                # a chaos schedule means the same thing at every depth
+                faults.fire(faults.SITE_DISPATCH, chunk=i)
                 out = dispatch_chunk(i)
                 # the host readback is the device-sync fence: this span
                 # is where queued device work (incl. collectives) drains
                 with span(names.SPAN_READBACK_FENCE):
+                    faults.fire(faults.SITE_DRAIN, chunk=i)
                     block = fetch_fn(out)
             host = (block.assemble() if isinstance(block, ShardedBlock)
                     else block)
@@ -748,6 +819,7 @@ def _sweep_impl(
             # compute-bound)
             with span(names.SPAN_IO_WRITE, chunk=i,
                       nbytes=int(block.nbytes)):
+                faults.fire(faults.SITE_IO_WRITE, chunk=i)
                 write_chunk(i, block if shard_checkpoint else host)
             blocks.append(host)
     elif done < nchunks:

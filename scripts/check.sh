@@ -23,10 +23,18 @@ fi
 echo "== telemetry schema =="
 python scripts/check_telemetry_schema.py
 
-echo "== obs/analysis test subset (fixture-free) =="
+echo "== obs/analysis/faults test subset (fixture-free) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_obs.py tests/test_flightrec.py tests/test_occupancy.py \
     tests/test_series.py tests/test_timeline_serve.py \
-    tests/test_analysis.py tests/test_pipeline.py
+    tests/test_analysis.py tests/test_pipeline.py tests/test_faults.py
+
+echo "== chaos smoke (seeded faults, byte-identity gate) =="
+# the fast arm of benchmarks/chaos_sweep.py: one seeded schedule
+# (transient failure + DrainTimeout stall + torn checkpoint write)
+# through the supervised-recovery path, checkpoint pinned byte-identical
+# to fault-free, server saturation shedding verified (exit 1 on any
+# gate miss). Seconds-scale, fixture-free, CPU-only.
+JAX_PLATFORMS=cpu python benchmarks/chaos_sweep.py --fast > /dev/null
 
 echo "check.sh: all gates green"

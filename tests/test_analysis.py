@@ -801,3 +801,140 @@ def test_unbounded_buffer_clean_on_real_obs_tree():
     assert active == [], [f.format() for f in active]
     # the escape hatch is in use (occupancy/devprof), with reasons
     assert any(f.rule == "obs-unbounded-buffer" for f in suppressed)
+
+
+# -------------------------------------------------------- robust rules
+
+def test_swallowed_exception_fires_on_silent_broad_handlers(tmp_path):
+    """robust-swallowed-exception: bare/broad handlers with pass /
+    continue / silent-fallback bodies in a threaded package module all
+    fire, each anchored to its own line."""
+    from pta_replicator_tpu.analysis import rules_robust
+
+    src = """
+        import threading
+
+        def worker(q):
+            while True:
+                try:
+                    q.get()
+                except Exception:
+                    pass
+                try:
+                    q.task_done()
+                except:
+                    continue
+                try:
+                    q.put(1)
+                except BaseException:
+                    state = None
+
+        threading.Thread(target=worker).start()
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"pta_replicator_tpu/parallel/bad.py": src},
+        rules_robust.RULES,
+    )
+    assert rule_ids(findings) == ["robust-swallowed-exception"] * 3
+
+
+def test_swallowed_exception_respects_handling_evidence(tmp_path):
+    """Non-firing shapes: re-raise, exception-object recording
+    (errors.append / set_exception / repr in a message), logging /
+    counter bumps, explicit fallback returns, narrow handlers — and
+    the whole rule stands down in unthreaded modules."""
+    from pta_replicator_tpu.analysis import rules_robust
+
+    good = """
+        import threading
+
+        errors = []
+
+        def worker(fut, q):
+            try:
+                q.get()
+            except Exception as exc:
+                errors.append(exc)
+            try:
+                q.get()
+            except Exception as exc:
+                fut.set_exception(exc)
+            try:
+                q.get()
+            except Exception:
+                raise RuntimeError("wrapped")
+            try:
+                q.get()
+            except Exception:
+                print("readback failed")
+            try:
+                q.get()
+            except Exception:
+                counter("pipeline.drain_timeouts").inc()
+            try:
+                q.get()
+            except Exception:
+                return {}
+            try:
+                q.get()
+            except OSError:
+                pass  # narrow: out of scope by design
+
+        threading.Thread(target=worker).start()
+    """
+    unthreaded = """
+        def read(path):
+            try:
+                return open(path).read()
+            except Exception:
+                pass
+    """
+    findings, _ = lint_tree(
+        tmp_path,
+        {
+            "pta_replicator_tpu/parallel/good.py": good,
+            "pta_replicator_tpu/utils/unthreaded.py": unthreaded,
+        },
+        rules_robust.RULES,
+    )
+    assert findings == []
+
+
+def test_swallowed_exception_suppression_and_scope(tmp_path):
+    """Inline suppression with a reason is honored (and counted as
+    suppressed); files outside the package are out of scope."""
+    from pta_replicator_tpu.analysis import rules_robust
+
+    src = """
+        import threading
+
+        def flush(rec):
+            try:
+                rec.write()
+            except Exception:  # graftlint: disable=robust-swallowed-exception — dying-process flush
+                pass
+
+        threading.Thread(target=flush).start()
+    """
+    findings, suppressed = lint_tree(
+        tmp_path, {"pta_replicator_tpu/obs/flush.py": src},
+        rules_robust.RULES,
+    )
+    assert findings == []
+    assert rule_ids(suppressed) == ["robust-swallowed-exception"]
+
+    outside, _ = lint_tree(
+        tmp_path, {"benchmarks/tool.py": """
+        import threading
+
+        def go(q):
+            try:
+                q.get()
+            except Exception:
+                pass
+
+        threading.Thread(target=go).start()
+    """},
+        rules_robust.RULES,
+    )
+    assert outside == []

@@ -1,0 +1,426 @@
+"""Fault injection + supervised recovery (pta_replicator_tpu/faults/,
+docs/robustness.md): schedule grammar, trigger determinism, the shared
+transient-vs-fatal classifier and backoff policy, the sweep's
+chunk-retry supervision (byte-identity through injected transient
+failures, stalls, and torn checkpoint writes — the chaos gate's fast
+subset), and the prefetch staging retry.
+
+Fixture-free and CPU-only: part of scripts/check.sh's pre-push gate.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.faults import inject, retry
+from pta_replicator_tpu.faults.inject import InjectedFault
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.obs import counter, names
+from pta_replicator_tpu.parallel.pipeline import DrainTimeout
+from pta_replicator_tpu.parallel.prefetch import prefetch_to_device
+from pta_replicator_tpu.utils.sweep import sweep
+
+#: fast in-process recovery for tests (production default backs off
+#: 0.5 s+ per retry — pure wasted wall under injected faults)
+FAST = retry.RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                         multiplier=2.0, max_delay_s=0.1, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends disarmed — a leaked schedule would
+    chaos unrelated tests."""
+    inject.disarm()
+    yield
+    inject.disarm()
+
+
+@pytest.fixture()
+def small_sweep():
+    b = synthetic_batch(npsr=3, ntoa=64, seed=2)
+    recipe = Recipe(
+        efac=jnp.ones(3),
+        rn_log10_amplitude=jnp.full(3, -14.0),
+        rn_gamma=jnp.full(3, 4.0),
+    )
+    return b, recipe, jax.random.PRNGKey(5)
+
+
+# ------------------------------------------------------- schedule grammar
+
+def test_parse_schedule_roundtrip():
+    text = ("drain:raise@chunk=2;checkpoint_write:torn@call=3;"
+            "dispatch:stall=2.5@chunk=1x2;cw_stream_stage:device_lost@p=0.1")
+    specs = inject.parse_schedule(text)
+    assert [s.spec_str() for s in specs] == [
+        "drain:raise@chunk=2", "checkpoint_write:torn@call=3",
+        "dispatch:stall=2.5@chunk=1x2", "cw_stream_stage:device_lost@p=0.1",
+    ]
+    assert specs[2].stall_s == 2.5 and specs[2].max_fires == 2
+
+
+@pytest.mark.parametrize("bad", [
+    "nosite:raise@chunk=1",        # unknown site
+    "drain:explode@chunk=1",       # unknown kind
+    "drain:raise@tick=1",          # unknown trigger
+    "drain:raise",                 # no trigger
+    "drain:torn@call=1",           # torn outside the checkpoint sites
+    "drain:raise@p=1.5",           # p out of range
+    "drain:raise@call=0",          # call is 1-based
+    "drain:raise=3@chunk=1",       # parameter on a parameterless kind
+])
+def test_parse_schedule_refuses_malformed(bad):
+    with pytest.raises(ValueError, match="bad fault spec"):
+        inject.parse_schedule(bad)
+
+
+# ------------------------------------------------------------- triggers
+
+def test_fire_disarmed_is_noop_and_cheap():
+    assert not inject.is_armed()
+    inject.fire("drain", chunk=3)  # must not raise, log, or import obs
+
+
+def test_chunk_trigger_fires_once():
+    with inject.armed("drain:raise@chunk=2"):
+        inject.fire("drain", chunk=0)
+        inject.fire("drain", chunk=1)
+        with pytest.raises(InjectedFault, match="drain.*raise"):
+            inject.fire("drain", chunk=2)
+        inject.fire("drain", chunk=2)  # max_fires=1: exhausted
+        assert len(inject.fired()) == 1
+
+
+def test_call_trigger_counts_per_site():
+    with inject.armed("io_write:raise@call=3"):
+        inject.fire("io_write", chunk=0)
+        inject.fire("drain", chunk=0)  # other site: not counted
+        inject.fire("io_write", chunk=1)
+        with pytest.raises(InjectedFault):
+            inject.fire("io_write", chunk=2)
+
+
+def test_two_call_triggers_at_one_site_both_fire_on_time():
+    """A firing must not shift later same-site specs' call counters:
+    call=2 and call=3 at one site fire at exactly calls 2 and 3."""
+    hits = []
+    with inject.armed("drain:raise@call=2;drain:raise@call=3"):
+        for k in range(5):
+            try:
+                inject.fire("drain", chunk=k)
+            except InjectedFault:
+                hits.append(k)
+    assert hits == [1, 2]  # 2nd and 3rd calls (0-indexed loop)
+
+
+def test_probabilistic_trigger_is_seeded_deterministic():
+    def run(seed):
+        hits = []
+        with inject.armed("drain:raise@p=0.3x100", seed=seed):
+            for k in range(50):
+                try:
+                    inject.fire("drain", chunk=k)
+                except InjectedFault:
+                    hits.append(k)
+        return hits
+
+    a, b = run(7), run(7)
+    assert a == b and len(a) > 0
+    assert run(8) != a  # a different seed is a different schedule
+
+
+def test_tile_index_matches_chunk_trigger():
+    with inject.armed("cw_stream_stage:raise@chunk=1"):
+        inject.fire("cw_stream_stage", tile=0)
+        with pytest.raises(InjectedFault):
+            inject.fire("cw_stream_stage", tile=1)
+
+
+def test_arm_from_env(monkeypatch):
+    monkeypatch.setenv("PTA_FAULTS", "drain:raise@chunk=0")
+    monkeypatch.setenv("PTA_FAULTS_SEED", "3")
+    assert inject.arm_from_env()
+    with pytest.raises(InjectedFault):
+        inject.fire("drain", chunk=0)
+    monkeypatch.delenv("PTA_FAULTS")
+    inject.disarm()
+    assert not inject.arm_from_env()
+
+
+# ---------------------------------------------------------- fault kinds
+
+def test_kind_fatal_is_not_transient():
+    with inject.armed("drain:fatal@chunk=0"):
+        with pytest.raises(InjectedFault) as ei:
+            inject.fire("drain", chunk=0)
+    assert ei.value.transient is False
+    assert not retry.is_transient(ei.value)
+
+
+def test_kind_enospc_raises_oserror():
+    import errno
+
+    with inject.armed("checkpoint_write:enospc@call=1"):
+        with pytest.raises(OSError) as ei:
+            inject.fire("checkpoint_write", path="/tmp/x")
+    assert ei.value.errno == errno.ENOSPC
+    assert retry.is_transient(ei.value)
+
+
+def test_kind_stall_sleeps_without_raising():
+    with inject.armed("drain:stall=0.05@chunk=0"):
+        t0 = time.monotonic()
+        inject.fire("drain", chunk=0)
+        assert time.monotonic() - t0 >= 0.05
+
+
+def test_kind_torn_truncates_the_inflight_file(tmp_path):
+    p = tmp_path / "victim.bin"
+    p.write_bytes(b"x" * 1000)
+    with inject.armed("checkpoint_write:torn@call=1"):
+        with pytest.raises(InjectedFault, match="torn"):
+            inject.fire("checkpoint_write", path=str(p))
+    assert p.stat().st_size == 500  # genuinely torn, not just raised
+
+
+# ------------------------------------------------- classifier + backoff
+
+@pytest.mark.parametrize("exc,transient", [
+    (InjectedFault("drain", "raise"), True),
+    (InjectedFault("drain", "fatal", transient=False), False),
+    (DrainTimeout("host readback exceeded 900s"), True),
+    (ConnectionResetError(), True),
+    (OSError(28, "No space left on device"), True),       # ENOSPC
+    (OSError(2, "No such file or directory"), False),     # ENOENT
+    (RuntimeError("DEVICE_LOST: device is gone"), True),
+    (RuntimeError("UNAVAILABLE: socket closed"), True),
+    (RuntimeError("something unrelated"), False),
+    (ValueError("checkpoint belongs to a different sweep"), False),
+    (KeyboardInterrupt(), False),
+])
+def test_is_transient_classification(exc, transient):
+    assert retry.is_transient(exc) is transient
+
+
+def test_backoff_ladder_shape_and_determinism():
+    # bench.py's proven tunnel ladder: 20 s then 40 s, +/-25% jitter
+    d1 = retry.backoff_delay(1, retry.TUNNEL_POLICY, seed=0)
+    d2 = retry.backoff_delay(2, retry.TUNNEL_POLICY, seed=0)
+    assert 15.0 <= d1 <= 25.0 and 30.0 <= d2 <= 50.0
+    assert d1 == retry.backoff_delay(1, retry.TUNNEL_POLICY, seed=0)
+    nojit = retry.RetryPolicy(base_delay_s=1.0, multiplier=3.0,
+                              max_delay_s=5.0, jitter=0.0)
+    assert [retry.backoff_delay(k, nojit) for k in (1, 2, 3, 4)] == [
+        1.0, 3.0, 5.0, 5.0  # capped at max_delay_s
+    ]
+    assert retry.TRANSIENT_EXIT_CODES == frozenset({3, 4})
+
+
+def test_retry_call_recovers_transient_and_respects_budget():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise InjectedFault("drain", "raise")
+        return "ok"
+
+    slept = []
+    assert retry.retry_call(flaky, policy=FAST,
+                            sleep=slept.append) == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+
+    def always():
+        raise InjectedFault("drain", "raise")
+
+    with pytest.raises(InjectedFault):
+        retry.retry_call(always, policy=FAST, sleep=lambda s: None)
+
+
+def test_retry_call_fatal_raises_immediately():
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry.retry_call(fatal, policy=FAST, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+# ----------------------------------------- sweep supervised recovery
+
+def _chaos_sweep(tmp_path, small_sweep, schedule, name, **kw):
+    b, recipe, key = small_sweep
+    ck = str(tmp_path / name)
+    with inject.armed(schedule):
+        out = sweep(key, b, recipe, nreal=16, chunk=4,
+                    checkpoint_path=ck, retry_policy=FAST, **kw)
+    return out, ck
+
+
+def _reference(tmp_path, small_sweep):
+    b, recipe, key = small_sweep
+    ck = str(tmp_path / "ref.npz")
+    return sweep(key, b, recipe, nreal=16, chunk=4,
+                 checkpoint_path=ck), ck
+
+
+def test_sweep_recovers_transient_chunk_failure_byte_identical(
+    tmp_path, small_sweep
+):
+    """The chaos gate's core: an injected transient drain failure is
+    absorbed by resume-from-sidecar, the result and the consolidated
+    checkpoint are byte-identical to the fault-free run, and the retry
+    is visible in telemetry."""
+    ref, ref_ck = _reference(tmp_path, small_sweep)
+    r0 = counter(names.SWEEP_CHUNK_RETRIES).value
+    out, ck = _chaos_sweep(tmp_path, small_sweep,
+                           "drain:raise@chunk=2", "chaos.npz")
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+    assert counter(names.SWEEP_CHUNK_RETRIES).value == r0 + 1
+
+
+def test_sweep_recovers_torn_checkpoint_write(tmp_path, small_sweep):
+    """A checkpoint temp file torn mid-write (truncated + raised) is
+    retried; the final consolidated checkpoint is byte-identical."""
+    ref, ref_ck = _reference(tmp_path, small_sweep)
+    out, ck = _chaos_sweep(tmp_path, small_sweep,
+                           "checkpoint_write:torn@call=3", "torn.npz")
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+def test_sweep_recovers_injected_stall_via_drain_timeout(
+    tmp_path, small_sweep
+):
+    """A stall longer than the drain deadline trips DrainTimeout, which
+    classifies transient and resumes — the wedged-tunnel story, end to
+    end, in-process."""
+    ref, ref_ck = _reference(tmp_path, small_sweep)
+    out, ck = _chaos_sweep(
+        tmp_path, small_sweep, "drain:stall=2@chunk=1", "stall.npz",
+        drain_timeout_s=0.4,
+    )
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+def test_sweep_device_lost_and_sync_loop_site(tmp_path, small_sweep):
+    """device_lost is transient; the depth-1 synchronous loop carries
+    the same injection sites as the executor."""
+    ref, _ = _reference(tmp_path, small_sweep)
+    out, _ck = _chaos_sweep(
+        tmp_path, small_sweep, "dispatch:device_lost@chunk=1",
+        "sync.npz", pipeline_depth=1,
+    )
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_sweep_fatal_fault_not_retried(tmp_path, small_sweep):
+    b, recipe, key = small_sweep
+    with inject.armed("drain:fatal@chunk=1"):
+        with pytest.raises(InjectedFault):
+            sweep(key, b, recipe, nreal=16, chunk=4,
+                  checkpoint_path=str(tmp_path / "fatal.npz"),
+                  retry_policy=FAST)
+        assert len(inject.fired()) == 1  # one firing, zero retries
+
+
+def test_sweep_chunk_retries_zero_is_fail_fast(tmp_path, small_sweep):
+    b, recipe, key = small_sweep
+    with inject.armed("drain:raise@chunk=1"):
+        with pytest.raises(InjectedFault):
+            sweep(key, b, recipe, nreal=16, chunk=4,
+                  checkpoint_path=str(tmp_path / "ff.npz"),
+                  chunk_retries=0, retry_policy=FAST)
+
+
+def test_sweep_budget_is_per_failing_chunk(tmp_path, small_sweep):
+    """Two transient failures on DIFFERENT chunks each get a fresh
+    budget; a chunk that keeps failing past the budget re-raises."""
+    ref, _ = _reference(tmp_path, small_sweep)
+    out, _ck = _chaos_sweep(
+        tmp_path, small_sweep,
+        "drain:raise@chunk=1;drain:raise@chunk=3", "two.npz",
+        chunk_retries=1,
+    )
+    np.testing.assert_array_equal(out, ref)
+
+    b, recipe, key = small_sweep
+    with inject.armed("drain:raise@chunk=1x5"):
+        with pytest.raises(InjectedFault):
+            sweep(key, b, recipe, nreal=16, chunk=4,
+                  checkpoint_path=str(tmp_path / "exhaust.npz"),
+                  chunk_retries=2, retry_policy=FAST)
+        # first try + 2 retries, then the budget is spent
+        assert len(inject.fired()) == 3
+
+
+# ------------------------------------------------- prefetch staging retry
+
+def test_prefetch_retries_transient_staging_once():
+    tiles = [np.full((4, 4), k, dtype=np.float64) for k in range(6)]
+    r0 = counter(names.CW_STREAM_STAGE_RETRIES).value
+    with inject.armed("cw_stream_stage:raise@chunk=2"):
+        got = list(prefetch_to_device(iter(tiles), depth=2))
+    assert len(got) == 6
+    for k, g in enumerate(got):
+        np.testing.assert_array_equal(np.asarray(g), tiles[k])
+    assert counter(names.CW_STREAM_STAGE_RETRIES).value == r0 + 1
+
+
+def test_prefetch_second_transient_failure_escalates():
+    """p=1 with two firings beats the single in-place retry: the error
+    re-raises on the consumer, in order, after earlier tiles."""
+    tiles = [np.full((2, 2), k, dtype=np.float64) for k in range(4)]
+    got = []
+    with inject.armed("cw_stream_stage:raise@p=1x2"):
+        with pytest.raises(InjectedFault):
+            for g in prefetch_to_device(iter(tiles), depth=1):
+                got.append(g)
+    assert len(got) == 0  # the first staging failed twice
+
+
+def test_prefetch_fatal_staging_not_retried():
+    tiles = [np.zeros((2, 2)) for _ in range(3)]
+    r0 = counter(names.CW_STREAM_STAGE_RETRIES).value
+    with inject.armed("cw_stream_stage:fatal@chunk=1"):
+        with pytest.raises(InjectedFault):
+            list(prefetch_to_device(iter(tiles), depth=2))
+    assert counter(names.CW_STREAM_STAGE_RETRIES).value == r0
+
+
+# ------------------------------------------------- bench-diff contract
+
+def test_chaos_bench_diff_directions():
+    """The CHAOS series' leaves classify the way the gate promises —
+    retries/rejects/expiries/fault-overhead are costs (lower-better),
+    recovered runs a score (higher-better) — and the committed round
+    JSON diffs cleanly against itself."""
+    from pta_replicator_tpu.obs.regress import bench_diff, metric_direction
+
+    assert metric_direction("chaos.0.chunk_retries") is False
+    assert metric_direction("server.rejected") is False
+    assert metric_direction("server.deadline_expired") is False
+    assert metric_direction("fault_overhead") is False
+    assert metric_direction("fault_overhead_s") is False
+    assert metric_direction("cw_stream.stage_retries") is False
+    assert metric_direction("recovered_runs") is True
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "CHAOS_r11_cpu.json")
+    assert os.path.exists(path), (
+        "CHAOS_r11_cpu.json must be committed with the chaos evidence"
+    )
+    _table, summary, rc = bench_diff([path, path])
+    assert rc == 0 and summary["regressed"] == 0
+    assert summary["comparable"] > 10

@@ -5,6 +5,7 @@ bench-diff direction contract for the LIKELIHOOD series."""
 import dataclasses
 import json
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -403,3 +404,104 @@ def test_likelihood_bench_diff_directions():
     _table, summary, rc = bench_diff([path, path])
     assert rc == 0 and summary["regressed"] == 0
     assert summary["comparable"] > 10
+
+# ------------------------------- PR 11: admission control + deadlines
+
+def _blocked_engine_server(setup, **kw):
+    """A started server whose engine is swapped for a gate: the first
+    batch enters and blocks until released, so the queue backs up
+    deterministically (no timing races)."""
+    import threading as _threading
+
+    batch, recipe, bank_arr = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank_arr), batch, recipe,
+        axes=("rn_log10_amplitude",), max_batch=1, max_delay_s=0.001,
+        **kw,
+    )
+    entered = _threading.Event()
+    release = _threading.Event()
+    nreal = bank_arr.shape[0]
+
+    def gated_engine(theta, *a, **k):
+        entered.set()
+        release.wait(30.0)
+        return np.zeros((theta.shape[0], nreal))
+
+    server._engine = gated_engine
+    return server, entered, release
+
+
+def test_server_rejects_on_saturation(setup):
+    """max_queue admission control: submissions past the bound raise
+    ServerSaturated WITHOUT enqueueing; the SLO counters advance; the
+    admitted requests are all served after release."""
+    from pta_replicator_tpu.obs import counter, names
+
+    server, entered, release = _blocked_engine_server(setup, max_queue=2)
+    r0 = counter(names.LIKELIHOOD_REJECTED).value
+    with server:
+        first = server.submit(rn_log10_amplitude=-13.5)
+        assert entered.wait(10.0)  # worker holds it inside the engine
+        queued = [server.submit(rn_log10_amplitude=-13.5 - 0.1 * k)
+                  for k in range(2)]
+        with pytest.raises(lk.ServerSaturated, match="max_queue=2"):
+            server.submit(rn_log10_amplitude=-14.9)
+        stats = server.stats()
+        assert stats["rejected"] == 1
+        assert counter(names.LIKELIHOOD_REJECTED).value == r0 + 1
+        release.set()
+    for f in [first] + queued:
+        assert f.done() and f.exception() is None
+    # the rejected request was never admitted: requests == served
+    assert server.stats()["requests"] == 3
+
+
+def test_server_deadline_expiry_under_saturation(setup):
+    """Requests stuck in a saturated queue past their deadline have
+    their futures RAISE DeadlineExpired (never strand, never evaluate
+    late); counters advance; stop() leaves no pending future."""
+    from pta_replicator_tpu.obs import counter, names
+
+    server, entered, release = _blocked_engine_server(
+        setup, request_deadline_s=0.05
+    )
+    d0 = counter(names.LIKELIHOOD_DEADLINE_EXPIRED).value
+    with server:
+        first = server.submit(rn_log10_amplitude=-13.5)
+        assert entered.wait(10.0)
+        stale = [server.submit(rn_log10_amplitude=-13.6 - 0.1 * k)
+                 for k in range(3)]
+        # a per-submit override beats the server default
+        fresh = server.submit(deadline_s=60.0, rn_log10_amplitude=-14.0)
+        time.sleep(0.15)  # all default-deadline requests expire queued
+        release.set()
+    assert first.done() and first.exception() is None
+    assert fresh.done() and fresh.exception() is None
+    for f in stale:
+        assert f.done()
+        with pytest.raises(lk.DeadlineExpired, match="expired after"):
+            f.result(timeout=0)
+    stats = server.stats()
+    assert stats["deadline_expired"] == 3
+    assert counter(names.LIKELIHOOD_DEADLINE_EXPIRED).value == d0 + 3
+    # expired requests never reached the engine: of 5 submitted, only
+    # the blocked first + the fresh override were SERVED
+    assert stats["requests"] == 2
+
+
+def test_server_stop_expires_rather_than_strands(setup):
+    """The stop() drain applies deadlines too: an expired queued
+    request raises instead of being served late or stranded."""
+    server, entered, release = _blocked_engine_server(
+        setup, request_deadline_s=0.05
+    )
+    server.start()
+    first = server.submit(rn_log10_amplitude=-13.5)
+    assert entered.wait(10.0)
+    stale = server.submit(rn_log10_amplitude=-13.7)
+    time.sleep(0.15)
+    release.set()
+    server.stop()
+    assert first.done() and stale.done()
+    assert isinstance(stale.exception(), lk.DeadlineExpired)

@@ -453,3 +453,59 @@ def test_regress_directions_for_multichip_series():
     # host properties, not scores: no direction
     assert metric_direction("arms.8.attainable_speedup") is None
     assert metric_direction("arms.8.compute_util_cores") is None
+
+
+def test_prefetch_to_mesh_mid_stage_exception_peers_drain():
+    """A fault injected INSIDE one device's staging call (mid-stage,
+    after peers may already have staged their pieces of the same tile)
+    re-raises on the consumer after every earlier tile was yielded in
+    order — no peer stager hangs, no yielded tile is stranded, and all
+    workers join promptly (ISSUE 11 satellite: the multi-device
+    abandon path under a mid-stage exception)."""
+    import time as _time
+
+    from pta_replicator_tpu.faults import inject
+    from pta_replicator_tpu.faults.inject import InjectedFault
+
+    mesh = make_mesh(4, 2)
+    tiles = _tiles(8)
+    got = []
+    t0 = _time.monotonic()
+    # fatal => the staging retry must NOT absorb it; call=13 lands the
+    # fault mid-tile on one stager after 12 healthy per-device stagings
+    # (8 devices x tile 0 + part of tile 1's fan-out)
+    with inject.armed("cw_stream_stage:fatal@call=13"):
+        it = prefetch_to_mesh(
+            iter(tiles), mesh, specs=(P(), P(None, "psr", None)), depth=2
+        )
+        with pytest.raises(InjectedFault):
+            for t in it:
+                got.append(t)
+    assert _time.monotonic() - t0 < 30.0  # drained, not wedged
+    # every tile yielded before the fault is complete and in order (how
+    # many made it out is scheduling-dependent: the faulted device may
+    # race ahead of a peer still on tile 0 — the contract is the
+    # PREFIX, the clean join, and the unchanged re-raise)
+    assert len(got) < len(tiles)
+    for (src, psr), (g_src, g_psr) in zip(tiles, got):
+        np.testing.assert_array_equal(np.asarray(g_src), src)
+        np.testing.assert_array_equal(np.asarray(g_psr), psr)
+
+
+def test_prefetch_to_mesh_transient_stage_fault_retried():
+    """A transient per-device staging failure is absorbed by the
+    in-place retry: the stream completes, bit-identical, with the
+    retry visible in telemetry."""
+    from pta_replicator_tpu.faults import inject
+    from pta_replicator_tpu.obs import counter, names as obs_names
+
+    mesh = make_mesh(2, 1)
+    tiles = _tiles(5)
+    r0 = counter(obs_names.CW_STREAM_STAGE_RETRIES).value
+    with inject.armed("cw_stream_stage:device_lost@call=4"):
+        got = list(prefetch_to_mesh(iter(tiles), mesh,
+                                    specs=(P(), P()), depth=2))
+    assert len(got) == 5
+    for (src, _), (g_src, _) in zip(tiles, got):
+        np.testing.assert_array_equal(np.asarray(g_src), src)
+    assert counter(obs_names.CW_STREAM_STAGE_RETRIES).value == r0 + 1

@@ -234,7 +234,9 @@ def test_serve_routes_and_read_only(tmp_path):
         base = serve_url(srv, "")
         status, body = _get(base + "/")
         assert status == 200
-        assert set(json.loads(body)["endpoints"]) == set(ROUTES)
+        assert set(json.loads(body)["endpoints"]) == (
+            set(ROUTES) | {"/healthz"}
+        )
         status, body = _get(base + "/progress")
         assert status == 200 and json.loads(body)["schema"] == 3
         status, body = _get(base + "/metrics")
@@ -359,3 +361,48 @@ def test_watch_serve_cli_flag(tmp_path):
     with pytest.raises(SystemExit) as exc:
         main(["watch", d, "--once", "--serve", "0"])
     assert exc.value.code == 3  # no heartbeat yet — watch contract
+
+
+def test_serve_healthz_readiness_ladder(tmp_path):
+    """/healthz walks the readiness ladder truthfully: 503 no-heartbeat
+    before a flight recorder writes, 200 live while the heartbeat is
+    fresh, 503 stale once it ages past the bound, 503 postmortem once
+    the run died (PR 11: what a load balancer or chaos harness polls)."""
+    d = str(tmp_path / "cap")
+    os.makedirs(d)
+    srv = serve_directory(d, 0, background=True)
+    srv.stale_after_s = 1.0
+    try:
+        url = serve_url(srv, "/healthz")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=5.0)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["state"] == "no-heartbeat"
+
+        with open(os.path.join(d, "progress.json"), "w") as fh:
+            json.dump({"schema": 3}, fh)
+        status, body = _get(url)
+        doc = json.loads(body)
+        assert status == 200 and doc["ok"] and doc["state"] == "live"
+        assert doc["heartbeat_age_s"] >= 0
+
+        old = time.time() - 30.0
+        os.utime(os.path.join(d, "progress.json"), (old, old))
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=5.0)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["state"] == "stale"
+
+        with open(os.path.join(d, "postmortem.json"), "w") as fh:
+            json.dump({"reason": "SIGTERM"}, fh)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(url, timeout=5.0)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["state"] == "postmortem"
+
+        # /readyz is an alias, and the index advertises the route
+        _status, body = _get(serve_url(srv, "/"))
+        assert "/healthz" in json.loads(body)["endpoints"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
