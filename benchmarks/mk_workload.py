@@ -2,6 +2,15 @@
 CPU backend, so benchmarks/fast_capture.py spends a flaky-tunnel window
 on the measurement instead of on an extra compile.
 
+Since round 12 this is a thin shim over the scenario compiler: the
+flagship workload is the committed spec
+``pta_replicator_tpu/scenarios/specs/flagship.json`` (the
+``bench_flagship`` preset), compiled by ``scenarios.compile`` — the ONE
+implementation of the workload's legacy RNG call order and content
+fingerprint, so the ``/tmp/workload.npz`` fingerprint contract is
+unchanged (tests pin the shim's fingerprint against
+``bench.build_workload``'s).
+
 The static plane (CW-catalog delays; deterministic_delays) is
 key-independent data: its f64 host plane precompute happens on the host
 either way, so the CPU-computed f32 plane is numerically equivalent input
@@ -20,7 +29,8 @@ CW_SCALING_r05_cpu.json records the segfault).
 
 Env knobs: MK_NCW (catalog size, default 100 — the bench workload),
 MK_PLANE_CHUNK (tile width, default 65536), MK_PLANE_TILES (tile-cache
-path; '0' skips, default /tmp/cw_plane_tiles.npz).
+path; '0' skips, default /tmp/cw_plane_tiles.npz), MK_SPEC (an
+alternative scenario spec file to compile instead of the flagship).
 """
 import os
 import sys
@@ -34,20 +44,46 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-from bench import build_workload  # noqa: E402
 from pta_replicator_tpu.models.batched import (  # noqa: E402
     cw_catalog_plane_tiles_for,
     deterministic_delays,
 )
 from pta_replicator_tpu.parallel.prefetch import save_plane_tiles  # noqa: E402
+from pta_replicator_tpu.scenarios import compile_spec, load_spec  # noqa: E402
 
-ncw = int(os.environ.get("MK_NCW", "100"))
+spec_path = os.environ.get("MK_SPEC") or os.path.join(
+    os.path.dirname(__file__), "..", "pta_replicator_tpu", "scenarios",
+    "specs", "flagship.json",
+)
+spec = load_spec(spec_path)
+if spec.preset == "bench_flagship":
+    # MK_NCW scales the flagship catalog exactly as it always did (the
+    # fingerprint covers the override, so a differently-sized cache can
+    # never masquerade as the bench workload) — but only when actually
+    # SET, so an MK_SPEC carrying its own ncw is not silently clobbered
+    # by the default; BENCH_BACKEND / BENCH_SYNTH_PRECISION keep
+    # flowing into the recipe exactly as they did through
+    # bench.build_workload (recipe knobs, not fingerprint inputs)
+    if "MK_NCW" in os.environ:
+        spec.preset_params = {**spec.preset_params,
+                              "ncw": int(os.environ["MK_NCW"])}
+    if os.environ.get("BENCH_BACKEND"):
+        spec.preset_params["cgw_backend"] = os.environ["BENCH_BACKEND"]
+    if os.environ.get("BENCH_SYNTH_PRECISION"):
+        spec.preset_params["gwb_synthesis_precision"] = os.environ[
+            "BENCH_SYNTH_PRECISION"]
+
 t = time.monotonic()
 # the fingerprint binds the cache to THIS workload definition (build
 # params, host draw bytes, STREAM_VERSION): fast_capture verifies it
 # before reuse, so a plane serialized from an older workload can never
 # silently substitute different static data (ADVICE.md r5)
-batch, recipe, fp = build_workload(ncw=ncw, with_fingerprint=True)
+compiled = compile_spec(spec)
+batch, recipe, fp = compiled.batch, compiled.recipe, compiled.fingerprint
+# the catalog size ACTUALLY compiled (tile-cache meta + log) — never
+# the MK_NCW env default, which does not apply to non-preset specs
+ncw = (int(recipe.cgw_params.shape[1])
+       if recipe.cgw_params is not None else 0)
 static = np.asarray(deterministic_delays(batch, recipe))
 # atomic write: a reader (fast_capture mid-window) must never see a
 # truncated file
@@ -58,7 +94,7 @@ print(f"wrote /tmp/workload.npz {static.shape} {static.dtype} "
       f"fp={fp} in {time.monotonic()-t:.1f}s")
 
 tiles_path = os.environ.get("MK_PLANE_TILES", "/tmp/cw_plane_tiles.npz")
-if tiles_path != "0":
+if tiles_path != "0" and recipe.cgw_params is not None:
     t = time.monotonic()
     chunk = int(os.environ.get("MK_PLANE_CHUNK", "65536"))
     # pdist/pphase forwarded exactly as deterministic_delays' streamed

@@ -1,0 +1,201 @@
+"""Scenario-fuzz bench: the differential matrix as committed evidence.
+
+Runs N (default 200) generated scenarios through the batched-vs-oracle
+differential (pta_replicator_tpu/scenarios/fuzz.py) and gates on the
+whole contract at once:
+
+* **0 unexplained disagreements** — every scenario's every enabled
+  family (and the jit-fused engine total) within its documented
+  tolerance of the oracle ``models/`` single-pulsar path, under shared
+  PRNG streams;
+* **coverage** — the fixed-seed generator must have exercised every
+  Recipe signal family and structural variant (white/ecorr/red/
+  chromatic, power-law + turnover + free-spectrum GWB, HD /
+  uncorrelated / anisotropic ORFs, population-split + explicit +
+  streamed CW catalogs, bursts, memory, gaussian transients, glitch
+  steps) at least once — a fuzz run that silently stopped sampling a
+  family proves nothing about it;
+* **pipelined-vs-sync sweep byte-identity** on a sampled subset of
+  scenarios carrying sweep plans;
+* **the planted-bug arm** — a controlled defect injected into one
+  batched family must be detected, shrunk to a minimal spec containing
+  exactly that family, written as a replayable spec file, and the
+  replay WITHOUT the defect must pass (the harness's own
+  false-positive control).
+
+Prints one JSON line; committed as ``FUZZ_r12_cpu.json`` and diffed by
+``bench-diff`` (scenarios_per_s / agreement_rate higher-better,
+max_rel_disagreement lower-better — obs/regress.py). Exit 1 on any
+gate miss, so CI runs the --fast configuration directly
+(scripts/check.sh).
+
+Usage: python benchmarks/scenario_fuzz.py [--fast] [--out PATH]
+  env: FUZZ_N / FUZZ_SEED / FUZZ_SWEEP_EVERY reshape the run.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from pta_replicator_tpu.scenarios import compile_spec, fuzz as fz  # noqa: E402
+from pta_replicator_tpu.scenarios.spec import load_spec  # noqa: E402
+from pta_replicator_tpu.utils.provenance import (  # noqa: E402
+    EVIDENCE_SCHEMA_VERSION,
+    provenance_stamp,
+)
+
+#: every signal family / structural variant the generator must have
+#: exercised in a full run (spec_families tokens). The fixed seed makes
+#: this deterministic: a miss means the generator (or the token map)
+#: changed, not bad luck.
+REQUIRED_COVERAGE = (
+    "white", "ecorr", "red", "chromatic",
+    "gwb_powerlaw", "gwb_turnover", "gwb_freespec",
+    "orf_hd", "orf_none", "orf_aniso",
+    "cw", "cw_streamed", "population_cw",
+    "burst", "memory", "transient", "glitch",
+)
+
+
+def planted_bug_arm(out_dir: str) -> dict:
+    """Inject a controlled defect into one batched family; require
+    detection, shrinking to exactly that family, and a replayable
+    minimal spec that PASSES once the defect is removed."""
+    planted_family = "ecorr"
+    report = fz.fuzz(
+        6, root_seed=5, out_dir=out_dir,
+        perturb={"family": planted_family, "scale": 1.01},
+    )
+    arm = {
+        "planted_family": planted_family,
+        "scale": 1.01,
+        "n_scenarios": report["n_scenarios"],
+        "detected": report["n_disagreements"],
+        "failures": report["failures"],
+    }
+    problems = []
+    if not report["n_disagreements"]:
+        problems.append("planted bug was not detected")
+    for f in report["failures"]:
+        if f["minimal_families"] != [planted_family]:
+            problems.append(
+                f"shrinker did not converge to the planted family: "
+                f"{f['minimal_families']}"
+            )
+        replay_file = f.get("replay_file")
+        if not replay_file or not os.path.exists(replay_file):
+            problems.append("no replayable minimal spec written")
+            continue
+        # the false-positive control: the minimal spec WITHOUT the
+        # planted defect must agree (the spec is innocent, the
+        # perturbation was the bug)
+        res = fz.run_scenario(
+            compile_spec(load_spec(replay_file), validate=False)
+        )
+        if not res.agree:
+            problems.append(
+                f"minimal spec {replay_file} disagrees even without "
+                "the planted defect"
+            )
+    arm["ok"] = not problems
+    arm["problems"] = problems
+    return arm
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv[1:]
+    out_path = None
+    argv = sys.argv[1:]
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    n = int(os.environ.get("FUZZ_N", "8" if fast else "200"))
+    seed = int(os.environ.get("FUZZ_SEED", "0"))
+    sweep_every = int(os.environ.get("FUZZ_SWEEP_EVERY",
+                                     "4" if fast else "8"))
+
+    failures = []
+    d = tempfile.mkdtemp(prefix="scenario_fuzz_")
+    # shrunk replayable failing specs must OUTLIVE the bench (the whole
+    # point is re-running them after an exit-1) — they go to a durable
+    # dir, not the tempdir the finally below deletes; created only when
+    # a disagreement actually happens. The planted arm's specs stay in
+    # the tempdir: they are validated in-process and intentionally
+    # transient.
+    fail_dir = os.environ.get("FUZZ_FAIL_DIR", "scenario_fuzz_failures")
+    try:
+        t0 = time.monotonic()
+        report = fz.fuzz(
+            n, root_seed=seed, out_dir=fail_dir,
+            sweep_every=sweep_every,
+            progress=(lambda done, total: print(
+                f"scenario {done}/{total}", file=sys.stderr)
+                if not fast else None),
+        )
+        if report["n_disagreements"]:
+            failures.append(
+                f"{report['n_disagreements']} unexplained "
+                f"disagreement(s): {report['failures']}"
+            )
+        si = report["sweep_identity"]
+        if si["checked"] == 0:
+            failures.append("sweep-identity arm never ran (no scenario "
+                            "carried a sweep plan at this seed)")
+        elif not si["all_bit_identical"]:
+            failures.append("pipelined-vs-sync sweep byte-identity "
+                            "violated")
+        missing = [fam for fam in REQUIRED_COVERAGE
+                   if not report["coverage"].get(fam)]
+        if missing and not fast:
+            failures.append(f"coverage gap: {missing} never sampled")
+
+        planted = planted_bug_arm(os.path.join(d, "planted"))
+        if not planted["ok"]:
+            failures.append(f"planted-bug arm: {planted['problems']}")
+
+        rec = {
+            "bench": "scenario_fuzz",
+            "backend": jax.default_backend(),
+            "fast": fast,
+            "wall_s": round(time.monotonic() - t0, 3),
+            "n_scenarios": report["n_scenarios"],
+            "root_seed": seed,
+            "scenarios_per_s": report["scenarios_per_s"],
+            "agreement_rate": report["agreement_rate"],
+            "n_disagreements": report["n_disagreements"],
+            "max_rel_disagreement": report["max_rel_disagreement"],
+            "max_rel_by_family": report["max_rel_by_family"],
+            "tolerances": report["tolerances"],
+            "coverage": report["coverage"],
+            "combo_histogram_size": report["combo_histogram_size"],
+            "required_coverage_missing": missing,
+            "sweep_identity": si,
+            "planted_bug": planted,
+            "ok": not failures,
+            "failures": failures,
+            **provenance_stamp(
+                EVIDENCE_SCHEMA_VERSION,
+                repo_root=os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))
+                ),
+            ),
+        }
+        payload = json.dumps(rec)
+        print(payload)
+        if out_path:
+            with open(out_path, "w") as fh:
+                fh.write(payload + "\n")
+        return 1 if failures else 0
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -164,6 +164,51 @@ def main(argv=None):
     p.add_argument("--out", default=None,
                    help="write the result JSON here instead of stdout")
     p = sub.add_parser(
+        "scenario",
+        help="declarative scenario layer (docs/scenarios.md): validate/"
+             "compile specs, run one through the sweep with its "
+             "provenance stamped, fuzz the batched engine against the "
+             "oracle models/ path, or replay a saved failing spec")
+    p.add_argument("action",
+                   choices=("validate", "compile", "run", "fuzz",
+                            "replay"),
+                   help="validate: check spec files and print their "
+                        "content hashes; compile: spec -> workload "
+                        "summary (--out writes the static-plane npz); "
+                        "run: compile + checkpointed sweep with the "
+                        "spec hash stamped into the sidecar; fuzz: "
+                        "random scenarios through the batched-vs-"
+                        "oracle differential (exit 1 on any "
+                        "disagreement); replay: re-run one saved spec "
+                        "through the differential")
+    p.add_argument("specs", nargs="*", metavar="SPEC",
+                   help="scenario spec file(s), .json or .toml")
+    p.add_argument("--out", default=None,
+                   help="compile: write the static plane + fingerprint "
+                        "npz here; run: write the result cube npz here")
+    p.add_argument("--checkpoint", default=None,
+                   help="run: resumable sweep checkpoint path "
+                        "(default: <out>.sweep.npz)")
+    p.add_argument("--nreal", type=int, default=None,
+                   help="run: override the spec's sweep.nreal")
+    p.add_argument("--n", type=int, default=50,
+                   help="fuzz: scenarios to generate (default 50)")
+    p.add_argument("--root-seed", type=int, default=0,
+                   help="fuzz: generator root seed (scenario K derives "
+                        "via fold_in(root, K))")
+    p.add_argument("--out-dir", default="scenario_fuzz_failures",
+                   help="fuzz: directory for shrunk replayable failing "
+                        "specs (default: ./scenario_fuzz_failures/, "
+                        "created only when a disagreement is found)")
+    p.add_argument("--sweep-every", type=int, default=0,
+                   help="fuzz: run the pipelined-vs-sync sweep "
+                        "byte-identity arm on every K-th scenario "
+                        "that carries a sweep plan (0 = off)")
+    p.add_argument("--fast", action="store_true",
+                   help="fuzz: the CI arm — 8 scenarios, fixed seed, "
+                        "sweep-identity every 4th")
+    p.add_argument("--telemetry", default=None, metavar="DIR")
+    p = sub.add_parser(
         "report", help="pretty-print a captured --telemetry directory")
     p.add_argument("dir", help="telemetry directory (events.jsonl + "
                                "metrics.json)")
@@ -604,7 +649,162 @@ def _serve_demo(args, bank, batch, recipe, grid_axes):
     return stats
 
 
+def _run_scenario(args):
+    from .obs import names, span
+    from .scenarios import SpecError, compile_spec, fuzz as fz, load_spec
+
+    def load_all():
+        if not args.specs:
+            raise SystemExit("scenario: give at least one SPEC file")
+        out = []
+        for path in args.specs:
+            try:
+                out.append((path, load_spec(path)))
+            except SpecError as exc:
+                raise SystemExit(f"{path}: {exc}")
+        return out
+
+    if args.action == "validate":
+        for path, spec in load_all():
+            print(json.dumps({
+                "spec": path, "name": spec.name,
+                "hash": spec.content_hash, "valid": True,
+            }))
+        return
+
+    if args.action == "compile":
+        all_specs = load_all()
+        if args.out and len(all_specs) > 1:
+            raise SystemExit(
+                "scenario compile --out takes exactly one SPEC (the "
+                "output path would be overwritten per spec); compile "
+                "them separately"
+            )
+        for path, spec in all_specs:
+            compiled = compile_spec(spec, validate=False)
+            summary = {
+                "spec": path,
+                "name": spec.name,
+                "hash": compiled.spec_hash,
+                "fingerprint": compiled.fingerprint,
+                "families": list(compiled.families),
+                "npsr": int(compiled.batch.npsr),
+                "ntoa": int(np.asarray(compiled.batch.toas_s).shape[1]),
+                "plan": vars(compiled.plan),
+            }
+            if args.out:
+                static = np.asarray(compiled.static_delays())
+                # np.savez appends .npz to other suffixes; atomic like
+                # mk_workload so a concurrent reader never sees a torn
+                # file
+                tmp = args.out + ".tmp.npz"
+                np.savez(tmp, static=static,
+                         fingerprint=np.array(compiled.fingerprint))
+                os.replace(tmp, args.out)
+                summary["out"] = args.out
+            print(json.dumps(summary, sort_keys=True))
+        return
+
+    if args.action == "run":
+        from .utils.sweep import sweep
+
+        specs = load_all()
+        if len(specs) > 1:
+            raise SystemExit(
+                "scenario run takes exactly one SPEC (got "
+                f"{len(specs)}); run them separately — each sweep "
+                "needs its own --checkpoint/--out"
+            )
+        path, spec = specs[0]
+        compiled = compile_spec(spec, validate=False)
+        plan = compiled.plan
+        nreal = args.nreal if args.nreal is not None else plan.nreal
+        chunk = plan.chunk
+        if nreal % chunk:
+            # silently picking a different chunk would change the
+            # fold_in-per-chunk key layout (and thus the draws), so a
+            # non-divisible override — including nreal < chunk — is an
+            # error, not an adjustment
+            raise SystemExit(
+                f"--nreal {nreal} must be a multiple of the spec's "
+                f"sweep.chunk ({plan.chunk}); pick a multiple or edit "
+                "the spec's sweep section"
+            )
+        ckpt = args.checkpoint or (
+            (args.out or f"{spec.name}.npz") + ".sweep.npz"
+        )
+        with span(names.SPAN_COMPUTE, nreal=nreal):
+            out = sweep(
+                compiled.realize_key(), compiled.batch, compiled.recipe,
+                nreal=nreal, checkpoint_path=ckpt, chunk=chunk,
+                reduce_fn=None, fit=plan.fit,
+                pipeline_depth=plan.pipeline_depth,
+                provenance=compiled.provenance(),
+            )
+        summary = {
+            "spec": path, "hash": compiled.spec_hash,
+            "checkpoint": ckpt, "shape": list(out.shape),
+            "rms_s": float(np.sqrt((np.asarray(out) ** 2).mean())),
+        }
+        if args.out:
+            # same atomic writer as the compile action (np.savez
+            # appends .npz to other suffixes, which would leave the
+            # summary naming a path that doesn't exist)
+            tmp = args.out + ".tmp.npz"
+            np.savez(tmp, residuals=np.asarray(out),
+                     mask=np.asarray(compiled.batch.mask))
+            os.replace(tmp, args.out)
+            summary["out"] = args.out
+        print(json.dumps(summary, sort_keys=True))
+        return
+
+    if args.action == "fuzz":
+        if args.specs:
+            raise SystemExit(
+                "scenario fuzz generates its own random scenarios and "
+                "takes no SPEC files (use `scenario replay` to re-run "
+                "a saved spec through the differential)"
+            )
+        n = 8 if args.fast else args.n
+        sweep_every = 4 if args.fast else args.sweep_every
+        report = fz.fuzz(
+            n, root_seed=args.root_seed, out_dir=args.out_dir,
+            sweep_every=sweep_every,
+            progress=lambda d, t: print(f"scenario {d}/{t}",
+                                        file=sys.stderr),
+        )
+        print(json.dumps(report, indent=1, sort_keys=True))
+        if report["n_disagreements"]:
+            print(f"scenario fuzz: {report['n_disagreements']} "
+                  f"disagreement(s); shrunk replayable spec(s) under "
+                  f"{args.out_dir}/", file=sys.stderr)
+            raise SystemExit(1)
+        si = report["sweep_identity"]
+        if si["checked"] and not si["all_bit_identical"]:
+            # stdout (the report) is routinely /dev/null'd in CI, so
+            # the failure reason must reach stderr too
+            print("scenario fuzz: pipelined-vs-sync sweep byte-"
+                  "identity violated (see the sweep_identity block of "
+                  "the report)", file=sys.stderr)
+            raise SystemExit(1)
+        return
+
+    if args.action == "replay":
+        rc = 0
+        for path, spec in load_all():
+            res = fz.run_scenario(compile_spec(spec, validate=False))
+            print(json.dumps({"spec": path, **res.to_dict()},
+                             indent=1, sort_keys=True))
+            if not res.agree:
+                rc = 1
+        if rc:
+            raise SystemExit(rc)
+        return
+
+
 def _run_command(args):
+    if args.cmd == "scenario":
+        return _run_scenario(args)
     if args.cmd == "likelihood":
         return _run_likelihood(args)
 

@@ -188,6 +188,7 @@ def default_rules() -> List[Rule]:
         rules_jax,
         rules_obs,
         rules_robust,
+        rules_scenarios,
         rules_telemetry,
         rules_threads,
     )
@@ -198,6 +199,7 @@ def default_rules() -> List[Rule]:
         *rules_telemetry.RULES,
         *rules_obs.RULES,
         *rules_robust.RULES,
+        *rules_scenarios.RULES,
     ]
 
 

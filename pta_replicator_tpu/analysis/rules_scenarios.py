@@ -1,0 +1,122 @@
+"""graftlint rule pack: scenario-layer PRNG seed discipline.
+
+The scenario compiler's correctness contract (scenarios/compile.py) is
+*positional independence*: scenario K's draws — and each signal
+family's draws within K — must not depend on how many scenarios (or
+families) were processed before it. That property is what makes the
+fuzz shrinker sound (deleting a spec section leaves every other
+section's stream bit-identical) and what keeps a committed spec's
+compile output stable forever. It holds exactly when every key
+derivation is **indexed** (``fold_in(root, index)``) and none is
+**sequential** (``key, sub = jax.random.split(key)`` threaded through a
+loop: remove one iteration and every later draw shifts).
+
+* ``scenario-split-chain`` — inside ``scenarios/`` modules, a call to
+  ``jax.random.split`` whose result rebinds its own key operand
+  (``key, k = split(key)`` / ``key = split(key)[0]``), or any
+  ``jax.random.split``/key-consuming draw inside a loop body. Both are
+  the sequential-chain shape; the fix is ``fold_in(root, i)`` with the
+  loop index (or a per-family constant from ``FAMILY_IDS``).
+
+The general ``jax-key-reuse`` rule (rules_jax.py) still applies in
+``scenarios/`` too — this pack adds the stricter, subtree-scoped
+"indexed, never sequential" requirement that only the scenario layer
+promises.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .engine import Finding, Module, Rule
+
+#: the subtree this pack polices (posix relpath prefix)
+SCENARIOS_PREFIX = "pta_replicator_tpu/scenarios/"
+
+#: jax.random callables that CONSUME a key (draws + derivations)
+_KEY_CALLS_PREFIX = "jax.random."
+#: derivation calls: split is the sequential-chain primitive; fold_in
+#: is the sanctioned indexed form
+_SPLIT = "jax.random.split"
+_FOLD_IN = "jax.random.fold_in"
+
+
+def _names_in(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+
+
+class ScenarioSplitChain(Rule):
+    id = "scenario-split-chain"
+    severity = "error"
+    description = (
+        "sequential PRNG key chain in scenarios/ (split rebinding its "
+        "own operand, or a key derivation/draw inside a loop): scenario "
+        "and family draws must be fold_in-indexed so they are "
+        "independent of iteration order (scenarios/compile.py seed "
+        "discipline)"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        rel = mod.relpath.replace("\\", "/")
+        if not rel.startswith(SCENARIOS_PREFIX):
+            return
+        # loop bodies in this module (for/while), for the in-loop check
+        loop_spans = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                end = max(
+                    (getattr(n, "lineno", node.lineno)
+                     for n in ast.walk(node)),
+                    default=node.lineno,
+                )
+                loop_spans.append((node.lineno, end))
+
+        def in_loop(lineno: int) -> bool:
+            return any(a < lineno <= b for a, b in loop_spans)
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve(node.func) or ""
+            if not resolved.startswith(_KEY_CALLS_PREFIX):
+                continue
+            if resolved in (_KEY_CALLS_PREFIX + "PRNGKey",
+                            _KEY_CALLS_PREFIX + "key",
+                            _KEY_CALLS_PREFIX + "key_data"):
+                continue
+            if resolved == _SPLIT:
+                # split rebinding its own operand = sequential chain,
+                # loop or not
+                operands = set(_names_in(node))
+                assign = mod.ancestors(node)
+                targets = set()
+                for anc in assign:
+                    if isinstance(anc, (ast.Assign, ast.AugAssign,
+                                        ast.AnnAssign)):
+                        tgt = (anc.targets if isinstance(anc, ast.Assign)
+                               else [anc.target])
+                        for t in tgt:
+                            targets.update(_names_in(t))
+                        break
+                if operands & targets:
+                    yield self.finding(
+                        mod, node.lineno,
+                        "jax.random.split rebinds its own key operand "
+                        f"({', '.join(sorted(operands & targets))}) — a "
+                        "sequential chain; derive with "
+                        "jax.random.fold_in(root, index) instead",
+                    )
+                    continue
+            if resolved != _FOLD_IN and in_loop(node.lineno):
+                yield self.finding(
+                    mod, node.lineno,
+                    f"{resolved.rsplit('.', 1)[-1]} inside a loop body "
+                    "in scenarios/: per-iteration keys must come from "
+                    "jax.random.fold_in(root, loop_index), not "
+                    "sequential derivation/draws",
+                )
+
+
+RULES = [ScenarioSplitChain()]

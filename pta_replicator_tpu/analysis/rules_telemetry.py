@@ -290,6 +290,16 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/obs/devprof.py", "event", n.EVENT_DEVICE_TRACE),
         (f"{pkg}/obs/devprof.py", "text", "JAX_COST_PREFIX"),
         (f"{pkg}/obs/devprof.py", "text", "JAX_ROOFLINE_PREFIX"),
+        # scenario layer (PR 12): compile and fuzz-case spans, the
+        # compiled/cases/disagreements/shrink-step counters — the fuzz
+        # harness's evidence trail (a silent fuzz run proves nothing)
+        (f"{pkg}/scenarios/compile.py", "span", n.SPAN_SCENARIO_COMPILE),
+        (f"{pkg}/scenarios/compile.py", "metric", n.SCENARIO_COMPILED),
+        (f"{pkg}/scenarios/fuzz.py", "span", n.SPAN_SCENARIO_FUZZ_CASE),
+        (f"{pkg}/scenarios/fuzz.py", "metric", n.SCENARIO_FUZZ_CASES),
+        (f"{pkg}/scenarios/fuzz.py", "metric",
+         n.SCENARIO_FUZZ_DISAGREEMENTS),
+        (f"{pkg}/scenarios/fuzz.py", "metric", n.SCENARIO_SHRINK_STEPS),
         (f"{pkg}/__main__.py", "span", n.SPAN_COMPUTE),
         (f"{pkg}/__main__.py", "span", n.SPAN_INGEST),
         ("bench.py", "span", n.SPAN_BENCH_MEASURE),

@@ -1168,6 +1168,112 @@ class Recipe:
     cgw_backend: str = field(metadata=dict(static=True), default="auto")
     transient_psr: int = field(metadata=dict(static=True), default=0)
 
+    def __post_init__(self):
+        _validate_recipe(self)
+
+
+def _leaf_shape(x):
+    """Shape of an array-ish Recipe leaf, else None. None gates the
+    shape checks below: ``register_dataclass`` re-runs ``__init__`` on
+    every pytree unflatten, where leaves may be tracers (shaped — check
+    them) but also non-array stand-ins that must be waved through — a
+    ``tree_map(lambda _: 0, recipe)`` structure probe, or
+    parallel/mesh.py's PartitionSpec tree (tree_unflatten of per-leaf
+    shard specs into the Recipe structure). Only a genuine ``.shape``
+    attribute qualifies; lists/tuples are deliberately NOT coerced
+    (PartitionSpec IS a tuple)."""
+    s = getattr(x, "shape", None)
+    if s is not None and not isinstance(x, (list, tuple)):
+        return tuple(s)
+    return None
+
+
+def _validate_recipe(r: "Recipe"):
+    """Reject mutually inconsistent Recipe fields at construction with
+    a message naming the field — the combinations below otherwise fail
+    deep inside jit with a shape/NoneType error pointing at nothing (or
+    worse, silently inject nothing). Presence (None-ness) checks always
+    run; shape checks run only when the leaf actually carries a shape
+    (see :func:`_leaf_shape` for why).
+
+    The scenario layer (scenarios/spec.py) validates the DECLARATIVE
+    surface before compiling; this is the last line of defense for
+    recipes assembled by hand."""
+
+    def need(cond: bool, msg: str):
+        if not cond:
+            raise ValueError(f"Recipe: {msg}")
+
+    burst_fields = ("burst_sky", "burst_hplus", "burst_hcross",
+                    "burst_grid")
+    burst_present = [f for f in burst_fields
+                     if getattr(r, f) is not None]
+    need(
+        len(burst_present) in (0, len(burst_fields)),
+        f"a burst needs all of {burst_fields}, got only "
+        f"{tuple(burst_present)} (the sky/polarization triple, both "
+        "pre-sampled waveforms, and the [start_s, stop_s] grid window "
+        "travel together)",
+    )
+    need(
+        (r.transient_waveform is None) == (r.transient_grid is None),
+        "transient_waveform and transient_grid travel together (the "
+        "pre-sampled waveform is meaningless without its [start_s, "
+        "stop_s] grid window, and vice versa)",
+    )
+    need(
+        r.cgw_params is not None or (r.cgw_pdist is None
+                                     and r.cgw_pphase is None),
+        "cgw_pdist/cgw_pphase describe the pulsar term of a CW catalog "
+        "— set cgw_params too (or drop them)",
+    )
+    need(
+        r.rn_gamma is not None or r.rn_log10_amplitude is None,
+        "red noise needs rn_gamma alongside rn_log10_amplitude (the "
+        "power-law prior has two parameters)",
+    )
+    need(
+        r.chrom_gamma is not None or r.chrom_log10_amplitude is None,
+        "chromatic noise needs chrom_gamma alongside "
+        "chrom_log10_amplitude",
+    )
+    need(
+        r.gwb_log10_amplitude is None or r.gwb_gamma is not None
+        or r.gwb_user_spectrum is not None,
+        "a power-law GWB needs gwb_gamma alongside gwb_log10_amplitude "
+        "(or a gwb_user_spectrum, which overrides the power law)",
+    )
+
+    cgw_shape = _leaf_shape(r.cgw_params)
+    if cgw_shape is not None:
+        need(
+            len(cgw_shape) == 2 and cgw_shape[0] == 8,
+            f"cgw_params must be the (8, Ns) stacked catalog (gwtheta, "
+            f"gwphi, mc, dist, fgw, phase0, psi, inc), got shape "
+            f"{cgw_shape}",
+        )
+        ns = cgw_shape[1]
+        for fname in ("cgw_pdist", "cgw_pphase"):
+            s = _leaf_shape(getattr(r, fname))
+            if s is not None and len(s) >= 1:
+                need(
+                    len(s) <= 2 and s[-1] == ns,
+                    f"{fname} has shape {s} but the catalog has "
+                    f"{ns} source(s); pass a scalar, (Ns,), or "
+                    f"(Np, Ns)",
+                )
+    for fname, want in (("gwm_params", (5,)), ("burst_sky", (3,)),
+                        ("burst_grid", (2,)), ("transient_grid", (2,))):
+        s = _leaf_shape(getattr(r, fname))
+        if s is not None:
+            need(
+                s == want,
+                f"{fname} must have shape {want}, got {s}",
+            )
+    if isinstance(r.transient_psr, int):
+        need(r.transient_psr >= 0,
+             f"transient_psr must be >= 0, got {r.transient_psr}")
+
 
 def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
     """One realization: (Np, Nt) summed delays from the enabled signals.

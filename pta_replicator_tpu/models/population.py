@@ -200,14 +200,25 @@ def population_recipe(
     gwb_npts: int = 600,
     cw_tref_s: float = 53000 * 86400.0,
     base_recipe=None,
+    split: PopulationSplit = None,
 ):
     """Device-path variant: same population split, returned as a Recipe
-    (user-spectrum GWB + stacked CW catalog) for batched realization."""
+    (user-spectrum GWB + stacked CW catalog) for batched realization.
+
+    ``split`` short-circuits the binning with a precomputed
+    :class:`PopulationSplit` (the scenario compiler bins once and feeds
+    both the recipe and its coverage record); ``vals``/``weights``/
+    ``fobs``/``T_obs`` are ignored then. A split with zero outliers
+    (``outlier_per_bin=0``, or every bin empty) leaves the CW catalog
+    off instead of injecting a zero-source catalog the tiled response
+    kernels cannot chunk."""
     import jax.numpy as jnp
 
     from .batched import Recipe
 
-    split = split_population(vals, weights, fobs, T_obs, outlier_per_bin)
+    if split is None:
+        split = split_population(vals, weights, fobs, T_obs,
+                                 outlier_per_bin)
     n_cw = split.outlier_fo.shape[0]
     rng = np.random.default_rng(seed)
     gwtheta = np.arccos(rng.uniform(-1.0, 1.0, n_cw))
@@ -216,19 +227,22 @@ def population_recipe(
     psi = rng.uniform(0.0, np.pi, n_cw)
     inc = np.arccos(rng.uniform(-1.0, 1.0, n_cw))
 
-    cat = np.stack(
-        [gwtheta, gwphi, split.outlier_mc, split.outlier_dl,
-         split.outlier_fo, phase0, psi, inc]
-    )
     kwargs = dict(vars(base_recipe)) if base_recipe is not None else {}
     kwargs.update(
         gwb_log10_amplitude=jnp.asarray(0.0),  # unused under user spectrum
         gwb_gamma=jnp.asarray(0.0),
         gwb_user_spectrum=jnp.asarray(split.user_spectrum),
         orf_cholesky=jnp.asarray(orf_cholesky),
-        cgw_params=jnp.asarray(cat),
         gwb_npts=gwb_npts,
         gwb_howml=howml,
-        cgw_tref_s=cw_tref_s,
     )
+    if n_cw:
+        cat = np.stack(
+            [gwtheta, gwphi, split.outlier_mc, split.outlier_dl,
+             split.outlier_fo, phase0, psi, inc]
+        )
+        kwargs.update(
+            cgw_params=jnp.asarray(cat),
+            cgw_tref_s=cw_tref_s,
+        )
     return Recipe(**kwargs)

@@ -65,10 +65,18 @@ SPAN_LIKELIHOOD_SERVE = "likelihood_serve"
 #: one-time bank projection pass through the ReducedGP precompute
 SPAN_LIKELIHOOD_PROJECT = "likelihood_project"
 
+# scenario compiler + differential fuzz harness (scenarios/)
+#: one spec -> (batch, recipe, plan) compile (scenarios/compile.py)
+SPAN_SCENARIO_COMPILE = "scenario_compile"
+#: one fuzz case: compile + batched-vs-oracle differential
+#: (scenarios/fuzz.py run_scenario)
+SPAN_SCENARIO_FUZZ_CASE = "scenario_fuzz_case"
+
 # CLI runner (the top-level span is the subcommand name)
 SPAN_CLI_REALIZE = "realize"
 SPAN_CLI_INFO = "info"
 SPAN_CLI_LIKELIHOOD = "likelihood"
+SPAN_CLI_SCENARIO = "scenario"
 SPAN_INGEST = "ingest"
 SPAN_BUILD_RECIPE = "build_recipe"
 SPAN_COMPUTE = "compute"
@@ -94,7 +102,9 @@ SPANS = frozenset({
     SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE, SPAN_MULTICHIP_SWEEP,
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_LIKELIHOOD_BATCH, SPAN_LIKELIHOOD_SERVE, SPAN_LIKELIHOOD_PROJECT,
+    SPAN_SCENARIO_COMPILE, SPAN_SCENARIO_FUZZ_CASE,
     SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_CLI_LIKELIHOOD,
+    SPAN_CLI_SCENARIO,
     SPAN_INGEST, SPAN_BUILD_RECIPE,
     SPAN_COMPUTE, SPAN_WRITE_OUTPUT,
     SPAN_BENCH_INGEST_B1855, SPAN_BENCH_AOT_COMPILE, SPAN_BENCH_WARMUP,
@@ -179,6 +189,14 @@ LIKELIHOOD_DEADLINE_EXPIRED = "likelihood.deadline_expired"
 #: labeled site=/kind= — zero in any run that didn't arm a schedule
 FAULTS_INJECTED = "faults.injected"
 
+# scenario layer (scenarios/): specs compiled, fuzz cases run,
+# batched-vs-oracle disagreements found (0 in a healthy tree), and
+# shrinker candidate evaluations spent minimizing failures
+SCENARIO_COMPILED = "scenario.compiled"
+SCENARIO_FUZZ_CASES = "scenario.fuzz_cases"
+SCENARIO_FUZZ_DISAGREEMENTS = "scenario.fuzz_disagreements"
+SCENARIO_SHRINK_STEPS = "scenario.shrink_steps"
+
 # flight recorder
 FLIGHTREC_STALLS = "flightrec.stalls"
 
@@ -220,6 +238,8 @@ METRICS = frozenset({
     LIKELIHOOD_QUEUE_DEPTH, LIKELIHOOD_REJECTED,
     LIKELIHOOD_DEADLINE_EXPIRED,
     FAULTS_INJECTED,
+    SCENARIO_COMPILED, SCENARIO_FUZZ_CASES,
+    SCENARIO_FUZZ_DISAGREEMENTS, SCENARIO_SHRINK_STEPS,
     FLIGHTREC_STALLS,
     OBS_OVERHEAD_S, PROC_RSS_BYTES,
     OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
@@ -252,6 +272,7 @@ PIPELINE_PREFIX = "pipeline."
 CW_STREAM_PREFIX = "cw_stream."
 LIKELIHOOD_PREFIX = "likelihood."
 FAULTS_PREFIX = "faults."
+SCENARIO_PREFIX = "scenario."
 OCCUPANCY_PREFIX = "occupancy."
 OBS_PREFIX = "obs."
 PROC_PREFIX = "proc."

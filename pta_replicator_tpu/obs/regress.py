@@ -82,6 +82,12 @@ _HIGHER_BETTER_TOKENS = (
     # through injected faults — fewer recovered runs means the
     # supervised-recovery machinery regressed (ISSUE 11)
     "recovered_runs",
+    # FUZZ series (benchmarks/scenario_fuzz.py, ISSUE 12): differential
+    # throughput and the share of scenarios where batched == oracle —
+    # a falling agreement rate is a correctness regression, full stop.
+    # "per_s"/"rate" already match these leaves; spelled out so the
+    # gate's contract for the series is explicit.
+    "scenarios_per_s", "agreement_rate",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves
@@ -99,7 +105,13 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
                         # spelled out for the explicit-contract reason
                         # above)
                         "chunk_retries", "stage_retries", "rejected",
-                        "deadline_expired", "fault_overhead")
+                        "deadline_expired", "fault_overhead",
+                        # FUZZ series (ISSUE 12): batched-vs-oracle
+                        # deviation magnitudes and disagreement counts
+                        # are costs — a rising max_rel_disagreement is
+                        # precision (or correctness) eroding even while
+                        # every scenario still passes its tolerance
+                        "disagreement")
 #: name fragments with NO better direction: jax.cost.* gauges are
 #: properties of the compiled program (flops per chunk changing is a
 #: workload change, not a perf verdict — even though "flops" is a

@@ -1,0 +1,41 @@
+"""Declarative scenario layer: spec -> compile -> differential fuzz.
+
+The ROADMAP's "as many scenarios as you can imagine" as an enumerable,
+benchmarked matrix (docs/scenarios.md):
+
+* :mod:`.spec` — versioned, JSON/TOML-serializable
+  :class:`~.spec.ScenarioSpec` with early field-naming validation and a
+  content hash for provenance;
+* :mod:`.compile` — deterministic, ``fold_in``-seeded compiler
+  spec -> (PulsarBatch, Recipe, SweepPlan); home of the
+  ``bench_flagship`` preset (the committed
+  ``scenarios/specs/flagship.json``, whose fingerprint contract
+  ``bench.build_workload`` and ``benchmarks/mk_workload.py`` shim onto);
+* :mod:`.fuzz` — property-based differential harness running random
+  specs through the batched engine vs the oracle ``models/``
+  single-pulsar path (and pipelined-vs-sync sweep byte-identity), with
+  shrinking to a minimal replayable failing spec.
+
+CLI: ``python -m pta_replicator_tpu scenario
+{validate,compile,run,fuzz,replay}``.
+"""
+from __future__ import annotations
+
+from .compile import (
+    CompiledScenario,
+    SweepPlan,
+    compile_spec,
+    family_key,
+    family_rng,
+    flagship_workload,
+    random_cw_catalog,
+    spec_families,
+)
+from .spec import SCENARIO_SPEC_VERSION, ScenarioSpec, SpecError, load_spec
+
+__all__ = [
+    "SCENARIO_SPEC_VERSION", "ScenarioSpec", "SpecError", "load_spec",
+    "CompiledScenario", "SweepPlan", "compile_spec", "family_key",
+    "family_rng", "flagship_workload", "random_cw_catalog",
+    "spec_families",
+]

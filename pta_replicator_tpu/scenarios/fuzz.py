@@ -1,0 +1,904 @@
+"""Property-based differential fuzzing: random scenarios through the
+batched engine vs the oracle ``models/`` single-pulsar path.
+
+The contract being fuzzed: for EVERY compilable scenario, each batched
+injection op (models/batched.py, f32 device math) agrees with the
+corresponding oracle pure-math function (models/white_noise.py,
+red_noise.py, gwb.py, cgw.py, bursts.py — numpy f64, single-pulsar
+loops, the code path pinned draw-for-draw against the reference) to a
+documented per-family tolerance, **under a shared PRNG stream**: the
+harness replays the exact ``jax.random`` draws the batched ops consume
+(the 5-way subkey split of ``realization_delays`` is public contract,
+STREAM_VERSION) and feeds the same stream through the oracle formulas.
+That makes the comparison deterministic and exact-in-distribution —
+a disagreement is a code bug (or a tolerance to re-document), never
+sampling noise.
+
+Per-family tolerances (relative to the oracle family's RMS; measured
+headroom ~10x over the observed f32-vs-f64 deviation on thousands of
+scenarios — see FUZZ_r*_cpu.json's ``max_rel_by_family``):
+
+=============  ========  ====================================================
+family         rel tol   dominant error term
+=============  ========  ====================================================
+white          1e-4      f32 sqrt/mul rounding on the combined variance
+ecorr          1e-4      f32 scale + epoch gather
+red            3e-3      f32 trig of O(100 rad) Fourier phases + f32 matmul
+chromatic      3e-3      red-noise term x f32 power-law frequency scaling
+gwb            3e-3      f32 DFT-synthesis matmul vs f64 hermitian ifft
+cw             3e-3      f32 sin(2*phase) after the f64 plane fold
+burst          1e-3      f32 grid interpolation
+memory         1e-3      f32 ramp arithmetic
+transient      1e-3      f32 grid interpolation (single pulsar)
+total          1e-3      engine (jit-fused) realization vs summed oracle
+=============  ========  ====================================================
+
+On top of the value differential, scenarios with a sweep plan can run
+the **pipelined-vs-sync byte-identity** arm: the same compiled scenario
+through ``utils.sweep`` at ``pipeline_depth=1`` and ``2``, asserting
+the returned cube AND the consolidated checkpoint bytes are identical
+(the sweep executor's core invariant, here enforced over arbitrary
+scenario content instead of one fixture).
+
+On a disagreement the harness **shrinks**: greedily drops spec sections
+and simplifies sizes while the failure persists (family draws are
+``fold_in``-indexed, so deleting one section never perturbs another's
+stream — see scenarios/compile.py), and writes the minimal failing spec
+as a replayable JSON file (``scenario replay FILE`` re-runs it).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .compile import CompiledScenario, compile_spec, spec_families
+from .spec import ScenarioSpec
+
+#: documented per-family relative tolerances (see module docstring)
+FAMILY_TOLERANCES = {
+    "white": 1e-4,
+    "ecorr": 1e-4,
+    "red": 3e-3,
+    "chromatic": 3e-3,
+    "gwb": 3e-3,
+    "cw": 3e-3,
+    "burst": 1e-3,
+    "memory": 1e-3,
+    "transient": 1e-3,
+    "total": 1e-3,
+}
+
+
+def _rel(dev: np.ndarray, oracle: np.ndarray) -> float:
+    """Max absolute deviation relative to the oracle signal's RMS —
+    scale-free, and robust to near-zero individual samples."""
+    rms = float(np.sqrt(np.mean(np.asarray(oracle, np.float64) ** 2)))
+    denom = max(rms, 1e-30)
+    return float(np.max(np.abs(
+        np.asarray(dev, np.float64) - np.asarray(oracle, np.float64)
+    ))) / denom
+
+
+# ------------------------------------------------------------ batched side
+
+def batched_family_delays(compiled: CompiledScenario) -> Dict[str, np.ndarray]:
+    """Each enabled family's delays from the BATCHED ops, eagerly, under
+    the production key schedule (the 5-way split of realization_delays
+    plus the deterministic ops)."""
+    import jax
+
+    from ..models import batched as B
+
+    batch, recipe = compiled.batch, compiled.recipe
+    key = compiled.realize_key()
+    k_wn, k_ec, k_rn, k_chrom, k_gwb = jax.random.split(key, 5)
+    out = {}
+    if recipe.efac is not None or recipe.log10_equad is not None:
+        out["white"] = np.asarray(B.white_noise_delays(
+            k_wn, batch,
+            efac=recipe.efac if recipe.efac is not None else 1.0,
+            log10_equad=recipe.log10_equad, tnequad=recipe.tnequad,
+        ))
+    if recipe.log10_ecorr is not None:
+        out["ecorr"] = np.asarray(
+            B.jitter_delays(k_ec, batch, recipe.log10_ecorr)
+        )
+    if recipe.rn_log10_amplitude is not None:
+        out["red"] = np.asarray(B.red_noise_delays(
+            k_rn, batch, recipe.rn_log10_amplitude, recipe.rn_gamma,
+            nmodes=recipe.rn_nmodes,
+        ))
+    if recipe.chrom_log10_amplitude is not None:
+        out["chromatic"] = np.asarray(B.chromatic_noise_delays(
+            k_chrom, batch, recipe.chrom_log10_amplitude,
+            recipe.chrom_gamma,
+            chromatic_index=(recipe.chrom_index
+                             if recipe.chrom_index is not None else 2.0),
+            nmodes=recipe.chrom_nmodes,
+        ))
+    if (recipe.gwb_log10_amplitude is not None
+            or recipe.gwb_user_spectrum is not None):
+        import jax.numpy as jnp
+
+        if recipe.orf_cholesky is None:
+            chol = jnp.sqrt(2.0) * jnp.eye(batch.npsr,
+                                           dtype=batch.toas_s.dtype)
+        else:
+            chol = recipe.orf_cholesky
+        out["gwb"] = np.asarray(B.gwb_delays(
+            k_gwb, batch, recipe.gwb_log10_amplitude, recipe.gwb_gamma,
+            chol, npts=recipe.gwb_npts, howml=recipe.gwb_howml,
+            turnover=recipe.gwb_turnover, f0=recipe.gwb_f0,
+            beta=recipe.gwb_beta, power=recipe.gwb_power,
+            user_spectrum=recipe.gwb_user_spectrum,
+            synthesis_precision=recipe.gwb_synthesis_precision,
+        ))
+    if recipe.cgw_params is not None:
+        if recipe.cgw_stream_chunk is not None:
+            out["cw"] = np.asarray(B.cgw_catalog_delays_streamed(
+                batch, *[recipe.cgw_params[i] for i in range(8)],
+                pdist=(recipe.cgw_pdist
+                       if recipe.cgw_pdist is not None else 1.0),
+                pphase=recipe.cgw_pphase, psr_term=recipe.cgw_psr_term,
+                evolve=recipe.cgw_evolve,
+                phase_approx=recipe.cgw_phase_approx,
+                tref_s=recipe.cgw_tref_s,
+                chunk=recipe.cgw_stream_chunk,
+                prefetch_depth=recipe.cgw_prefetch_depth,
+            ))
+        else:
+            out["cw"] = np.asarray(B.cgw_catalog_delays(
+                batch, *[recipe.cgw_params[i] for i in range(8)],
+                pdist=(recipe.cgw_pdist
+                       if recipe.cgw_pdist is not None else 1.0),
+                pphase=recipe.cgw_pphase, psr_term=recipe.cgw_psr_term,
+                evolve=recipe.cgw_evolve,
+                phase_approx=recipe.cgw_phase_approx,
+                tref_s=recipe.cgw_tref_s, chunk=recipe.cgw_chunk,
+                backend=recipe.cgw_backend,
+            ))
+    if recipe.gwm_params is not None:
+        out["memory"] = np.asarray(
+            B.gw_memory_delays(batch, *recipe.gwm_params)
+        )
+    if recipe.burst_sky is not None:
+        out["burst"] = np.asarray(B.burst_delays(
+            batch, recipe.burst_sky[0], recipe.burst_sky[1],
+            recipe.burst_hplus, recipe.burst_hcross,
+            recipe.burst_grid[0], recipe.burst_grid[1],
+            psi=recipe.burst_sky[2],
+        ))
+    if recipe.transient_waveform is not None:
+        out["transient"] = np.asarray(B.transient_delays(
+            batch, recipe.transient_psr, recipe.transient_waveform,
+            recipe.transient_grid[0], recipe.transient_grid[1],
+        ))
+    return out
+
+
+_JITTED_REALIZATION = None
+
+
+def _jitted_realization():
+    """ONE module-held jit wrapper: a fresh ``jax.jit(...)`` per call
+    would own a fresh compile cache, recompiling every scenario even
+    inside a shape bucket."""
+    global _JITTED_REALIZATION
+    if _JITTED_REALIZATION is None:
+        import jax
+
+        from ..models.batched import realization_delays
+
+        _JITTED_REALIZATION = jax.jit(realization_delays)
+    return _JITTED_REALIZATION
+
+
+def batched_total(compiled: CompiledScenario) -> np.ndarray:
+    """The PRODUCTION engine's realization: jitted realization_delays
+    plus the eagerly precomputed static plane — exactly what
+    ``realize``/``sweep`` dispatch per key (minus the fit tail, which
+    has its own oracle-pinned tests)."""
+    static = np.asarray(compiled.static_delays())
+    d = np.asarray(_jitted_realization()(
+        compiled.realize_key(), compiled.batch, compiled.recipe
+    ))
+    return d + static
+
+
+# ------------------------------------------------------------- oracle side
+
+def _per_toa_np(param, batch) -> np.ndarray:
+    """Oracle-side per-backend expansion: scalar / (Np,) / (Np, NB)
+    parameter onto TOAs through the integer backend index — the numpy
+    mirror of the reference's string-flag expand_by_flags semantics."""
+    p = np.asarray(param, np.float64)
+    npsr, ntoa = np.asarray(batch.toas_s).shape
+    mask = np.asarray(batch.mask, np.float64)
+    if p.ndim == 0:
+        return np.full((npsr, ntoa), float(p)) * mask
+    if p.ndim == 1:
+        return p[:, None] * mask
+    idx = np.asarray(batch.backend_index)
+    return np.take_along_axis(p, idx, axis=1) * mask
+
+
+def oracle_family_delays(compiled: CompiledScenario) -> Dict[str, np.ndarray]:
+    """Each enabled family's delays from the ORACLE path: numpy f64
+    single-pulsar math out of models/white_noise.py / red_noise.py /
+    gwb.py / cgw.py / bursts.py, consuming the SAME ``jax.random``
+    stream the batched ops drew (replayed on host — threefry is
+    deterministic, so the bits are identical)."""
+    import jax
+
+    from ..models.cgw import antenna_pattern, cw_delay
+    from ..models.bursts import memory_ramp, polarization_rotation
+    from ..models.gwb import (
+        characteristic_strain,
+        gwb_grid,
+        gwb_time_series,
+        interp_to_toas,
+        residual_psd_coeff,
+    )
+    from ..models.red_noise import red_noise_delay
+    from ..models.white_noise import jitter_delay
+
+    batch, recipe = compiled.batch, compiled.recipe
+    dtype = batch.toas_s.dtype
+    key = compiled.realize_key()
+    k_wn, k_ec, k_rn, k_chrom, k_gwb = jax.random.split(key, 5)
+
+    toas = np.asarray(batch.toas_s, np.float64)
+    errors = np.asarray(batch.errors_s, np.float64)
+    mask = np.asarray(batch.mask, np.float64)
+    npsr, ntoa = toas.shape
+    out = {}
+
+    if recipe.efac is not None or recipe.log10_equad is not None:
+        # the batched op draws ONE combined-variance normal per TOA
+        # (STREAM_VERSION v3); the oracle mirror composes the same
+        # per-TOA sigma from the oracle-style per-backend expansion
+        eps = np.asarray(
+            jax.random.normal(k_wn, (npsr, ntoa), dtype), np.float64
+        )
+        efac_t = _per_toa_np(
+            recipe.efac if recipe.efac is not None else 1.0, batch
+        )
+        var = (efac_t * errors) ** 2
+        if recipe.log10_equad is not None:
+            equad_t = _per_toa_np(
+                10.0 ** np.asarray(recipe.log10_equad, np.float64), batch
+            )
+            if not recipe.tnequad:
+                equad_t = efac_t * equad_t
+            var = var + equad_t**2
+        out["white"] = np.sqrt(var) * eps * mask
+
+    if recipe.log10_ecorr is not None:
+        nep = np.asarray(batch.epoch_mask).shape[1]
+        eps = np.asarray(
+            jax.random.normal(k_ec, (npsr, nep), dtype), np.float64
+        )
+        ec = 10.0 ** np.asarray(recipe.log10_ecorr, np.float64)
+        epoch_mask = np.asarray(batch.epoch_mask, np.float64)
+        rows = []
+        for p in range(npsr):
+            if ec.ndim == 0:
+                per_epoch = np.full(nep, float(ec))
+            elif ec.ndim == 1:
+                per_epoch = np.full(nep, ec[p])
+            else:
+                per_epoch = ec[p][np.asarray(batch.epoch_backend_index)[p]]
+            per_epoch = per_epoch * epoch_mask[p]
+            rows.append(jitter_delay(
+                np.asarray(batch.epoch_index)[p], per_epoch, eps[p]
+            ))
+        out["ecorr"] = np.stack(rows) * mask
+
+    tspan = np.asarray(batch.tspan_s, np.float64)
+
+    def oracle_red(k, log10_amp, gamma, nmodes):
+        eps = np.asarray(
+            jax.random.normal(k, (npsr, 2 * nmodes), dtype), np.float64
+        )
+        amp = np.broadcast_to(np.asarray(log10_amp, np.float64), (npsr,))
+        gam = np.broadcast_to(np.asarray(gamma, np.float64), (npsr,))
+        return np.stack([
+            red_noise_delay(toas[p], amp[p], gam[p], eps[p],
+                            nmodes=nmodes, tspan_s=tspan[p])
+            for p in range(npsr)
+        ]) * mask
+
+    if recipe.rn_log10_amplitude is not None:
+        out["red"] = oracle_red(
+            k_rn, recipe.rn_log10_amplitude, recipe.rn_gamma,
+            recipe.rn_nmodes,
+        )
+
+    if recipe.chrom_log10_amplitude is not None:
+        achrom = oracle_red(
+            k_chrom, recipe.chrom_log10_amplitude, recipe.chrom_gamma,
+            recipe.chrom_nmodes,
+        )
+        freqs = np.asarray(batch.freqs_mhz, np.float64)
+        idx = float(np.asarray(
+            recipe.chrom_index if recipe.chrom_index is not None else 2.0
+        ))
+        scale = np.where(
+            freqs > 0.0,
+            (recipe.chrom_ref_freq_mhz
+             / np.where(freqs > 0.0, freqs, 1.0)) ** idx,
+            0.0,
+        )
+        out["chromatic"] = achrom * scale
+
+    if (recipe.gwb_log10_amplitude is not None
+            or recipe.gwb_user_spectrum is not None):
+        start, stop = float(batch.start_s), float(batch.stop_s)
+        ut, dt_grid, f = gwb_grid(start, stop, recipe.gwb_npts,
+                                  recipe.gwb_howml)
+        nf = len(f)
+        if recipe.orf_cholesky is None:
+            ncols = npsr
+            M = np.sqrt(2.0) * np.eye(npsr)
+        else:
+            M = np.asarray(recipe.orf_cholesky, np.float64)
+            ncols = M.shape[1]
+        w2 = np.asarray(
+            jax.random.normal(k_gwb, (2, ncols, nf), dtype), np.float64
+        )
+        w = w2[0] + 1j * w2[1]
+        hcf = characteristic_strain(
+            f,
+            (None if recipe.gwb_log10_amplitude is None
+             else float(np.asarray(recipe.gwb_log10_amplitude))),
+            (None if recipe.gwb_gamma is None
+             else float(np.asarray(recipe.gwb_gamma))),
+            turnover=recipe.gwb_turnover, f0=recipe.gwb_f0,
+            beta=recipe.gwb_beta, power=recipe.gwb_power,
+            user_spectrum=(
+                None if recipe.gwb_user_spectrum is None
+                else np.asarray(recipe.gwb_user_spectrum, np.float64)
+            ),
+            xp=np,
+        )
+        C = residual_psd_coeff(hcf, f, stop - start, recipe.gwb_howml,
+                               xp=np)
+        series = gwb_time_series(w, M, C, dt_grid, recipe.gwb_npts,
+                                 xp=np)
+        out["gwb"] = np.stack([
+            interp_to_toas(ut, series[p], toas[p]) for p in range(npsr)
+        ]) * mask
+
+    if recipe.cgw_params is not None:
+        params = [np.asarray(recipe.cgw_params[i], np.float64)
+                  for i in range(8)]
+        pdist = np.asarray(
+            recipe.cgw_pdist if recipe.cgw_pdist is not None else 1.0,
+            np.float64,
+        )
+        pphase = (None if recipe.cgw_pphase is None
+                  else np.asarray(recipe.cgw_pphase, np.float64))
+        phat = np.asarray(batch.phat, np.float64)
+        t_src = (float(batch.tref_mjd) * 86400.0 - recipe.cgw_tref_s
+                 + toas)
+        rows = []
+        for p in range(npsr):
+            pd = pdist[p] if pdist.ndim == 2 else pdist
+            pp = None
+            if pphase is not None:
+                pp = pphase[p] if pphase.ndim == 2 else pphase
+            res = cw_delay(
+                t_src[p], phat[p], *params, pdist=pd, pphase=pp,
+                psr_term=recipe.cgw_psr_term, evolve=recipe.cgw_evolve,
+                phase_approx=recipe.cgw_phase_approx, nan_to_zero=True,
+                xp=np,
+            )
+            rows.append(np.sum(np.atleast_2d(res), axis=0))
+        out["cw"] = np.stack(rows) * mask
+
+    if recipe.gwm_params is not None:
+        strain, gwtheta, gwphi, pol, t0_mjd = [
+            float(np.asarray(recipe.gwm_params[i])) for i in range(5)
+        ]
+        t0_s = (t0_mjd - float(batch.tref_mjd)) * 86400.0
+        rows = []
+        for p in range(npsr):
+            fplus, fcross, _ = antenna_pattern(gwtheta, gwphi, phat_np(
+                batch, p))
+            pol_amp = np.cos(2.0 * pol) * fplus + np.sin(2.0 * pol) * fcross
+            rows.append(memory_ramp(toas[p], t0_s, pol_amp, strain))
+        out["memory"] = np.stack(rows) * mask
+
+    if recipe.burst_sky is not None:
+        gwtheta, gwphi, psi = [
+            float(np.asarray(recipe.burst_sky[i])) for i in range(3)
+        ]
+        g0, g1 = [float(np.asarray(recipe.burst_grid[i]))
+                  for i in range(2)]
+        hp = np.asarray(recipe.burst_hplus, np.float64)
+        hc = np.asarray(recipe.burst_hcross, np.float64)
+        tg = np.linspace(g0, g1, hp.shape[0])
+        rows = []
+        for p in range(npsr):
+            hpt = np.interp(toas[p], tg, hp)
+            hct = np.interp(toas[p], tg, hc)
+            inside = (toas[p] >= g0) & (toas[p] <= g1)
+            hpt, hct = hpt * inside, hct * inside
+            rp, rc = polarization_rotation(hpt, hct, psi)
+            fplus, fcross, _ = antenna_pattern(gwtheta, gwphi,
+                                               phat_np(batch, p))
+            rows.append(-fplus * rp - fcross * rc)
+        out["burst"] = np.stack(rows) * mask
+
+    if recipe.transient_waveform is not None:
+        g0, g1 = [float(np.asarray(recipe.transient_grid[i]))
+                  for i in range(2)]
+        wf = np.asarray(recipe.transient_waveform, np.float64)
+        tg = np.linspace(g0, g1, wf.shape[0])
+        p = recipe.transient_psr
+        row = np.interp(toas[p], tg, wf)
+        row = row * ((toas[p] >= g0) & (toas[p] <= g1)) * mask[p]
+        block = np.zeros_like(toas)
+        block[p] = row
+        out["transient"] = block
+    return out
+
+
+def phat_np(batch, p: int) -> np.ndarray:
+    return np.asarray(batch.phat, np.float64)[p]
+
+
+# ------------------------------------------------------------ differential
+
+@dataclass
+class DiffResult:
+    """One scenario's differential verdicts."""
+
+    spec: ScenarioSpec
+    spec_hash: str
+    families: Tuple[str, ...]
+    #: family -> {"rel": float, "tol": float, "ok": bool}
+    verdicts: Dict[str, dict] = field(default_factory=dict)
+    agree: bool = True
+    worst_family: Optional[str] = None
+    worst_rel: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "spec_hash": self.spec_hash,
+            "families": list(self.families),
+            "verdicts": self.verdicts,
+            "agree": self.agree,
+            "worst_family": self.worst_family,
+            "worst_rel": self.worst_rel,
+        }
+
+
+def run_scenario(compiled: CompiledScenario,
+                 perturb: Optional[dict] = None) -> DiffResult:
+    """Run one compiled scenario through the full differential.
+
+    ``perturb`` plants a controlled defect into the batched side —
+    ``{"family": "ecorr", "scale": 1.01}`` multiplies that family's
+    batched delays (and the engine total, consistently) before
+    comparison. The planted-bug arm of the fuzz bench uses this to
+    prove end to end that a real disagreement is detected, shrunk to a
+    minimal spec, and written replayable; it exists ONLY for that
+    self-test and never runs unless requested."""
+    from ..obs import counter, names, span
+
+    res = DiffResult(
+        spec=compiled.spec, spec_hash=compiled.spec_hash,
+        families=compiled.families,
+    )
+    with span(names.SPAN_SCENARIO_FUZZ_CASE,
+              spec_hash=compiled.spec_hash):
+        dev = batched_family_delays(compiled)
+        oracle = oracle_family_delays(compiled)
+        total_dev = batched_total(compiled)
+        if perturb:
+            fam = perturb["family"]
+            scale = float(perturb.get("scale", 1.01))
+            if fam in dev:
+                delta = (scale - 1.0) * dev[fam]
+                dev[fam] = dev[fam] + delta
+                total_dev = total_dev + delta
+
+        missing = set(dev) ^ set(oracle)
+        if missing:  # a family one side skipped is itself a bug
+            for fam in missing:
+                res.verdicts[fam] = {
+                    "rel": float("inf"), "tol": 0.0, "ok": False,
+                    "note": "family present on only one side",
+                }
+            res.agree = False
+        for fam in sorted(set(dev) & set(oracle)):
+            rel = _rel(dev[fam], oracle[fam])
+            tol = FAMILY_TOLERANCES[fam]
+            ok = rel <= tol
+            res.verdicts[fam] = {"rel": rel, "tol": tol, "ok": ok}
+            if rel > res.worst_rel:
+                res.worst_rel, res.worst_family = rel, fam
+            res.agree = res.agree and ok
+
+        # the engine total: jit-fused production realization vs the
+        # summed oracle (catches cross-family assembly bugs the
+        # per-family comparisons cannot)
+        total_oracle = np.zeros_like(np.asarray(total_dev, np.float64))
+        for fam in oracle:
+            total_oracle = total_oracle + oracle[fam]
+        rel = _rel(total_dev, total_oracle)
+        tol = FAMILY_TOLERANCES["total"]
+        ok = rel <= tol
+        res.verdicts["total"] = {"rel": rel, "tol": tol, "ok": ok}
+        if rel > res.worst_rel:
+            res.worst_rel, res.worst_family = rel, "total"
+        res.agree = res.agree and ok
+        counter(names.SCENARIO_FUZZ_CASES).inc()
+        if not res.agree:
+            counter(names.SCENARIO_FUZZ_DISAGREEMENTS).inc()
+    return res
+
+
+def check_sweep_identity(compiled: CompiledScenario, tmpdir: str) -> dict:
+    """Pipelined-vs-sync byte identity over THIS scenario: the same
+    compiled workload through utils.sweep at depth 1 and depth 2 must
+    return identical cubes and consolidate identical checkpoint bytes."""
+    import hashlib
+
+    from ..utils.sweep import sweep
+
+    plan = compiled.plan
+    results, digests = [], []
+    for depth in (1, 2):
+        path = os.path.join(tmpdir, f"ck_depth{depth}.npz")
+        out = sweep(
+            compiled.realize_key(), compiled.batch, compiled.recipe,
+            nreal=plan.nreal, checkpoint_path=path, chunk=plan.chunk,
+            reduce_fn=None, fit=plan.fit, pipeline_depth=depth,
+            provenance=compiled.provenance(),
+        )
+        results.append(np.asarray(out))
+        with open(path, "rb") as fh:
+            digests.append(hashlib.sha256(fh.read()).hexdigest())
+    return {
+        "bit_identical": bool(np.array_equal(results[0], results[1])),
+        "checkpoint_identical": digests[0] == digests[1],
+        "sha256": digests[0],
+    }
+
+
+# --------------------------------------------------------------- generator
+
+#: compile-cache-friendly shape buckets: the jitted engine re-lowers per
+#: (shapes, static fields), so the generator draws from a few buckets
+#: instead of a continuum (the scenario space stays rich through the
+#: CONTENT, not the array dims)
+SHAPE_BUCKETS = ((2, 64, 1), (3, 96, 2), (4, 128, 2))
+
+
+def sample_spec(root_seed: int, index: int) -> ScenarioSpec:
+    """Scenario ``index`` of the constrained random generator.
+
+    Seed discipline: the scenario's identity comes from
+    ``fold_in(PRNGKey(root_seed), index)`` — its bits become
+    ``spec.seed``, so scenario K's compile-time draws are independent
+    of every other scenario and of K's position in the run."""
+    import jax
+
+    from .compile import family_rng
+
+    bits = np.asarray(jax.random.key_data(
+        jax.random.fold_in(jax.random.PRNGKey(root_seed), index)
+    )).astype(np.uint64)
+    spec_seed = int(bits[-1] & np.uint64(0x7FFFFFFF))
+    # structural choices draw from a generator-owned stream (family -1
+    # would collide with compile's own streams; use the raw bits)
+    rng = np.random.default_rng(int(bits[0] << np.uint64(16)) + index)
+
+    npsr, ntoa, nbackend = SHAPE_BUCKETS[
+        int(rng.integers(len(SHAPE_BUCKETS)))
+    ]
+    d: dict = {
+        "name": f"fuzz-{root_seed}-{index}",
+        "seed": spec_seed,
+        "array": {"npsr": npsr, "ntoa": ntoa, "nbackend": nbackend,
+                  "span_days": 2000.0},
+    }
+
+    def maybe(p):
+        return rng.uniform() < p
+
+    def val(lo, hi, p_dist=0.5, log=False):
+        """A spec leaf: sometimes a concrete scalar, sometimes a
+        distribution object (exercises the compiler's draw machinery)."""
+        if maybe(p_dist):
+            return {"dist": "loguniform" if log else "uniform",
+                    "lo": lo, "hi": hi}
+        if log:
+            return float(10.0 ** rng.uniform(np.log10(lo), np.log10(hi)))
+        return float(rng.uniform(lo, hi))
+
+    if maybe(0.75):
+        w = {}
+        if maybe(0.85):
+            w["efac"] = val(0.8, 1.5)
+        if maybe(0.7):
+            w["log10_equad"] = val(-7.5, -6.0)
+        if not w:
+            w["efac"] = 1.1
+        if maybe(0.5):
+            w["per_backend"] = True
+        if maybe(0.3):
+            w["tnequad"] = True
+        d["white"] = w
+    if maybe(0.5):
+        d["ecorr"] = {"log10_ecorr": val(-7.5, -6.3),
+                      **({"per_backend": True} if maybe(0.5) else {})}
+    if maybe(0.55):
+        d["red"] = {"log10_amplitude": val(-14.5, -13.0),
+                    "gamma": val(2.0, 5.0),
+                    "nmodes": int(rng.choice([4, 8]))}
+    if maybe(0.35):
+        d["chromatic"] = {"log10_amplitude": val(-14.5, -13.5),
+                          "gamma": val(1.0, 4.0),
+                          "index": float(rng.choice([2.0, 4.0])),
+                          "nmodes": 4}
+    orf = ["hd", "none", {"lmax": 1, "clm": [float(np.sqrt(4 * np.pi)),
+                                             0.3, -0.2, 0.1]}][
+        int(rng.integers(3))
+    ]
+    if maybe(0.25):
+        d["population"] = {
+            "n_binaries": int(rng.choice([100, 300])),
+            "outlier_per_bin": int(rng.integers(0, 3)),
+            "nbins": 4, "npts": 64, "howml": 4.0, "orf": orf,
+        }
+    else:
+        if maybe(0.55):
+            g = {"log10_amplitude": val(-14.8, -13.8),
+                 "gamma": val(3.0, 5.0), "npts": 64, "howml": 4.0,
+                 "orf": orf}
+            if maybe(0.3):
+                g["turnover"] = {"f0": val(5e-10, 5e-9, log=True),
+                                 "beta": 1.0, "power": 1.0}
+            d["gwb"] = g
+        if maybe(0.45):
+            c = {"nsrc": int(rng.integers(1, 4))}
+            if maybe(0.4):
+                c["pdist_kpc"] = val(0.5, 3.0)
+            if maybe(0.3):
+                c["psr_term"] = False
+            if maybe(0.25):
+                c["evolve"] = False
+            if maybe(0.25):
+                c["stream_chunk"] = 2
+            d["cw"] = c
+    if maybe(0.3):
+        d["burst"] = {"log10_amp": val(-8.0, -6.0),
+                      "t0_frac": val(0.2, 0.8),
+                      "width_frac": val(0.02, 0.1), "ngrid": 128}
+    if maybe(0.3):
+        d["memory"] = {"log10_strain": val(-14.0, -12.0),
+                       "t0_frac": val(0.2, 0.8)}
+    if maybe(0.4):
+        d["transient"] = {
+            "psr": int(rng.integers(npsr)),
+            "kind": "glitch" if maybe(0.5) else "gaussian",
+            "log10_amp": val(-7.5, -6.0),
+            "t0_frac": val(0.2, 0.8), "width_frac": val(0.02, 0.1),
+            "ngrid": 128,
+        }
+    if not any(k in d for k in
+               ("white", "ecorr", "red", "chromatic", "gwb",
+                "population", "cw", "burst", "memory", "transient")):
+        d["white"] = {"efac": 1.1}
+    if maybe(0.4):
+        d["sweep"] = {"nreal": 4, "chunk": 2,
+                      "pipeline_depth": 2}
+    return ScenarioSpec.from_dict(d).validate()
+
+
+# ---------------------------------------------------------------- shrinker
+
+def _shrink_candidates(d: dict) -> List[dict]:
+    """Ordered simplification candidates for one spec dict: drop whole
+    sections first (biggest steps), then shrink sizes, then simplify
+    within sections. Every candidate is a fresh dict."""
+    out = []
+    droppable = ("population", "cw", "gwb", "chromatic", "red", "ecorr",
+                 "white", "burst", "memory", "transient", "sweep")
+    present = [s for s in droppable if s in d]
+    for sec in present:
+        if sec != "sweep" and len([
+            s for s in present if s != "sweep"
+        ]) <= 1:
+            continue  # keep at least one signal family (spec validity)
+        c = {k: v for k, v in d.items() if k != sec}
+        out.append(c)
+    arr = d.get("array", {})
+    for key, floor in (("npsr", 2), ("ntoa", 32), ("nbackend", 1)):
+        cur = arr.get(key)
+        if isinstance(cur, int) and cur > floor:
+            c = json.loads(json.dumps(d))
+            c["array"][key] = max(floor, cur // 2)
+            out.append(c)
+    for sec, key, simple in (
+        ("white", "per_backend", False),
+        ("ecorr", "per_backend", False),
+        ("red", "nmodes", 2),
+        ("chromatic", "nmodes", 2),
+        ("cw", "nsrc", 1),
+        ("population", "n_binaries", 50),
+        ("population", "outlier_per_bin", 1),
+    ):
+        if sec in d and d[sec].get(key) not in (None, simple):
+            c = json.loads(json.dumps(d))
+            c[sec][key] = simple
+            out.append(c)
+    for sec, key in (("gwb", "turnover"), ("cw", "stream_chunk"),
+                     ("cw", "pdist_kpc")):
+        if sec in d and key in d[sec]:
+            c = json.loads(json.dumps(d))
+            del c[sec][key]
+            out.append(c)
+    for sec in ("gwb", "population"):
+        if sec in d and d[sec].get("orf", "hd") != "none":
+            c = json.loads(json.dumps(d))
+            c[sec]["orf"] = "none"
+            out.append(c)
+    return out
+
+
+def shrink(spec: ScenarioSpec, fails: Callable[[ScenarioSpec], bool],
+           max_steps: int = 200) -> Tuple[ScenarioSpec, int]:
+    """Greedy shrink: repeatedly accept the first candidate
+    simplification that still fails, until none does (or the step
+    budget runs out). Returns (minimal failing spec, candidates
+    evaluated). Family draws are fold_in-indexed, so dropping one
+    section leaves every other section's stream bit-identical — the
+    disagreement cannot dodge the shrinker by changing draws."""
+    from ..obs import counter, names
+
+    current = spec.to_dict()
+    steps = 0
+    progress = True
+    while progress and steps < max_steps:
+        progress = False
+        for cand in _shrink_candidates(current):
+            try:
+                cspec = ScenarioSpec.from_dict(cand).validate()
+            except Exception:
+                continue
+            steps += 1
+            counter(names.SCENARIO_SHRINK_STEPS).inc()
+            if steps >= max_steps:
+                break
+            try:
+                if fails(cspec):
+                    current = cspec.to_dict()
+                    progress = True
+                    break
+            except Exception:
+                # a candidate that CRASHES still reproduces a defect;
+                # treat as failing so the shrinker can chase crashes too
+                current = cspec.to_dict()
+                progress = True
+                break
+    return ScenarioSpec.from_dict(current), steps
+
+
+# -------------------------------------------------------------- fuzz driver
+
+def fuzz(
+    n: int,
+    root_seed: int = 0,
+    out_dir: Optional[str] = None,
+    sweep_every: int = 0,
+    perturb: Optional[dict] = None,
+    progress: Optional[Callable[[int, int], None]] = None,
+) -> dict:
+    """Run ``n`` generated scenarios through the differential; shrink
+    and persist every failure. Returns the report dict the bench embeds:
+    agreement stats, the per-family worst deviations, the coverage
+    histogram over signal-family combinations, and scenarios/s.
+
+    ``sweep_every=k`` also runs the pipelined-vs-sync sweep
+    byte-identity arm on every k-th scenario that carries a sweep plan.
+    ``perturb`` plants a defect (see :func:`run_scenario`) — the
+    planted-bug self-test arm."""
+    import tempfile
+
+    t0 = time.monotonic()
+    coverage: Dict[str, int] = {}
+    combos: Dict[str, int] = {}
+    max_rel_by_family: Dict[str, float] = {}
+    failures: List[dict] = []
+    sweep_checks: List[dict] = []
+    n_agree = 0
+
+    for i in range(n):
+        spec = sample_spec(root_seed, i)
+        compiled = compile_spec(spec, validate=False)
+        res = run_scenario(compiled, perturb=perturb)
+        for fam in compiled.families:
+            coverage[fam] = coverage.get(fam, 0) + 1
+        combo = "+".join(sorted(compiled.families)) or "(none)"
+        combos[combo] = combos.get(combo, 0) + 1
+        for fam, v in res.verdicts.items():
+            if np.isfinite(v["rel"]):
+                max_rel_by_family[fam] = max(
+                    max_rel_by_family.get(fam, 0.0), v["rel"]
+                )
+        if res.agree:
+            n_agree += 1
+        else:
+            def _fails(s: ScenarioSpec, _p=perturb) -> bool:
+                c = compile_spec(s, validate=False)
+                return not run_scenario(c, perturb=_p).agree
+
+            minimal, steps = shrink(spec, _fails)
+            entry = {
+                "index": i,
+                "spec_hash": spec.content_hash,
+                "worst_family": res.worst_family,
+                "worst_rel": res.worst_rel,
+                "minimal_spec_hash": minimal.content_hash,
+                "minimal_families": list(spec_families(minimal)),
+                "shrink_steps": steps,
+            }
+            if out_dir:
+                os.makedirs(out_dir, exist_ok=True)
+                path = os.path.join(
+                    out_dir, f"failing_{spec.content_hash}.json"
+                )
+                minimal.save(path)
+                entry["replay_file"] = path
+            failures.append(entry)
+        if (sweep_every and spec.sweep is not None
+                and i % sweep_every == 0):
+            with tempfile.TemporaryDirectory() as td:
+                chk = check_sweep_identity(compiled, td)
+            chk["index"] = i
+            sweep_checks.append(chk)
+        if progress is not None:
+            progress(i + 1, n)
+
+    elapsed = time.monotonic() - t0
+    return {
+        "n_scenarios": n,
+        "root_seed": root_seed,
+        "elapsed_s": round(elapsed, 3),
+        "scenarios_per_s": round(n / max(elapsed, 1e-9), 3),
+        "agreement_rate": n_agree / max(n, 1),
+        "n_disagreements": len(failures),
+        "max_rel_disagreement": max(max_rel_by_family.values(),
+                                    default=0.0),
+        "max_rel_by_family": {k: float(v) for k, v in
+                              sorted(max_rel_by_family.items())},
+        "tolerances": dict(FAMILY_TOLERANCES),
+        "coverage": dict(sorted(coverage.items())),
+        "combo_histogram_size": len(combos),
+        "failures": failures,
+        "sweep_identity": {
+            "checked": len(sweep_checks),
+            "all_bit_identical": all(
+                c["bit_identical"] and c["checkpoint_identical"]
+                for c in sweep_checks
+            ) if sweep_checks else None,
+        },
+    }
+
+
+def replay(path: str) -> DiffResult:
+    """Re-run one saved (typically shrunk) spec through the
+    differential — the debugging loop for a fuzz failure."""
+    from .spec import load_spec
+
+    spec = load_spec(path)
+    return run_scenario(compile_spec(spec, validate=False))

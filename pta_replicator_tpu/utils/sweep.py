@@ -543,8 +543,18 @@ def sweep(
     shard_checkpoint: Optional[bool] = None,
     chunk_retries: int = 2,
     retry_policy=None,
+    provenance: Optional[dict] = None,
 ) -> np.ndarray:
     """Run ``nreal`` realizations in resumable chunks.
+
+    ``provenance`` is an optional JSON-serializable stamp recorded in
+    the checkpoint sidecar alongside the sweep fingerprint — the
+    scenario layer passes ``{"spec_name", "spec_hash",
+    "scenario_version"}`` (scenarios.compile.CompiledScenario.
+    provenance) so a bank on disk names the spec that produced it. It
+    participates in the resume fingerprint: resuming with a different
+    stamp (a different spec hash) raises instead of silently mixing
+    scenario content.
 
     Returns the stacked reduced results, shape (nreal, ...). A rerun with
     the same arguments resumes after the last completed chunk; a finished
@@ -627,6 +637,7 @@ def sweep(
                     progress=progress, pipeline_depth=pipeline_depth,
                     drain_timeout_s=drain_timeout_s, durable=durable,
                     shard_checkpoint=shard_checkpoint,
+                    provenance=provenance,
                 )
             except BaseException as exc:  # noqa: BLE001 — classified, then re-raised
                 if chunk_retries <= 0 or not is_transient(exc):
@@ -664,6 +675,7 @@ def _sweep_impl(
     drain_timeout_s: Optional[float],
     durable: bool,
     shard_checkpoint: Optional[bool],
+    provenance: Optional[dict] = None,
 ) -> np.ndarray:
     import jax
 
@@ -700,6 +712,12 @@ def _sweep_impl(
         # Same-topology resume is bit-identical; cross-topology resume is
         # equal up to float reduction order in partitioned contractions.
     }
+    if provenance is not None:
+        # scenario-layer stamp (spec name/hash); part of the resume
+        # fingerprint, so a checkpoint cannot silently continue under a
+        # different spec. Old sidecars (no stamp) stay resumable by
+        # sweeps that pass no stamp.
+        meta["provenance"] = dict(provenance)
     meta_path = checkpoint_path + ".meta.json"
     done = 0
     if os.path.exists(meta_path):

@@ -29,6 +29,14 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_series.py tests/test_timeline_serve.py \
     tests/test_analysis.py tests/test_pipeline.py tests/test_faults.py
 
+echo "== scenario fuzz (fast arm: batched vs oracle differential) =="
+# 8 generated scenarios at a fixed seed through the batched-vs-oracle
+# differential (scenarios/fuzz.py), incl. the pipelined-vs-sync sweep
+# byte-identity arm on every 4th — exit 1 on any disagreement.
+# Seconds-scale, fixture-free, CPU-only (docs/scenarios.md).
+JAX_PLATFORMS=cpu python -m pta_replicator_tpu scenario fuzz --fast \
+    > /dev/null
+
 echo "== chaos smoke (seeded faults, byte-identity gate) =="
 # the fast arm of benchmarks/chaos_sweep.py: one seeded schedule
 # (transient failure + DrainTimeout stall + torn checkpoint write)

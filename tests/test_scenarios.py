@@ -1,0 +1,481 @@
+"""Scenario layer (pta_replicator_tpu.scenarios): spec validation,
+compiler determinism and seed discipline, the batched-vs-oracle
+differential, the shrinker, the sweep provenance stamp, the Recipe
+early-validation satellite, the scenario lint rule, and the CLI.
+
+CPU-only and fixture-free (everything runs on synthetic batches).
+"""
+import json
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.scenarios import (
+    ScenarioSpec,
+    SpecError,
+    compile_spec,
+    flagship_workload,
+    load_spec,
+    spec_families,
+)
+from pta_replicator_tpu.scenarios import fuzz as fz
+
+BASE = {
+    "name": "t", "seed": 3,
+    "array": {"npsr": 3, "ntoa": 64, "nbackend": 2, "span_days": 2000.0},
+    "white": {"efac": 1.1, "per_backend": True},
+    "red": {"log10_amplitude": -14.0, "gamma": 3.0, "nmodes": 4},
+}
+
+
+def mkspec(**over):
+    d = {**{k: (dict(v) if isinstance(v, dict) else v)
+            for k, v in BASE.items()}, **over}
+    return ScenarioSpec.from_dict(d)
+
+
+# -------------------------------------------------------- spec validation
+
+def test_spec_rejects_unknown_key_naming_field():
+    with pytest.raises(SpecError, match="array.*unknown key.*npulsars"):
+        mkspec(array={"npulsars": 3}).validate()
+    with pytest.raises(SpecError, match="unknown top-level"):
+        ScenarioSpec.from_dict({"array": {}, "whtie": {}})
+
+
+def test_spec_rejects_bad_distribution_grammar():
+    with pytest.raises(SpecError, match="white.efac.dist"):
+        mkspec(white={"efac": {"dist": "zipf", "lo": 1}}).validate()
+    with pytest.raises(SpecError, match="lo must be <= hi"):
+        mkspec(white={"efac": {"dist": "uniform", "lo": 2.0,
+                               "hi": 1.0}}).validate()
+    with pytest.raises(SpecError, match="needs 'sd'"):
+        mkspec(white={"efac": {"dist": "normal", "mean": 1.0}}).validate()
+
+
+def test_spec_rejects_inconsistent_sections():
+    with pytest.raises(SpecError, match="population and gwb"):
+        mkspec(
+            gwb={"log10_amplitude": -14.0, "gamma": 4.0},
+            population={"n_binaries": 10},
+        ).validate()
+    with pytest.raises(SpecError, match="transient.psr.*out of range"):
+        mkspec(transient={"psr": 7, "log10_amp": -7.0}).validate()
+    with pytest.raises(SpecError, match="nreal.*multiple"):
+        mkspec(sweep={"nreal": 5, "chunk": 2}).validate()
+    with pytest.raises(SpecError, match="no signal family"):
+        ScenarioSpec.from_dict({"array": {"npsr": 2}}).validate()
+
+
+def test_spec_version_and_preset_guards():
+    with pytest.raises(SpecError, match="newer than this reader"):
+        mkspec(scenario_version=99).validate()
+    with pytest.raises(SpecError, match="preset.*must not also carry"):
+        ScenarioSpec.from_dict({
+            "preset": "bench_flagship", "white": {"efac": 1.0},
+        }).validate()
+    with pytest.raises(SpecError, match="preset must be one of"):
+        ScenarioSpec.from_dict({"preset": "nope"}).validate()
+
+
+# ---------------------------------------------- round-trip + determinism
+
+def test_spec_roundtrip_identical_hash_and_compile(tmp_path):
+    spec = mkspec(
+        gwb={"log10_amplitude": {"dist": "uniform", "lo": -14.5,
+                                 "hi": -14.0},
+             "gamma": 4.33, "npts": 64, "howml": 4.0, "orf": "none"},
+    ).validate()
+    path = str(tmp_path / "s.json")
+    spec.save(path)
+    back = load_spec(path)
+    assert back.content_hash == spec.content_hash
+    c1, c2 = compile_spec(spec), compile_spec(back)
+    assert c1.spec_hash == c2.spec_hash
+    for f, v in vars(c1.recipe).items():
+        v2 = getattr(c2.recipe, f)
+        if v is not None and hasattr(v, "shape"):
+            np.testing.assert_array_equal(np.asarray(v), np.asarray(v2))
+    np.testing.assert_array_equal(
+        np.asarray(c1.batch.toas_s), np.asarray(c2.batch.toas_s)
+    )
+
+
+def test_compile_deterministic_across_process_restarts(tmp_path):
+    """The same spec must compile to byte-identical draws in a FRESH
+    process (the committed-spec stability contract)."""
+    spec = mkspec(seed=17)
+    path = str(tmp_path / "s.json")
+    spec.validate().save(path)
+    prog = (
+        "import json,hashlib,numpy as np;"
+        "from pta_replicator_tpu.scenarios import load_spec, compile_spec;"
+        f"c = compile_spec(load_spec({path!r}));"
+        "h = hashlib.sha256();"
+        "[h.update(np.ascontiguousarray(np.asarray(v)).tobytes())"
+        " for f, v in sorted(vars(c.recipe).items())"
+        " if v is not None and hasattr(v, 'shape')];"
+        "print(h.hexdigest())"
+    )
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", prog], capture_output=True,
+            text=True, check=True,
+            env={**__import__('os').environ, "JAX_PLATFORMS": "cpu"},
+        ).stdout.strip()
+        for _ in range(2)
+    }
+    assert len(outs) == 1, outs
+
+
+def test_fold_in_family_independence():
+    """Dropping one section must leave every other family's compiled
+    draws bit-identical — the property the shrinker stands on."""
+    with_burst = mkspec(burst={"log10_amp": -7.0}).validate()
+    without = mkspec().validate()
+    c1, c2 = compile_spec(with_burst), compile_spec(without)
+    for f in ("efac", "rn_log10_amplitude", "rn_gamma"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(c1.recipe, f)),
+            np.asarray(getattr(c2.recipe, f)),
+        )
+    np.testing.assert_array_equal(
+        np.asarray(c1.batch.toas_s), np.asarray(c2.batch.toas_s)
+    )
+
+
+def test_flagship_spec_matches_bench_fingerprint():
+    """The committed flagship spec and bench.build_workload are the same
+    workload: equal content fingerprints (the /tmp/workload.npz cache
+    contract) at a reduced size, through both entry points."""
+    import bench
+
+    small = dict(npsr=4, ntoa=128, nbackend=2, ncw=3)
+    _, _, fp_shim = bench.build_workload(**small, with_fingerprint=True)
+    _, _, fp_direct = flagship_workload(**small, with_fingerprint=True)
+    assert fp_shim == fp_direct
+    spec = ScenarioSpec.from_dict({
+        "name": "flagship", "preset": "bench_flagship",
+        "preset_params": small,
+    }).validate()
+    assert compile_spec(spec).fingerprint == fp_direct
+
+
+# -------------------------------------------------- differential + shrink
+
+def test_differential_agrees_on_mixed_scenario():
+    spec = mkspec(
+        ecorr={"log10_ecorr": -6.8},
+        gwb={"log10_amplitude": -14.3, "gamma": 4.33, "npts": 64,
+             "howml": 4.0, "orf": "hd"},
+        cw={"nsrc": 2},
+        memory={"log10_strain": -13.0},
+        transient={"psr": 1, "kind": "glitch", "log10_amp": -6.5},
+    ).validate()
+    res = fz.run_scenario(compile_spec(spec))
+    assert res.agree, res.to_dict()
+    assert set(res.verdicts) == {
+        "white", "ecorr", "red", "gwb", "cw", "memory", "transient",
+        "total",
+    }
+    for fam, v in res.verdicts.items():
+        assert v["rel"] <= v["tol"], (fam, v)
+
+
+def test_planted_disagreement_detected_and_shrunk(tmp_path):
+    spec = mkspec(
+        ecorr={"log10_ecorr": -6.8},
+        burst={"log10_amp": -7.0},
+    ).validate()
+    perturb = {"family": "ecorr", "scale": 1.01}
+    res = fz.run_scenario(compile_spec(spec), perturb=perturb)
+    assert not res.agree
+    assert res.worst_family == "ecorr"
+
+    def fails(s):
+        return not fz.run_scenario(compile_spec(s, validate=False),
+                                   perturb=perturb).agree
+
+    minimal, steps = fz.shrink(spec, fails)
+    assert steps > 0
+    assert spec_families(minimal) == ("ecorr",)
+    # replayable, and innocent without the planted defect
+    path = str(tmp_path / "min.json")
+    minimal.save(path)
+    assert fz.run_scenario(compile_spec(load_spec(path))).agree
+
+
+def test_generator_deterministic_and_positionally_independent():
+    a = fz.sample_spec(9, 4)
+    b = fz.sample_spec(9, 4)
+    assert a.to_dict() == b.to_dict()
+    assert a.content_hash == fz.sample_spec(9, 4).content_hash
+    # scenario 4 is the same spec no matter how many others ran
+    assert fz.sample_spec(9, 5).content_hash != a.content_hash
+
+
+# ------------------------------------------------------ sweep provenance
+
+def test_sweep_provenance_stamped_and_fingerprinted(tmp_path):
+    from pta_replicator_tpu.utils.sweep import sweep
+
+    spec = mkspec(sweep={"nreal": 4, "chunk": 2}).validate()
+    c = compile_spec(spec)
+    ck = str(tmp_path / "ck.npz")
+    out = sweep(c.realize_key(), c.batch, c.recipe, nreal=4,
+                checkpoint_path=ck, chunk=2, reduce_fn=None,
+                provenance=c.provenance())
+    meta = json.load(open(ck + ".meta.json"))
+    assert meta["provenance"]["spec_hash"] == c.spec_hash
+    assert meta["provenance"]["spec_name"] == "t"
+    # resume with the same stamp: instant, identical
+    again = sweep(c.realize_key(), c.batch, c.recipe, nreal=4,
+                  checkpoint_path=ck, chunk=2, reduce_fn=None,
+                  provenance=c.provenance())
+    np.testing.assert_array_equal(out, again)
+    # a different stamp must refuse to resume
+    with pytest.raises(ValueError, match="different sweep"):
+        sweep(c.realize_key(), c.batch, c.recipe, nreal=4,
+              checkpoint_path=ck, chunk=2, reduce_fn=None,
+              provenance={"spec_name": "other", "spec_hash": "beef",
+                          "scenario_version": 1})
+
+
+# ------------------------------------- Recipe early-validation satellite
+
+@pytest.mark.parametrize("kwargs,frag", [
+    (dict(burst_sky=jnp.zeros(3)), "burst needs all of"),
+    (dict(burst_hplus=jnp.zeros(8)), "burst needs all of"),
+    (dict(transient_waveform=jnp.zeros(16)), "travel together"),
+    (dict(transient_grid=jnp.zeros(2)), "travel together"),
+    (dict(cgw_pdist=jnp.ones(3)), "set cgw_params too"),
+    (dict(cgw_pphase=jnp.ones(3)), "set cgw_params too"),
+    (dict(rn_log10_amplitude=jnp.asarray(-14.0)), "rn_gamma"),
+    (dict(chrom_log10_amplitude=jnp.asarray(-14.0)), "chrom_gamma"),
+    (dict(gwb_log10_amplitude=jnp.asarray(-14.0)), "gwb_gamma"),
+    (dict(cgw_params=jnp.zeros((3, 8))), "(8, Ns)"),
+    (dict(cgw_params=jnp.zeros((8, 3)), cgw_pdist=jnp.ones((2, 4))),
+     "3 source"),
+    (dict(cgw_params=jnp.zeros((8, 3)), cgw_pphase=jnp.ones(4)),
+     "3 source"),
+    (dict(gwm_params=jnp.zeros(4)), "gwm_params"),
+    (dict(burst_sky=jnp.zeros(4), burst_hplus=jnp.zeros(8),
+          burst_hcross=jnp.zeros(8), burst_grid=jnp.zeros(2)),
+     "burst_sky"),
+])
+def test_recipe_rejects_inconsistent_combo(kwargs, frag):
+    with pytest.raises(ValueError, match="Recipe"):
+        try:
+            Recipe(**kwargs)
+        except ValueError as exc:
+            assert frag in str(exc), str(exc)
+            raise
+
+
+def test_recipe_validation_survives_pytree_roundtrips():
+    import jax
+
+    r = Recipe(efac=jnp.ones(3), cgw_params=jnp.zeros((8, 2)),
+               cgw_pdist=jnp.ones(2))
+    # unflatten with placeholder leaves (structure probes) must not raise
+    jax.tree_util.tree_map(lambda _: 0, r)
+    # unflatten with tracers (jit) runs the shape checks and passes
+    out = jax.jit(lambda rr: rr.efac * 2)(r)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    # valid user-spectrum-only GWB stays constructible
+    Recipe(gwb_user_spectrum=jnp.ones((5, 2)))
+
+
+# ----------------------------------------------------- scenario lint rule
+
+def _lint_scenarios(tmp_path, body):
+    import textwrap as tw
+
+    from pta_replicator_tpu.analysis import engine as eng
+    from pta_replicator_tpu.analysis import rules_scenarios
+
+    rel = "pta_replicator_tpu/scenarios/zz_fixture.py"
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(tw.dedent(body))
+    mods, problems = eng.parse_modules([str(path)], str(tmp_path))
+    active, suppressed = eng.run_rules(mods, rules_scenarios.RULES)
+    return problems + active
+
+
+def test_scenario_split_chain_fires_on_sequential_split(tmp_path):
+    findings = _lint_scenarios(tmp_path, """
+        import jax
+
+        def chain(key, n):
+            key, sub = jax.random.split(key)
+            return sub
+    """)
+    assert [f.rule for f in findings] == ["scenario-split-chain"]
+    assert "rebinds its own key operand" in findings[0].message
+
+
+def test_scenario_split_chain_fires_on_draw_in_loop(tmp_path):
+    findings = _lint_scenarios(tmp_path, """
+        import jax
+
+        def draws(root, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.normal(root, (4,)))
+            return out
+    """)
+    assert [f.rule for f in findings] == ["scenario-split-chain"]
+    assert "fold_in" in findings[0].message
+
+
+def test_scenario_split_chain_allows_fold_in_indexing(tmp_path):
+    findings = _lint_scenarios(tmp_path, """
+        import jax
+
+        def keys(root, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.fold_in(root, i))
+            return out
+
+        def family(root):
+            k1, k2 = jax.random.split(root)
+            return jax.random.normal(k1, (4,)) + jax.random.normal(
+                k2, (4,))
+    """)
+    assert findings == []
+
+
+def test_scenario_rule_scoped_to_scenarios_subtree(tmp_path):
+    import textwrap as tw
+
+    from pta_replicator_tpu.analysis import engine as eng
+    from pta_replicator_tpu.analysis import rules_scenarios
+
+    rel = "pta_replicator_tpu/models/zz_other.py"
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(tw.dedent("""
+        import jax
+
+        def chain(key):
+            key, sub = jax.random.split(key)
+            return sub
+    """))
+    mods, problems = eng.parse_modules([str(path)], str(tmp_path))
+    active, _ = eng.run_rules(mods, rules_scenarios.RULES)
+    assert problems + active == []
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_scenario_validate_compile_replay(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    spec = mkspec(
+        gwb={"log10_amplitude": -14.3, "gamma": 4.33, "npts": 64,
+             "howml": 4.0, "orf": "none"},
+        sweep={"nreal": 4, "chunk": 2},
+    ).validate()
+    path = str(tmp_path / "s.json")
+    spec.save(path)
+
+    main(["scenario", "validate", path])
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["valid"] and rec["hash"] == spec.content_hash
+
+    out = str(tmp_path / "w.npz")
+    main(["scenario", "compile", path, "--out", out])
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["fingerprint"] == spec.content_hash
+    with np.load(out) as z:
+        assert z["static"].shape == (3, 64)
+        assert str(z["fingerprint"]) == spec.content_hash
+
+    ck = str(tmp_path / "ck.npz")
+    res = str(tmp_path / "r.npz")
+    main(["scenario", "run", path, "--out", res, "--checkpoint", ck])
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["shape"] == [4, 3, 64]
+    meta = json.load(open(ck + ".meta.json"))
+    assert meta["provenance"]["spec_hash"] == spec.content_hash
+
+    main(["scenario", "replay", path])
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["agree"] is True
+
+
+def test_cli_scenario_validate_rejects_bad_spec(tmp_path):
+    from pta_replicator_tpu.__main__ import main
+
+    path = str(tmp_path / "bad.json")
+    json.dump({"array": {"npsr": 3}, "white": {"efac": -2.0}},
+              open(path, "w"))
+    with pytest.raises(SystemExit, match="efac"):
+        main(["scenario", "validate", path])
+
+
+def test_spec_rejects_wrong_length_value_list():
+    # explicit per-pulsar lists must match array.npsr AT VALIDATE TIME
+    with pytest.raises(SpecError, match="white.efac.*array.npsr = 3"):
+        mkspec(white={"efac": [1.0, 1.1]}).validate()
+    with pytest.raises(SpecError, match="red.gamma.*array.npsr = 3"):
+        mkspec(red={"log10_amplitude": -14.0,
+                    "gamma": [3.0, 3.1]}).validate()
+    # a flat list is ambiguous under per_backend
+    with pytest.raises(SpecError, match="cannot combine with"):
+        mkspec(white={"efac": [1.0, 1.1, 1.2],
+                      "per_backend": True}).validate()
+    # correct length passes and compiles
+    c = compile_spec(mkspec(white={"efac": [1.0, 1.1, 1.2]}).validate())
+    np.testing.assert_allclose(np.asarray(c.recipe.efac),
+                               [1.0, 1.1, 1.2])
+
+
+def test_preset_param_flows_into_recipe():
+    spec = ScenarioSpec.from_dict({
+        "preset": "bench_flagship",
+        "preset_params": {"npsr": 4, "ntoa": 128, "nbackend": 2,
+                          "ncw": 3, "cgw_backend": "pallas_interpret"},
+    }).validate()
+    assert compile_spec(spec).recipe.cgw_backend == "pallas_interpret"
+
+
+def test_spec_rejects_misspelled_preset_param():
+    with pytest.raises(SpecError, match="preset_params.*ncww"):
+        ScenarioSpec.from_dict({
+            "preset": "bench_flagship", "preset_params": {"ncww": 50},
+        }).validate()
+
+
+def test_cli_scenario_run_guards(tmp_path, capsys):
+    from pta_replicator_tpu.__main__ import main
+
+    spec = mkspec(sweep={"nreal": 4, "chunk": 2}).validate()
+    path = str(tmp_path / "s.json")
+    spec.save(path)
+    # a --nreal the spec's chunk does not divide must be a named error,
+    # not a deep sweep traceback (and never a silent chunk change —
+    # chunking changes the fold_in key layout)
+    with pytest.raises(SystemExit, match="multiple of the spec's"):
+        main(["scenario", "run", path, "--nreal", "3",
+              "--checkpoint", str(tmp_path / "ck.npz")])
+    # nreal SMALLER than the spec chunk is the same silent-rechunk
+    # hazard and must also be a named error
+    with pytest.raises(SystemExit, match="multiple of the spec's"):
+        main(["scenario", "run", path, "--nreal", "1",
+              "--checkpoint", str(tmp_path / "ck2.npz")])
+    # run takes exactly one spec; extras must not be silently dropped
+    with pytest.raises(SystemExit, match="exactly one SPEC"):
+        main(["scenario", "run", path, path])
+    # compile --out with several specs would overwrite the output
+    with pytest.raises(SystemExit, match="exactly one SPEC"):
+        main(["scenario", "compile", path, path,
+              "--out", str(tmp_path / "w.npz")])
