@@ -62,6 +62,10 @@ REQUIRED_COVERAGE = (
     "orf_hd", "orf_none", "orf_aniso",
     "cw", "cw_streamed", "population_cw",
     "burst", "memory", "transient", "glitch",
+    # beyond-diagonal correlated noise (ISSUE 13): every structured
+    # covariance family must be differentially exercised against the
+    # dense f64 oracle
+    "cov_banded", "cov_kron", "cov_dense",
 )
 
 

@@ -77,7 +77,9 @@ def _build_recipe(spec: dict, psrs, locs=None):
             orf = assemble_orf(
                 locs, clm=orf_mode.get("clm"), lmax=int(orf_mode["lmax"])
             )
-        kwargs["orf_cholesky"] = jnp.asarray(np.linalg.cholesky(orf))
+        kwargs["orf_cholesky"] = jnp.asarray(
+            np.linalg.cholesky(np.asarray(orf, np.float64))
+        )
     return Recipe(**kwargs)
 
 

@@ -185,6 +185,7 @@ class Rule:
 def default_rules() -> List[Rule]:
     """The shipped rule packs (imported lazily to avoid cycles)."""
     from . import (
+        rules_cov,
         rules_jax,
         rules_obs,
         rules_robust,
@@ -200,6 +201,7 @@ def default_rules() -> List[Rule]:
         *rules_obs.RULES,
         *rules_robust.RULES,
         *rules_scenarios.RULES,
+        *rules_cov.RULES,
     ]
 
 

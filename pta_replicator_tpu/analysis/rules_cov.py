@@ -1,0 +1,112 @@
+"""graftlint rule pack: covariance-factorization precision discipline.
+
+A Cholesky factorization (or the triangular solve consuming its
+factor) squares the conditioning of whatever feeds it, and at float32
+that silently eats half the mantissa — exactly the failure class the
+``covariance/`` subsystem's f64-oracle pinning exists to catch
+(docs/covariance.md "Precision"). The discipline the pack enforces:
+
+* ``cov-f32-cholesky`` — a ``cholesky``/``solve_triangular`` call in
+  package code must either show an explicit float64 cast in its
+  argument expression (``np.linalg.cholesky(np.asarray(C,
+  np.float64))``, an ``.astype(np.float64)``, an x64-dtype operand
+  built in the same call) or carry an inline
+  ``# graftlint: disable=cov-f32-cholesky`` naming WHY the caller's
+  dtype is safe (an oracle-pinned kernel, a documented f64-only host
+  path, a validated f32 serving path). Silent caller-dtype
+  factorizations are how a TPU f32 default turns into quietly wrong
+  uncertainties.
+
+Suppressions are accepted on the call line itself, the line directly
+above it (the readable home for a long reason), or any line inside a
+multi-line call — the engine's same-line filter still applies on top.
+
+Test files, benchmarks, and examples are exempt: they pin or exercise
+precision deliberately.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .engine import Finding, Module, Rule
+
+#: callee suffixes the rule polices (resolved dotted names)
+_FACTOR_SUFFIXES = (".cholesky", ".solve_triangular")
+_FACTOR_BARE = ("cholesky", "solve_triangular")
+
+#: subtree markers that count as an explicit f64 cast
+_F64_MARKERS = ("float64",)
+
+
+def _is_package_file(relpath: str) -> bool:
+    rel = relpath.replace("\\", "/")
+    if not rel.startswith("pta_replicator_tpu/"):
+        return False
+    base = rel.rsplit("/", 1)[-1]
+    return not (base.startswith("test_") or base == "conftest.py")
+
+
+def _mentions_float64(node: ast.AST) -> bool:
+    """True when the call's argument expressions visibly carry an f64
+    cast: a ``float64`` attribute/name anywhere in the subtree (covers
+    ``np.float64``, ``jnp.float64``, ``.astype(np.float64)``,
+    ``np.asarray(x, np.float64)``, ``dtype=np.float64``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _F64_MARKERS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _F64_MARKERS:
+            return True
+        if isinstance(sub, ast.Constant) and sub.value == "float64":
+            return True
+    return False
+
+
+class CovF32Cholesky(Rule):
+    id = "cov-f32-cholesky"
+    severity = "error"
+    description = (
+        "cholesky/solve_triangular call without an explicit float64 "
+        "cast or an inline suppression naming why the caller dtype is "
+        "safe: factorizations square the conditioning, and an f32 "
+        "default silently halves the mantissa of every downstream "
+        "uncertainty (docs/covariance.md)"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not _is_package_file(mod.relpath):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve(node.func) or ""
+            bare = (node.func.id if isinstance(node.func, ast.Name)
+                    else getattr(node.func, "attr", ""))
+            if not (resolved.endswith(_FACTOR_SUFFIXES)
+                    or bare in _FACTOR_BARE):
+                continue
+            if _mentions_float64(node):
+                continue
+            # suppression window: the call line, the line above it, or
+            # any line inside a multi-line call (the engine filters the
+            # same-line case again; this widens to the readable homes)
+            end = max(
+                (getattr(n, "lineno", node.lineno)
+                 for n in ast.walk(node)),
+                default=node.lineno,
+            )
+            if any(
+                self.id in mod.suppressions.get(ln, ())
+                for ln in range(node.lineno - 1, end + 1)
+            ):
+                continue
+            name = resolved or bare
+            yield self.finding(
+                mod, node.lineno,
+                f"{name} at the caller's dtype: add an explicit "
+                "float64 cast in the call, or suppress inline with the "
+                "reason f32 is safe here",
+            )
+
+
+RULES = [CovF32Cholesky()]

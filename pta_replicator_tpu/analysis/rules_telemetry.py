@@ -273,6 +273,17 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
          n.LIKELIHOOD_DEADLINE_EXPIRED),
         (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),
         (f"{pkg}/obs/flightrec.py", "event", n.EVENT_FLIGHTREC_STALL),
+        # structured-covariance subsystem (ISSUE 13): the eager solve/
+        # sample spans + the adoption counters in the instrumented
+        # kernel helpers, and the blocked-Cholesky engine's jit label
+        # (devprof roofline accounting) — the ladder's instrumentation
+        # must not silently un-instrument
+        (f"{pkg}/covariance/kernels.py", "span", n.SPAN_COV_SOLVE),
+        (f"{pkg}/covariance/kernels.py", "span", n.SPAN_COV_SAMPLE),
+        (f"{pkg}/covariance/kernels.py", "metric", n.COV_SOLVES),
+        (f"{pkg}/covariance/kernels.py", "metric",
+         n.COV_BLOCKED_FRACTION),
+        (f"{pkg}/covariance/kernels.py", "jit", n.JIT_COV_CHOLESKY),
         # stage-occupancy + device-cost layer (PR 6): the heartbeat's
         # duty gauges, the prefetcher's busy accounting, the managed
         # jax.profiler capture, and the jax.cost./jax.roofline. gauge

@@ -1,0 +1,28 @@
+"""Beyond-diagonal noise covariance: structured representations
+(:mod:`.structure`) and their blocked/Kronecker solve kernels
+(:mod:`.kernels`).
+
+The subsystem closes ROADMAP open item 3 (arXiv:2506.13866's improved
+covariance modeling + arXiv:1407.1838's GP formulation): a
+:class:`~pta_replicator_tpu.covariance.structure.CovOp` rides inside a
+``Recipe`` — the batched engine *samples* correlated noise from it
+(``models/batched.realization_delays``), the GLS refit *weights* by it
+(the generalized ``white_ecorr_solver``), and the GP likelihood
+*prices* it (``likelihood/gp.py``) — all against one dense float64
+oracle (:func:`~pta_replicator_tpu.covariance.structure.
+dense_noise_covariance`). See docs/covariance.md.
+"""
+from .structure import (  # noqa: F401
+    COV_STREAM_FOLD,
+    BandedCov,
+    CovOp,
+    DenseCov,
+    KroneckerCov,
+    LowRankCov,
+    banded_from_times,
+    dense_from_times,
+    dense_noise_covariance,
+    kron_time_channel,
+    recipe_cov_s2,
+)
+from . import kernels  # noqa: F401

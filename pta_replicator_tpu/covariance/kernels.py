@@ -1,0 +1,395 @@
+"""Structured-covariance solve kernels: blocked Cholesky, block-
+tridiagonal (banded) Cholesky, and Kronecker solves.
+
+The solver ladder (cheapest structure that fits wins — docs/
+covariance.md):
+
+=====================  =======================  =====================
+structure              factorization            cost per pulsar
+=====================  =======================  =====================
+diagonal (+ECORR)      analytic Woodbury        O(Nt)  (white_ecorr_
+                                                solver, unchanged)
+block-tridiagonal      :func:`block_tridiag_    O(Nt b^2)
+("banded", bandwidth   cholesky` — lax.scan of
+b)                     (b, b) MXU factor/solve
+                       steps
+Kronecker time (x)     :func:`kron_solve` —     O(ne^3 + nc^3
+channel                per-factor Cholesky      + Nt (ne + nc))
+dense                  :func:`blocked_          O(Nt^3), blocked for
+                       cholesky` — right-       the MXU (tiled SYRK
+                       looking blocked w/       trailing update)
+                       Pallas or tiled-XLA
+                       trailing update
+=====================  =======================  =====================
+
+``blocked_cholesky``'s trailing update — the O(n^3) bulk — has two
+backends sharing ONE tile implementation
+(:func:`~pta_replicator_tpu.ops.pallas_cw.cov_tile_update`): the
+Pallas TPU kernel (``ops/pallas_cw.cov_syrk_update``) and a pure-XLA
+tiled loop. Because both run the same op sequence per tile, the two
+are bit-identical on CPU under ``interpret=True``
+(tests/test_covariance.py pins this), so the CPU path stays a faithful
+test double of the TPU kernel. ``backend='auto'`` picks XLA on CPU
+(LAPACK beats any hand blocking there) and the Pallas tiling on TPU.
+
+Everything here is shape-static, jit/vmap/grad-safe (scan + batched
+(b, b) primitives), and runs at the caller's dtype — covariance
+factorizations at f32 are only as good as their conditioning, so every
+consumer is pinned against an f64 dense oracle (the `cov-f32-cholesky`
+lint rule enforces the cast-or-justify discipline tree-wide).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.linalg import solve_triangular
+
+from ..ops.pallas_cw import cov_syrk_update, cov_tile_update
+
+
+def _chol_logdet(L):
+    """log det from a (batched) Cholesky factor: 2 sum log diag."""
+    return 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1
+    )
+
+
+# ------------------------------------------------------ blocked dense
+
+def _syrk_xla(C, L, tile: int):
+    """Tiled-XLA trailing update: the same per-tile op sequence as the
+    Pallas kernel (shared :func:`cov_tile_update`), looped over the
+    static tile grid — the bit-identical CPU fallback. Strictly-upper
+    tiles pass through un-updated, exactly as the kernel's
+    ``pl.when`` guard skips them (only the lower triangle is consumed
+    downstream)."""
+    m = C.shape[-1]
+    nt = m // tile
+    rows = []
+    for i in range(nt):
+        li = L[:, i * tile:(i + 1) * tile, :]
+        cols = [
+            cov_tile_update(
+                C[:, i * tile:(i + 1) * tile, j * tile:(j + 1) * tile],
+                li,
+                L[:, j * tile:(j + 1) * tile, :],
+            ) if j <= i else
+            C[:, i * tile:(i + 1) * tile, j * tile:(j + 1) * tile]
+            for j in range(nt)
+        ]
+        rows.append(jnp.concatenate(cols, axis=-1))
+    return jnp.concatenate(rows, axis=-2)
+
+
+def blocked_cholesky(A, block: int = 128, backend: str = "auto"):
+    """Lower Cholesky factor of a batched SPD matrix ``A`` (Np, n, n)
+    via the right-looking blocked algorithm: per step, one (block,
+    block) ``jnp.linalg.cholesky`` of the diagonal block, a batched
+    triangular panel solve, and the SYRK trailing update — the O(n^3)
+    bulk — through the selected backend ('xla' tiled loop, 'pallas'
+    TPU kernel, 'pallas_interpret' the same kernel interpreted on CPU,
+    'auto' = xla on CPU / pallas on TPU).
+
+    ``n`` is padded up to a multiple of ``block`` with identity rows
+    (decoupled — they factor to unit diagonal and touch nothing), so
+    any n works. Returns the (Np, n, n) lower factor.
+    """
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "xla"
+    npsr, n, _ = A.shape
+    nb = -(-n // block)
+    npad = nb * block - n
+    if npad:
+        A = jnp.pad(A, ((0, 0), (0, npad), (0, npad)))
+        pad_eye = jnp.concatenate(
+            [jnp.zeros(n, A.dtype), jnp.ones(npad, A.dtype)]
+        )
+        A = A + pad_eye[None, :, None] * pad_eye[None, None, :] * jnp.eye(
+            nb * block, dtype=A.dtype
+        )
+    W = A
+    out = jnp.zeros_like(W)
+    for k in range(nb):
+        k0, k1 = k * block, (k + 1) * block
+        # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the blocked kernel runs at whatever precision its consumer chose; every consumer is pinned against the f64 dense oracle (tests/test_covariance.py) and the f32 TPU path rides the bench ladder's tolerance gate
+        Lkk = jnp.linalg.cholesky(W[:, k0:k1, k0:k1])
+        out = out.at[:, k0:k1, k0:k1].set(Lkk)
+        if k1 < nb * block:
+            B = W[:, k1:, k0:k1]
+            # panel: B Lkk^-T  ==  solve_triangular(Lkk, B^T)^T
+            P = jnp.swapaxes(
+                # graftlint: disable=cov-f32-cholesky  # same caller-dtype contract as the diagonal-block factor above (oracle-pinned)
+                solve_triangular(
+                    Lkk, jnp.swapaxes(B, -1, -2), lower=True
+                ),
+                -1, -2,
+            )
+            out = out.at[:, k1:, k0:k1].set(P)
+            trail = W[:, k1:, k1:]
+            if backend in ("pallas", "pallas_interpret"):
+                trail = cov_syrk_update(
+                    trail, P, tile=block,
+                    interpret=(backend == "pallas_interpret"),
+                )
+            else:
+                trail = _syrk_xla(trail, P, tile=block)
+            W = W.at[:, k1:, k1:].set(trail)
+    tri = jnp.tril(out)
+    return tri[:, :n, :n]
+
+
+def dense_cholesky(A, block: int = 128, method: str = "auto"):
+    """Batched lower Cholesky of (Np, n, n): ``method='xla'`` is
+    ``jnp.linalg.cholesky`` (LAPACK on CPU — unbeatable there),
+    ``'blocked'`` the MXU-friendly blocked factorization above,
+    ``'auto'`` picks by backend."""
+    if method == "auto":
+        method = "blocked" if jax.default_backend() == "tpu" else "xla"
+    if method == "xla":
+        # graftlint: disable=cov-f32-cholesky  # caller-dtype dispatcher: precision policy is the consumer's (every consumer is pinned against the f64 dense oracle in tests/test_covariance.py)
+        return jnp.linalg.cholesky(A)
+    return blocked_cholesky(A, block=block)
+
+
+def cholesky_solve(L, X):
+    """Solve ``(L L^T) Z = X`` for (Np, n, n) factor and (Np, n, Q)
+    right-hand sides via two batched triangular solves."""
+    # graftlint: disable=cov-f32-cholesky  # caller-dtype solve against an oracle-pinned factor (see blocked_cholesky)
+    Y = solve_triangular(L, X, lower=True)
+    # graftlint: disable=cov-f32-cholesky  # second leg of the same oracle-pinned solve
+    return solve_triangular(L, Y, lower=True, trans=1)
+
+
+# ----------------------------------------------- block-tridiagonal
+
+def _scan_axis(x):
+    """(Np, nb, ...) -> (nb, Np, ...) for lax.scan."""
+    return jnp.moveaxis(x, 1, 0)
+
+
+def _unscan_axis(x):
+    return jnp.moveaxis(x, 0, 1)
+
+
+def block_tridiag_cholesky(D, E):
+    """Cholesky of a symmetric positive-definite block-tridiagonal
+    matrix: ``D`` (Np, nb, b, b) diagonal blocks, ``E`` (Np, nb-1, b,
+    b) sub-diagonal blocks (``E[k]`` is the (k+1, k) block). Returns
+    ``(Ld, M)``: the (Np, nb, b, b) diagonal Cholesky blocks and the
+    (Np, nb, b, b) sub-diagonal factor blocks (``M[0]`` is zero).
+
+    One lax.scan over block columns — each step is a batched (b, b)
+    Cholesky, triangular solve, and matmul (MXU work), so the whole
+    factorization costs O(Nt b^2) instead of the dense O(Nt^3).
+    """
+    npsr, nb, b, _ = D.shape
+    Epad = jnp.concatenate(
+        [jnp.zeros((npsr, 1, b, b), D.dtype), E], axis=1
+    )
+
+    def step(prev_L, inputs):
+        Dk, Ek = inputs
+        # M_k = E_{k-1} L_{k-1}^-T; E_0 = 0 so M_0 = 0 exactly
+        M = jnp.swapaxes(
+            # graftlint: disable=cov-f32-cholesky  # caller-dtype structured factor; pinned vs the f64 dense oracle (tests/test_covariance.py)
+            solve_triangular(prev_L, jnp.swapaxes(Ek, -1, -2),
+                             lower=True),
+            -1, -2,
+        )
+        S = Dk - jnp.einsum(
+            "pik,pjk->pij", M, M, precision="highest"
+        )
+        # graftlint: disable=cov-f32-cholesky  # same oracle-pinned caller-dtype contract
+        Lk = jnp.linalg.cholesky(S)
+        return Lk, (Lk, M)
+
+    init = jnp.tile(jnp.eye(b, dtype=D.dtype), (npsr, 1, 1))
+    _, (Ld, M) = jax.lax.scan(
+        step, init, (_scan_axis(D), _scan_axis(Epad))
+    )
+    return _unscan_axis(Ld), _unscan_axis(M)
+
+
+def block_tridiag_logdet(Ld):
+    """log det from the block-tridiagonal factor's diagonal blocks."""
+    return 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(Ld, axis1=-2, axis2=-1)), axis=(-2, -1)
+    )
+
+
+def block_tridiag_solve(Ld, M, X):
+    """Solve ``(L L^T) Z = X`` for the block-tridiagonal factor of
+    :func:`block_tridiag_cholesky`; ``X`` is (Np, nb, b, Q). Forward
+    then backward substitution, each one lax.scan of batched (b, b)
+    triangular solves."""
+    npsr, nb, b, Q = X.shape
+
+    def fwd(y_prev, inputs):
+        Lk, Mk, xk = inputs
+        rhs = xk - jnp.einsum(
+            "pij,pjq->piq", Mk, y_prev, precision="highest"
+        )
+        # graftlint: disable=cov-f32-cholesky  # caller-dtype structured solve; oracle-pinned (tests/test_covariance.py)
+        yk = solve_triangular(Lk, rhs, lower=True)
+        return yk, yk
+
+    y0 = jnp.zeros((npsr, b, Q), X.dtype)
+    _, Y = jax.lax.scan(
+        fwd, y0, (_scan_axis(Ld), _scan_axis(M), _scan_axis(X))
+    )
+
+    Mnext = jnp.concatenate(
+        [M[:, 1:], jnp.zeros((npsr, 1, b, b), X.dtype)], axis=1
+    )
+
+    def bwd(z_next, inputs):
+        Lk, Mk1, yk = inputs
+        rhs = yk - jnp.einsum(
+            "pji,pjq->piq", Mk1, z_next, precision="highest"
+        )
+        # graftlint: disable=cov-f32-cholesky  # caller-dtype structured solve; oracle-pinned (tests/test_covariance.py)
+        zk = solve_triangular(Lk, rhs, lower=True, trans=1)
+        return zk, zk
+
+    _, Z = jax.lax.scan(
+        bwd, y0,
+        (_scan_axis(Ld), _scan_axis(Mnext), Y),
+        reverse=True,
+    )
+    return _unscan_axis(Z)
+
+
+def block_tridiag_matvec(D, E, X):
+    """``C X`` for the block-tridiagonal (D, E) representation and
+    (Np, nb, b, Q) operands."""
+    out = jnp.einsum("pkij,pkjq->pkiq", D, X, precision="highest")
+    lower = jnp.einsum(
+        "pkij,pkjq->pkiq", E, X[:, :-1], precision="highest"
+    )
+    upper = jnp.einsum(
+        "pkji,pkjq->pkiq", E, X[:, 1:], precision="highest"
+    )
+    out = out.at[:, 1:].add(lower)
+    out = out.at[:, :-1].add(upper)
+    return out
+
+
+def block_tridiag_matmul_factor(Ld, M, Z):
+    """``L Z`` for the block-tridiagonal factor — the sampling map
+    (``L z`` has covariance ``L L^T``); ``Z`` is (Np, nb, b)."""
+    out = jnp.einsum("pkij,pkj->pki", Ld, Z, precision="highest")
+    out = out.at[:, 1:].add(
+        jnp.einsum("pkij,pkj->pki", M[:, 1:], Z[:, :-1],
+                   precision="highest")
+    )
+    return out
+
+
+# ------------------------------------------------------- Kronecker
+
+def kron_cholesky(Ct, Cf):
+    """Per-factor Cholesky of a Kronecker covariance ``Ct (x) Cf``
+    ((Np, ne, ne) epoch-level temporal factor, (Np, nc, nc) channel
+    factor): ``chol(Ct (x) Cf) = chol(Ct) (x) chol(Cf)`` under the
+    epoch-major (row-major) TOA ordering — the Kronecker product of
+    lower-triangular factors is lower triangular, and Cholesky factors
+    are unique, so the structured factor IS the dense factor."""
+    # graftlint: disable=cov-f32-cholesky  # caller-dtype structured factor; pinned vs the f64 dense Kronecker oracle (tests/test_covariance.py)
+    return jnp.linalg.cholesky(Ct), jnp.linalg.cholesky(Cf)
+
+
+def kron_solve(Lt, Lf, X):
+    """Solve ``(Ct (x) Cf) Z = X`` from the per-factor Cholesky
+    factors: reshape X (Np, ne*nc, Q) to the (ne, nc) grid and apply
+    ``Ct^-1`` along epochs and ``Cf^-1`` along channels — O(Nt (ne +
+    nc)) per right-hand side instead of the dense O(Nt^2)."""
+    npsr, nt, Q = X.shape
+    ne = Lt.shape[-1]
+    nc = Lf.shape[-1]
+    Xg = X.reshape(npsr, ne, nc * Q)
+    Y = cholesky_solve(Lt, Xg).reshape(npsr, ne, nc, Q)
+    Yc = jnp.moveaxis(Y, 2, 1).reshape(npsr, nc, ne * Q)
+    Z = cholesky_solve(Lf, Yc).reshape(npsr, nc, ne, Q)
+    return jnp.moveaxis(Z, 2, 1).reshape(npsr, nt, Q)
+
+
+def kron_logdet(Lt, Lf):
+    """log det of ``Ct (x) Cf`` from the factor Cholesky diagonals."""
+    ne = Lt.shape[-1]
+    nc = Lf.shape[-1]
+    return nc * _chol_logdet(Lt) + ne * _chol_logdet(Lf)
+
+
+def kron_sample_map(Lt, Lf, Z):
+    """``(Lt (x) Lf) z`` for a (Np, ne, nc) standard-normal grid: the
+    sampling map ``Lt Z Lf^T`` (epoch-major vec convention)."""
+    Y = jnp.einsum("pij,pjc->pic", Lt, Z, precision="highest")
+    return jnp.einsum("pic,pkc->pik", Y, Lf, precision="highest")
+
+
+# --------------------------------------------- eager telemetry shims
+
+#: running tallies behind the cov.blocked_fraction gauge: structured
+#: (banded/Kronecker/blocked) solves vs every solve the eager helpers
+#: priced. Only the eager, host-driven entry points below count — the
+#: jit-traced solver inside the likelihood prices once per compile.
+_SOLVE_TALLY = {"total": 0, "structured": 0}
+
+
+def solve_eager(op, x, s2=None):
+    """Eagerly solve ``C z = x`` through a CovOp, under the
+    ``cov_solve`` span with the ``cov.{solves,blocked_fraction}``
+    telemetry — the instrumented entry the bench ladder, oracle
+    harnesses, and CLI paths share (inside jit, call ``op.solve``
+    directly; spans and counters cannot live under a trace)."""
+    from ..obs import counter, gauge, names, span
+
+    structured = type(op).__name__ != "DenseCov"
+    with span(names.SPAN_COV_SOLVE, kind=type(op).__name__,
+              structured=structured):
+        out = op.solve(x, s2=s2)
+        out = jax.block_until_ready(out)
+    counter(names.COV_SOLVES).inc()
+    _SOLVE_TALLY["total"] += 1
+    _SOLVE_TALLY["structured"] += int(structured)
+    gauge(names.COV_BLOCKED_FRACTION).set(
+        _SOLVE_TALLY["structured"] / _SOLVE_TALLY["total"]
+    )
+    return out
+
+
+def sample_eager(op, key, s2=None, rows=None):
+    """Eagerly draw one correlated-noise realization through a CovOp,
+    under the ``cov_sample`` span — the fuzz harness's batched-side
+    entry (the production injection samples inside the jitted engine
+    and is span-free by design)."""
+    from ..obs import names, span
+
+    with span(names.SPAN_COV_SAMPLE, kind=type(op).__name__):
+        return jax.block_until_ready(op.sample(key, s2=s2, rows=rows))
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_solve_engine(method: str, block: int):
+    """Jitted dense factor+solve engine, instrumented for devprof
+    roofline accounting (the bench ladder's dense arm)."""
+    from ..obs import instrumented_jit, names
+
+    def run(A, X):
+        L = dense_cholesky(A, block=block, method=method)
+        return cholesky_solve(L, X), _chol_logdet(L)
+
+    return instrumented_jit(
+        run, name=names.JIT_COV_CHOLESKY, static_argnums=(),
+    )
+
+
+def dense_solve(A, X, method: str = "auto", block: int = 128):
+    """Factor + solve a batched dense SPD system through the cached
+    ``instrumented_jit`` engine (``cov.blocked_cholesky`` label, so
+    ``devprof`` cost/roofline accounting applies). Returns ``(Z,
+    logdet)``."""
+    return _dense_solve_engine(method, block)(A, X)

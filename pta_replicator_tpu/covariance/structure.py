@@ -1,0 +1,765 @@
+"""Structured beyond-diagonal noise-covariance representations.
+
+Every production path in the repo priced noise as the standard
+diagonal-white + rank-reduced model — exactly the approximation
+arXiv:2506.13866 shows biases PTA analyses once inter-epoch
+correlations (solar wind, chromatic DM structure, band noise) matter,
+and which the Gaussian-process formulation of arXiv:1407.1838
+generalizes. This module is the missing pillar: covariance *structures*
+with a common :class:`CovOp` interface —
+
+* ``matvec(x, s2)``   — apply ``s2 * C``
+* ``solve(x, s2)``    — apply ``(s2 * C)^-1``
+* ``logdet(s2)``      — masked ``log det (s2 * C)`` over valid TOAs
+* ``sample(key, s2)`` — one ``N(0, s2 * C)`` draw (Np, Nt)
+* ``dense()``         — the numpy-float64 dense oracle every structured
+  path is pinned against (tests/test_covariance.py, <= 1e-8 relative)
+
+and four concrete structures:
+
+=====================  ==============================================
+:class:`DenseCov`      dense per-pulsar (n, n) — the reference
+                       structure and the thing the ladder must beat
+:class:`BandedCov`     block-tridiagonal inter-epoch correlation
+                       (compact-support Wendland taper, diagonally-
+                       dominant by construction): O(Nt b^2) solves
+:class:`KroneckerCov`  time (x) frequency-channel chromatic structure
+                       (squared-exponential epochs (x) AR(1) channels,
+                       the solar-wind shape): O(ne^3 + nc^3) solves
+:class:`LowRankCov`    low-rank-plus-structured (Woodbury over any
+                       base CovOp)
+=====================  ==============================================
+
+Ops are registered pytrees, so a CovOp rides inside a
+:class:`~pta_replicator_tpu.models.batched.Recipe` through jit/vmap/
+sharding like any other leaf. Builders run on the HOST in float64 at
+compile/recipe-build time (the scenario compiler's eager frontier,
+same posture as the CW plane fold) and store both the structure AND
+its Cholesky factor as leaves — so the per-realization sampling map
+inside the jitted engine is a cheap structured matmul, never a
+factorization, and the factor is f64-exact regardless of the device
+dtype.
+
+Amplitude discipline: ops are built UNIT-NORMALIZED (unit diagonal at
+valid TOAs) and scaled at evaluation time by ``s2 = 10^(2
+cov_log10_sigma)`` from the Recipe leaf — which keeps the covariance
+amplitude a flat, named, fittable hyperparameter (``map_fit`` recovers
+it; the round-trip gate in benchmarks/cov_solve.py).
+
+Padding convention: stored structure blocks are ZERO on padding
+rows/cols (pure signal part); factors are of the structure plus
+identity at padding — decoupled unit rows that price ``log 1 = 0`` and
+solve to ``x``. ``nvalid`` (valid-TOA counts) makes the ``s2`` scaling
+of ``logdet`` exact under masking.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import kernels as K
+
+#: fold_in index of the correlated-noise draw on the per-realization
+#: key (models/batched.py realization_delays): the cov family draws
+#: from ``fold_in(key, COV_STREAM_FOLD)``, NOT from a widened split —
+#: so enabling it leaves every existing family's stream bit-identical
+#: (the same append-only discipline as scenarios' FAMILY_IDS).
+COV_STREAM_FOLD = 12
+
+
+def _as_np64(x):
+    return np.asarray(x, np.float64)
+
+
+def _wendland(r):
+    """Compact-support Wendland-C2 taper: (1-r)^4 (4r+1) for r < 1,
+    exactly 0 beyond — positive definite in up to three dimensions, so
+    the tapered kernel is a genuine covariance with a hard bandwidth."""
+    rc = np.clip(r, 0.0, 1.0)
+    return np.where(r < 1.0, (1.0 - rc) ** 4 * (4.0 * rc + 1.0), 0.0)
+
+
+def _np_block_tridiag_cholesky(D, E, valid_blocks):
+    """Host float64 block-tridiagonal Cholesky of the UNIT op (D + the
+    padding identity): the build-time twin of
+    kernels.block_tridiag_cholesky, run once per construction so the
+    jitted sampler never factors anything."""
+    npsr, nb, b, _ = D.shape
+    Ld = np.zeros_like(D)
+    M = np.zeros_like(D)
+    eye = np.eye(b)
+    prev = None
+    for k in range(nb):
+        # identity at padding rows: decoupled, log det 0, solve to x
+        S = D[:, k] + np.einsum(
+            "ij,pj->pij", eye, 1.0 - valid_blocks[:, k]
+        )
+        if k:
+            Mk = np.swapaxes(
+                np.linalg.solve(prev, np.swapaxes(E[:, k - 1], -1, -2)),
+                -1, -2,
+            )
+            M[:, k] = Mk
+            S = S - Mk @ np.swapaxes(Mk, -1, -2)
+        # graftlint: disable=cov-f32-cholesky  # host build-time factor, explicitly float64 end to end (builders upcast via _as_np64)
+        Ld[:, k] = np.linalg.cholesky(S)
+        prev = Ld[:, k]
+    return Ld, M
+
+
+def _s2_arr(s2, dtype):
+    """Normalize an s2 operand: None -> 1.0 scalar, else dtype array
+    (scalar or per-pulsar (Np,))."""
+    if s2 is None:
+        return jnp.asarray(1.0, dtype)
+    return jnp.asarray(s2, dtype)
+
+
+def _bcol(s2, extra_dims: int):
+    """Broadcast a scalar-or-(Np,) s2 against (Np, ...) operands."""
+    if s2.ndim == 0:
+        return s2
+    return s2.reshape(s2.shape + (1,) * extra_dims)
+
+
+def _draw_rows(key, npsr, nt, dtype, rows):
+    """The z draw behind every sample: ``normal(key, (Np, Nt))``, or an
+    exact row window of the global (npsr_global, Nt) stream under a
+    pulsar-sharded shard_map (same discipline as models.batched's
+    ``_rows_draw``)."""
+    if rows is None:
+        return jax.random.normal(key, (npsr, nt), dtype)
+    npsr_global, row_start = rows
+    full = jax.random.normal(key, (npsr_global, nt), dtype)
+    return jax.lax.dynamic_slice_in_dim(full, row_start, npsr, 0)
+
+
+class CovOp:
+    """Interface mixin: concrete structures implement the five-method
+    contract documented in the module docstring. (Duck-typed on
+    purpose — Recipe validation checks for ``sample``, so a foreign
+    structure with the same contract plugs in.)"""
+
+    def matvec(self, x, s2=None):
+        raise NotImplementedError
+
+    def solve(self, x, s2=None):
+        raise NotImplementedError
+
+    def logdet(self, s2=None):
+        raise NotImplementedError
+
+    def sample(self, key, s2=None, rows=None):
+        raise NotImplementedError
+
+    def dense(self, pad_identity: bool = True) -> np.ndarray:
+        raise NotImplementedError
+
+
+def _solve_2d(solve3, x, s2):
+    """Lift a (Np, Nt, Q) solver over (Np, Nt) vectors too."""
+    if x.ndim == 2:
+        return solve3(x[..., None], s2)[..., 0]
+    return solve3(x, s2)
+
+
+# ------------------------------------------------------------- dense
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DenseCov(CovOp):
+    """Dense per-pulsar covariance: ``mat`` (Np, n, n) pure signal part
+    (zero padding rows), ``L`` its host-f64 Cholesky factor (with
+    identity at padding), ``valid`` (Np, n) 1/0 mask, ``nvalid`` (Np,)
+    valid counts. The reference structure of the ladder — and the
+    fallback every other structure's combined-solver path can
+    dense-materialize into."""
+
+    mat: jax.Array
+    L: jax.Array
+    valid: jax.Array
+    nvalid: jax.Array
+
+    @classmethod
+    def from_dense(cls, mat, mask=None, dtype=None):
+        """Wrap an explicit (Np, n, n) SPD matrix (f64 host factor)."""
+        m = _as_np64(mat)
+        npsr, n, _ = m.shape
+        valid = (np.ones((npsr, n)) if mask is None
+                 else (_as_np64(mask) > 0).astype(np.float64))
+        m = m * valid[:, :, None] * valid[:, None, :]
+        pad = np.einsum("ij,pj->pij", np.eye(n), 1.0 - valid)
+        # graftlint: disable=cov-f32-cholesky  # host build-time factor, explicitly float64 (_as_np64 above)
+        L = np.linalg.cholesky(m + pad)
+        if dtype is None:
+            dtype = jnp.zeros(0).dtype
+        return cls(
+            mat=jnp.asarray(m, dtype), L=jnp.asarray(L, dtype),
+            valid=jnp.asarray(valid, dtype),
+            nvalid=jnp.asarray(valid.sum(axis=-1), dtype),
+        )
+
+    def matvec(self, x, s2=None):
+        s2 = _s2_arr(s2, x.dtype)
+        out = jnp.einsum("pij,pj...->pi...", self.mat, x,
+                         precision="highest")
+        return out * _bcol(s2, out.ndim - 1)
+
+    def solve(self, x, s2=None):
+        s2 = _s2_arr(s2, x.dtype)
+
+        def s3(xx, s2):
+            z = K.cholesky_solve(self.L, xx)
+            return z / _bcol(s2, 2)
+
+        return _solve_2d(s3, jnp.asarray(x), s2)
+
+    def logdet(self, s2=None):
+        s2 = _s2_arr(s2, self.L.dtype)
+        return K._chol_logdet(self.L) + self.nvalid * jnp.log(s2)
+
+    def sample(self, key, s2=None, rows=None):
+        npsr, n = self.valid.shape
+        z = _draw_rows(key, npsr, n, self.L.dtype, rows)
+        s2 = _s2_arr(s2, self.L.dtype)
+        out = jnp.einsum("pij,pj->pi", self.L, z, precision="highest")
+        return out * self.valid * _bcol(jnp.sqrt(s2), 1)
+
+    def dense(self, pad_identity: bool = True) -> np.ndarray:
+        m = _as_np64(self.mat)
+        if pad_identity:
+            v = _as_np64(self.valid)
+            m = m + np.einsum("ij,pj->pij", np.eye(m.shape[-1]), 1.0 - v)
+        return m
+
+    def dense_device(self, dtype):
+        return jnp.asarray(self.mat, dtype)
+
+
+def dense_from_times(toas_s, mask, corr_s, nugget: float = 0.05,
+                     dtype=None) -> DenseCov:
+    """Unit-diagonal squared-exponential temporal covariance over the
+    full TOA set (no truncation): ``C = (K_SE(dt; corr_s) + nugget I) /
+    (1 + nugget)`` — SPD for any geometry. The dense member of the
+    scenario family and the ladder's reference arm."""
+    t = _as_np64(toas_s)
+    dt = t[:, :, None] - t[:, None, :]
+    Kse = np.exp(-0.5 * (dt / float(corr_s)) ** 2)
+    n = t.shape[1]
+    C = (Kse + float(nugget) * np.eye(n)[None]) / (1.0 + float(nugget))
+    return DenseCov.from_dense(C, mask=mask, dtype=dtype)
+
+
+# ------------------------------------------------------------ banded
+
+@jax.tree_util.register_dataclass
+@dataclass
+class BandedCov(CovOp):
+    """Block-tridiagonal inter-epoch correlation: ``D`` (Np, nb, b, b)
+    diagonal blocks / ``E`` (Np, nb-1, b, b) sub-diagonal blocks of
+    the pure signal part (unit diagonal at valid TOAs, zero padding),
+    ``Ld``/``M`` the host-f64 factor of the unit op, ``valid`` (Np,
+    nb*b) the padded-grid mask, ``nvalid`` valid counts. ``nt`` is the
+    un-padded TOA count (static: a shape)."""
+
+    D: jax.Array
+    E: jax.Array
+    Ld: jax.Array
+    M: jax.Array
+    valid: jax.Array
+    nvalid: jax.Array
+    nt: int = field(metadata=dict(static=True), default=0)
+
+    @property
+    def block(self) -> int:
+        return int(self.D.shape[-1])
+
+    def _grid(self, x):
+        """(Np, Nt, Q) -> zero-padded (Np, nb, b, Q)."""
+        npsr, nt, Q = x.shape
+        ntp = self.valid.shape[1]
+        if ntp != nt:
+            x = jnp.pad(x, ((0, 0), (0, ntp - nt), (0, 0)))
+        return x.reshape(npsr, -1, self.block, Q)
+
+    def _ungrid(self, xg):
+        npsr = xg.shape[0]
+        return xg.reshape(npsr, -1, xg.shape[-1])[:, : self.nt]
+
+    def matvec(self, x, s2=None):
+        s2 = _s2_arr(s2, x.dtype)
+
+        def s3(xx, s2):
+            out = self._ungrid(
+                K.block_tridiag_matvec(self.D, self.E, self._grid(xx))
+            )
+            return out * _bcol(s2, 2)
+
+        return _solve_2d(s3, jnp.asarray(x), s2)
+
+    def solve(self, x, s2=None):
+        s2 = _s2_arr(s2, x.dtype)
+
+        def s3(xx, s2):
+            z = self._ungrid(
+                K.block_tridiag_solve(self.Ld, self.M, self._grid(xx))
+            )
+            return z / _bcol(s2, 2)
+
+        return _solve_2d(s3, jnp.asarray(x), s2)
+
+    def logdet(self, s2=None):
+        s2 = _s2_arr(s2, self.Ld.dtype)
+        return K.block_tridiag_logdet(self.Ld) + self.nvalid * jnp.log(s2)
+
+    def sample(self, key, s2=None, rows=None):
+        npsr = self.valid.shape[0]
+        z = _draw_rows(key, npsr, self.nt, self.Ld.dtype, rows)
+        zg = self._grid(z[..., None])[..., 0]
+        s = K.block_tridiag_matmul_factor(self.Ld, self.M, zg)
+        s = s.reshape(npsr, -1)[:, : self.nt]
+        s2 = _s2_arr(s2, self.Ld.dtype)
+        return s * self.valid[:, : self.nt] * _bcol(jnp.sqrt(s2), 1)
+
+    def dense(self, pad_identity: bool = True) -> np.ndarray:
+        D = _as_np64(self.D)
+        E = _as_np64(self.E)
+        npsr, nb, b, _ = D.shape
+        ntp = nb * b
+        C = np.zeros((npsr, ntp, ntp))
+        for k in range(nb):
+            k0 = k * b
+            C[:, k0:k0 + b, k0:k0 + b] = D[:, k]
+            if k:
+                C[:, k0:k0 + b, k0 - b:k0] = E[:, k - 1]
+                C[:, k0 - b:k0, k0:k0 + b] = np.swapaxes(
+                    E[:, k - 1], -1, -2
+                )
+        C = C[:, : self.nt, : self.nt]
+        if pad_identity:
+            v = _as_np64(self.valid)[:, : self.nt]
+            C = C + np.einsum("ij,pj->pij", np.eye(self.nt), 1.0 - v)
+        return C
+
+    def dense_device(self, dtype):
+        """Traceable dense materialization of the pure part (the
+        combined solver's fallback when ECORR shares the covariance)."""
+        npsr, nb, b, _ = self.D.shape
+        ntp = nb * b
+        C = jnp.zeros((npsr, ntp, ntp), dtype)
+        for k in range(nb):
+            k0 = k * b
+            C = C.at[:, k0:k0 + b, k0:k0 + b].set(
+                jnp.asarray(self.D[:, k], dtype)
+            )
+            if k:
+                Ek = jnp.asarray(self.E[:, k - 1], dtype)
+                C = C.at[:, k0:k0 + b, k0 - b:k0].set(Ek)
+                C = C.at[:, k0 - b:k0, k0:k0 + b].set(
+                    jnp.swapaxes(Ek, -1, -2)
+                )
+        return C[:, : self.nt, : self.nt]
+
+
+def banded_from_times(toas_s, mask, rho, corr_s, block: int = 32,
+                      dtype=None) -> BandedCov:
+    """Unit-diagonal block-tridiagonal inter-epoch correlation from
+    concrete TOA times (host float64, compile-time):
+
+    ``R = I + (rho / max_row_mass) * W_tridiag(dt; corr_s)``
+
+    with ``W`` the compact-support Wendland taper restricted to the
+    block-tridiagonal sparsity and the coupling normalized by the
+    largest off-diagonal row mass — so ``rho < 1`` makes ``R`` strictly
+    diagonally dominant, hence SPD, for ANY cadence (the model is
+    defined by this construction; the taper's hard support is what the
+    banded solver's O(Nt b^2) cost stands on)."""
+    t = _as_np64(toas_s)
+    m = (_as_np64(mask) > 0).astype(np.float64)
+    npsr, nt = t.shape
+    nb = -(-nt // block)
+    ntp = nb * block
+    tpad = np.pad(t, ((0, 0), (0, ntp - nt)))
+    vpad = np.pad(m, ((0, 0), (0, ntp - nt)))
+    tg = tpad.reshape(npsr, nb, block)
+    vg = vpad.reshape(npsr, nb, block)
+
+    r = float(corr_s)
+    dt_d = np.abs(tg[:, :, :, None] - tg[:, :, None, :]) / r
+    Wd = _wendland(dt_d) * (vg[:, :, :, None] * vg[:, :, None, :])
+    eye = np.eye(block)[None, None]
+    Wd = Wd * (1.0 - eye)  # zero diagonal: W is pure coupling
+    dt_o = np.abs(tg[:, 1:, :, None] - tg[:, :-1, None, :]) / r
+    Wo = _wendland(dt_o) * (vg[:, 1:, :, None] * vg[:, :-1, None, :])
+
+    # off-diagonal row mass: within-block + both adjacent-block sides
+    rows = Wd.sum(axis=-1)
+    rows[:, 1:] += Wo.sum(axis=-1)
+    rows[:, :-1] += Wo.sum(axis=-2)
+    denom = np.maximum(rows.reshape(npsr, -1).max(axis=-1), 1e-12)
+    rho_arr = np.broadcast_to(_as_np64(rho), (npsr,))
+    coup = (rho_arr / denom)[:, None, None, None]
+
+    D = coup * Wd + np.einsum("ij,pkj->pkij", np.eye(block), vg)
+    E = coup * Wo
+    Ld, M = _np_block_tridiag_cholesky(D, E, vg)
+    if dtype is None:
+        dtype = jnp.zeros(0).dtype
+    return BandedCov(
+        D=jnp.asarray(D, dtype), E=jnp.asarray(E, dtype),
+        Ld=jnp.asarray(Ld, dtype), M=jnp.asarray(M, dtype),
+        valid=jnp.asarray(vpad, dtype),
+        nvalid=jnp.asarray(m.sum(axis=-1), dtype), nt=nt,
+    )
+
+
+# --------------------------------------------------------- Kronecker
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KroneckerCov(CovOp):
+    """Time (x) frequency-channel Kronecker covariance ``Ct (x) Cf``
+    over an epoch-major (ne, nc) TOA grid: ``Ct`` (Np, ne, ne) epoch-
+    level temporal factor, ``Cf`` (Np, nc, nc) channel factor, with
+    their host-f64 Cholesky factors. Requires a FULL grid (every TOA
+    valid, ``Nt = ne * nc`` in time order) — the scenario compiler
+    enforces this at validate time. The chromatic solar-wind shape:
+    correlation across epochs (x) correlation across the observing
+    band."""
+
+    Ct: jax.Array
+    Cf: jax.Array
+    Lt: jax.Array
+    Lf: jax.Array
+    nvalid: jax.Array
+
+    @property
+    def shape_grid(self):
+        return int(self.Ct.shape[-1]), int(self.Cf.shape[-1])
+
+    def matvec(self, x, s2=None):
+        ne, nc = self.shape_grid
+        s2 = _s2_arr(s2, x.dtype)
+
+        def s3(xx, s2):
+            npsr, nt, Q = xx.shape
+            Xg = xx.reshape(npsr, ne, nc, Q)
+            Y = jnp.einsum("pij,pjcq->picq", self.Ct, Xg,
+                           precision="highest")
+            out = jnp.einsum("pcd,pidq->picq", self.Cf, Y,
+                             precision="highest")
+            return out.reshape(npsr, nt, Q) * _bcol(s2, 2)
+
+        return _solve_2d(s3, jnp.asarray(x), s2)
+
+    def solve(self, x, s2=None):
+        s2 = _s2_arr(s2, x.dtype)
+
+        def s3(xx, s2):
+            z = K.kron_solve(self.Lt, self.Lf, xx)
+            return z / _bcol(s2, 2)
+
+        return _solve_2d(s3, jnp.asarray(x), s2)
+
+    def logdet(self, s2=None):
+        s2 = _s2_arr(s2, self.Lt.dtype)
+        return K.kron_logdet(self.Lt, self.Lf) + self.nvalid * jnp.log(s2)
+
+    def sample(self, key, s2=None, rows=None):
+        ne, nc = self.shape_grid
+        npsr = self.Ct.shape[0]
+        z = _draw_rows(key, npsr, ne * nc, self.Lt.dtype, rows)
+        s = K.kron_sample_map(self.Lt, self.Lf, z.reshape(npsr, ne, nc))
+        s2 = _s2_arr(s2, self.Lt.dtype)
+        return s.reshape(npsr, ne * nc) * _bcol(jnp.sqrt(s2), 1)
+
+    def dense(self, pad_identity: bool = True) -> np.ndarray:
+        Ct = _as_np64(self.Ct)
+        Cf = _as_np64(self.Cf)
+        return np.stack(
+            [np.kron(Ct[p], Cf[p]) for p in range(Ct.shape[0])]
+        )
+
+    def dense_device(self, dtype):
+        ne, nc = self.shape_grid
+        C = jnp.einsum(
+            "pij,pcd->picjd", jnp.asarray(self.Ct, dtype),
+            jnp.asarray(self.Cf, dtype), precision="highest",
+        )
+        npsr = C.shape[0]
+        return C.reshape(npsr, ne * nc, ne * nc)
+
+
+def kron_time_channel(toas_s, channels: int, time_ell_s, chan_rho,
+                      nugget: float = 0.05, dtype=None,
+                      mask=None) -> KroneckerCov:
+    """Kronecker time (x) channel covariance from concrete TOA times:
+    consecutive groups of ``channels`` TOAs form one epoch (Nt must
+    divide evenly — validated upstream); the temporal factor is a
+    unit-diagonal squared-exponential kernel over epoch mean times
+    (+ nugget), the channel factor an AR(1) correlation
+    ``chan_rho^|a-b|`` (SPD for |rho| < 1).
+
+    The Kronecker structure has NO padding-identity escape hatch —
+    every TOA is a live grid cell. Pass ``mask`` to have the builder
+    enforce that (a masked TOA would otherwise stay cross-coupled in
+    the priced C0 while the injection zeroes it, silently biasing the
+    likelihood against its oracle)."""
+    if mask is not None and not np.all(_as_np64(mask) > 0):
+        raise ValueError(
+            "KroneckerCov needs a FULL TOA grid (every TOA valid): the "
+            "time (x) channel structure cannot decouple masked TOAs; "
+            "use BandedCov/DenseCov for ragged batches"
+        )
+    t = _as_np64(toas_s)
+    npsr, nt = t.shape
+    nc = int(channels)
+    if nt % nc:
+        raise ValueError(
+            f"Kronecker grid needs ntoa ({nt}) divisible by channels "
+            f"({nc})"
+        )
+    ne = nt // nc
+    tg = t.reshape(npsr, ne, nc).mean(axis=-1)
+    dt = tg[:, :, None] - tg[:, None, :]
+    Ct = np.exp(-0.5 * (dt / float(time_ell_s)) ** 2)
+    Ct = (Ct + float(nugget) * np.eye(ne)[None]) / (1.0 + float(nugget))
+    rho_arr = np.broadcast_to(_as_np64(chan_rho), (npsr,))
+    ab = np.abs(np.arange(nc)[:, None] - np.arange(nc)[None, :])
+    Cf = rho_arr[:, None, None] ** ab[None]
+    # graftlint: disable=cov-f32-cholesky  # host build-time factors, explicitly float64 (_as_np64 above)
+    Lt = np.linalg.cholesky(Ct)
+    # graftlint: disable=cov-f32-cholesky  # host build-time factors, explicitly float64 (_as_np64 above)
+    Lf = np.linalg.cholesky(Cf)
+    if dtype is None:
+        dtype = jnp.zeros(0).dtype
+    return KroneckerCov(
+        Ct=jnp.asarray(Ct, dtype), Cf=jnp.asarray(Cf, dtype),
+        Lt=jnp.asarray(Lt, dtype), Lf=jnp.asarray(Lf, dtype),
+        nvalid=jnp.asarray(np.full(npsr, float(nt)), dtype),
+    )
+
+
+# ------------------------------------------------- low-rank + base
+
+@jax.tree_util.register_dataclass
+@dataclass
+class LowRankCov(CovOp):
+    """Low-rank-plus-structured: ``C = base + U diag(phi) U^T`` over
+    any base CovOp, solved by the Woodbury identity through the base's
+    own structured solve (an (R, R) Cholesky on top — the same shape
+    as the GP likelihood's rank-reduced block)."""
+
+    base: CovOp
+    U: jax.Array
+    phi: jax.Array
+
+    @property
+    def nvalid(self):
+        return self.base.nvalid
+
+    def matvec(self, x, s2=None):
+        s2 = _s2_arr(s2, self.U.dtype)
+
+        def s3(xx, s2):
+            inner = jnp.einsum("pnr,pnq->prq", self.U, xx,
+                               precision="highest")
+            lowr = jnp.einsum(
+                "pnr,prq->pnq", self.U, inner * self.phi[:, :, None],
+                precision="highest",
+            )
+            return self.base.matvec(xx, s2=s2) + lowr * _bcol(s2, 2)
+
+        return _solve_2d(s3, jnp.asarray(x), s2)
+
+    def _woodbury(self):
+        G = self.base.solve(self.U)  # base^-1 U, (Np, Nt, R)
+        S = jnp.einsum("pnr,pns->prs", self.U, G, precision="highest")
+        R = self.U.shape[-1]
+        S = S + jnp.eye(R, dtype=self.U.dtype) / self.phi[:, None, :]
+        # graftlint: disable=cov-f32-cholesky  # caller-dtype Woodbury core; pinned vs the f64 dense oracle (tests/test_covariance.py)
+        L = jnp.linalg.cholesky(S)
+        return G, L
+
+    def solve(self, x, s2=None):
+        s2 = _s2_arr(s2, self.U.dtype)
+
+        def s3(xx, s2):
+            G, L = self._woodbury()
+            y = self.base.solve(xx)
+            inner = jnp.einsum("pnr,pnq->prq", self.U, y,
+                               precision="highest")
+            from jax.scipy.linalg import cho_solve
+
+            corr = cho_solve((L, True), inner)
+            z = y - jnp.einsum("pnr,prq->pnq", G, corr,
+                               precision="highest")
+            return z / _bcol(s2, 2)
+
+        return _solve_2d(s3, jnp.asarray(x), s2)
+
+    def logdet(self, s2=None):
+        _G, L = self._woodbury()
+        s2 = _s2_arr(s2, self.U.dtype)
+        return (
+            self.base.logdet()
+            + K._chol_logdet(L)
+            + jnp.sum(jnp.log(self.phi), axis=-1)
+            + self.nvalid * jnp.log(s2)
+        )
+
+    def sample(self, key, s2=None, rows=None):
+        k_base, k_lr = jax.random.split(key, 2)
+        base_s = self.base.sample(k_base, rows=rows)
+        npsr, R = self.phi.shape
+        nglobal, start = (npsr, 0) if rows is None else rows
+        z = jax.lax.dynamic_slice_in_dim(
+            jax.random.normal(k_lr, (nglobal, R), self.U.dtype),
+            start, npsr, 0,
+        )
+        lr = jnp.einsum(
+            "pnr,pr->pn", self.U, jnp.sqrt(self.phi) * z,
+            precision="highest",
+        )
+        s2 = _s2_arr(s2, self.U.dtype)
+        return (base_s + lr) * _bcol(jnp.sqrt(s2), 1)
+
+    def dense(self, pad_identity: bool = True) -> np.ndarray:
+        U = _as_np64(self.U)
+        phi = _as_np64(self.phi)
+        return self.base.dense(pad_identity=pad_identity) + np.einsum(
+            "pnr,pr,pmr->pnm", U, phi, U
+        )
+
+    def dense_device(self, dtype):
+        U = jnp.asarray(self.U, dtype)
+        return self.base.dense_device(dtype) + jnp.einsum(
+            "pnr,pr,pmr->pnm", U, jnp.asarray(self.phi, dtype), U,
+            precision="highest",
+        )
+
+
+# ------------------------------------------- recipe-facing helpers
+
+def recipe_cov_s2(recipe, dtype=None):
+    """The evaluation-time amplitude of a recipe's correlated-noise
+    block: ``10^(2 cov_log10_sigma)``, or None when the recipe carries
+    no amplitude leaf (the op's built-in unit scale applies)."""
+    ls = getattr(recipe, "cov_log10_sigma", None)
+    if ls is None:
+        return None
+    ls = jnp.asarray(ls) if dtype is None else jnp.asarray(ls, dtype)
+    return 10.0 ** (2.0 * ls)
+
+
+def banded_combined_solver(op: BandedCov, safe_sigma2, s2, dtype):
+    """Structured solver for ``C0 = diag(sigma2) + s2 * R_banded``: the
+    white diagonal folds into the block-tridiagonal diagonal blocks, so
+    the combined factor stays O(Nt b^2) — the covariance-aware GLS/
+    likelihood hot path for the banded family. Padding rows (both
+    masked TOAs, whose safe sigma2 is 1, and the block-grid tail) stay
+    exact identity. Returns ``(c0inv_mat, logdet)`` with the same
+    contract as ``white_ecorr_solver``'s closure."""
+    npsr, nb, b, _ = op.D.shape
+    ntp = nb * b
+    sig = jnp.asarray(safe_sigma2, dtype)
+    sig = jnp.pad(sig, ((0, 0), (0, ntp - sig.shape[1])),
+                  constant_values=1.0)
+    s2v = _s2_arr(s2, dtype)
+    sc = _bcol(s2v, 3)
+    D = jnp.asarray(op.D, dtype) * sc + jnp.einsum(
+        "ij,pkj->pkij", jnp.eye(b, dtype=dtype),
+        sig.reshape(npsr, nb, b),
+    )
+    E = jnp.asarray(op.E, dtype) * sc
+    Ld, M = K.block_tridiag_cholesky(D, E)
+    logdet = K.block_tridiag_logdet(Ld)
+
+    def c0inv_mat(X):
+        npsr_, nt, Q = X.shape
+        Xp = jnp.pad(X, ((0, 0), (0, ntp - nt), (0, 0)))
+        Z = K.block_tridiag_solve(
+            Ld, M, Xp.reshape(npsr_, nb, b, Q)
+        )
+        return Z.reshape(npsr_, ntp, Q)[:, :nt]
+
+    return c0inv_mat, logdet
+
+
+def dense_combined_solver(batch, safe_sigma2, ecorr2, extra, s2, dtype):
+    """Dense fallback for ``C0 = diag(sigma2) + U_ec diag(ecorr2)
+    U_ec^T + s2 * X`` with ANY structured extra: materialize, factor
+    with the blocked-Cholesky dispatcher, solve by triangular
+    substitution. O(Nt^3) per pulsar — correct for every structure/
+    ECORR combination; the banded path above and the pure-structure
+    ladders are the fast lanes (docs/covariance.md)."""
+    npsr, nt = safe_sigma2.shape
+    C = jnp.einsum(
+        "ij,pj->pij", jnp.eye(nt, dtype=dtype),
+        jnp.asarray(safe_sigma2, dtype),
+    )
+    if extra is not None:
+        s2v = _s2_arr(s2, dtype)
+        C = C + extra.dense_device(dtype) * _bcol(s2v, 2)
+    if ecorr2 is not None:
+        onehot = (
+            batch.epoch_index[..., None]
+            == jnp.arange(ecorr2.shape[1])[None, None, :]
+        ).astype(dtype) * batch.mask[..., None]
+        C = C + jnp.einsum(
+            "pne,pe,pme->pnm", onehot, jnp.asarray(ecorr2, dtype),
+            onehot, precision="highest",
+        )
+    L = K.dense_cholesky(C)
+    logdet = K._chol_logdet(L)
+
+    def c0inv_mat(X):
+        return K.cholesky_solve(L, X)
+
+    return c0inv_mat, logdet
+
+
+def dense_noise_covariance(batch, recipe) -> np.ndarray:
+    """The ONE dense (Np, Nt, Nt) float64 oracle assembly of a recipe's
+    full noise covariance — white diagonal, analytic ECORR block,
+    rank-reduced GP blocks, and the structured correlated-noise block:
+
+        C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T + s2 X
+
+    built from the SAME ``gls_noise_model`` components (and the same
+    CovOp) the device engines consume, so the oracle and the engine can
+    never disagree about what C is. Padding rows are zero (pure signal
+    part); consumers slice their valid TOAs
+    (``likelihood.gp.dense_loglikelihood``) or add their own identity.
+    Host numpy, tests/benches only."""
+    from ..models.batched import gls_noise_model
+
+    sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+    sigma2 = _as_np64(sigma2)
+    npsr, nt = sigma2.shape
+    C = np.einsum("ij,pj->pij", np.eye(nt), sigma2)
+    if ecorr2 is not None:
+        ecorr2 = _as_np64(ecorr2)
+        epoch_index = np.asarray(batch.epoch_index)
+        mask = _as_np64(batch.mask)
+        onehot = (
+            epoch_index[..., None] == np.arange(ecorr2.shape[1])
+        ).astype(np.float64) * mask[..., None]
+        C = C + np.einsum("pne,pe,pme->pnm", onehot, ecorr2, onehot)
+    if U is not None:
+        U = _as_np64(U)
+        phi = _as_np64(phi)
+        C = C + np.einsum("pnr,pr,pmr->pnm", U, phi, U)
+    extra = getattr(recipe, "noise_cov", None)
+    if extra is not None:
+        s2 = recipe_cov_s2(recipe)
+        s2 = 1.0 if s2 is None else _as_np64(s2)
+        Xd = extra.dense(pad_identity=False)
+        C = C + Xd * np.reshape(
+            np.broadcast_to(s2, (npsr,)), (npsr, 1, 1)
+        )
+    return C

@@ -62,24 +62,19 @@ import jax.numpy as jnp
 from jax.scipy.linalg import cho_solve, solve_triangular
 
 from ..batch import PulsarBatch
+from ..covariance.kernels import _chol_logdet
 from ..models.batched import Recipe, gls_noise_model, white_ecorr_solver
 
 _LOG_2PI = float(np.log(2.0 * np.pi))
 
-#: Recipe fields that change the white/ECORR block C0 — a
-#: :class:`ReducedGP` precompute is only valid while these are fixed
-#: (likelihood/infer.py routes grids over any of them to the direct
-#: path instead)
+#: Recipe fields that change the white/ECORR(/correlated-noise) block
+#: C0 — a :class:`ReducedGP` precompute is only valid while these are
+#: fixed (likelihood/infer.py routes grids over any of them to the
+#: direct path instead). ``cov_log10_sigma`` scales the structured
+#: ``noise_cov`` block, which lives inside C0.
 WHITE_NOISE_FIELDS = frozenset(
-    {"efac", "log10_equad", "log10_ecorr", "tnequad"}
+    {"efac", "log10_equad", "log10_ecorr", "tnequad", "cov_log10_sigma"}
 )
-
-
-def _chol_logdet(L):
-    """log det from a batched Cholesky factor: 2 sum log diag(L)."""
-    return 2.0 * jnp.sum(
-        jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), axis=-1
-    )
 
 
 def _tm_columns(batch: PulsarBatch, design, dtype):
@@ -120,10 +115,14 @@ def loglikelihood(
     for the same reason the GLS refit does (the TPU bf16 default leaves
     ~1e-2 relative error on Gram entries).
     """
+    from ..covariance.structure import recipe_cov_s2
+
     dtype = jnp.asarray(residuals).dtype
     sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
     _winv, c0inv, logdet_c0 = white_ecorr_solver(
-        batch, sigma2, ecorr2, dtype
+        batch, sigma2, ecorr2, dtype,
+        extra=recipe.noise_cov,
+        extra_s2=recipe_cov_s2(recipe, dtype),
     )
     r = jnp.asarray(residuals, dtype) * batch.mask
     x0 = c0inv(r[..., None])[..., 0]  # C0^-1 r, (Np, Nt)
@@ -141,9 +140,9 @@ def loglikelihood(
         S = jnp.einsum("pnr,pns->prs", U, G, precision="highest")
         phi_safe = jnp.where(phi > 0, phi, 1.0)
         S = S + jnp.eye(U.shape[-1], dtype=dtype) / phi_safe[:, None, :]
-        L = jnp.linalg.cholesky(S)
+        L = jnp.linalg.cholesky(S)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
         b = jnp.einsum("pnr,pn->pr", U, x0, precision="highest")
-        z = solve_triangular(L, b[..., None], lower=True)[..., 0]
+        z = solve_triangular(L, b[..., None], lower=True)[..., 0]  # graftlint: disable=cov-f32-cholesky  # same oracle-pinned contract as the factor above
         quad = quad - jnp.sum(z * z, axis=-1)
         # log det C = log det C0 + log det S + log det Phi
         logdet = logdet + _chol_logdet(L) + jnp.sum(
@@ -177,9 +176,9 @@ def loglikelihood(
         A = A + jnp.eye(K, dtype=dtype) * zero_col[:, None, :].astype(
             dtype
         )
-        La = jnp.linalg.cholesky(A)
+        La = jnp.linalg.cholesky(A)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
         bm = jnp.einsum("pnk,pn->pk", Mn, w, precision="highest")
-        zm = solve_triangular(La, bm[..., None], lower=True)[..., 0]
+        zm = solve_triangular(La, bm[..., None], lower=True)[..., 0]  # graftlint: disable=cov-f32-cholesky  # same oracle-pinned contract as the factor above
         quad = quad - jnp.sum(zm * zm, axis=-1)
         logdet = logdet + _chol_logdet(La)
         ndof = ndof - jnp.sum((~zero_col).astype(dtype), axis=-1)
@@ -261,6 +260,13 @@ class ReducedGP:
     zero_col: Optional[jax.Array]
     #: (Np,) valid-TOA count minus fitted timing columns
     ndof: jax.Array
+    #: structured correlated-noise block (a covariance CovOp) and its
+    #: frozen amplitude 10^(2 cov_log10_sigma): part of C0, retained so
+    #: :meth:`project` rebuilds the SAME generalized solver the build
+    #: used (grids over cov_log10_sigma invalidate the precompute —
+    #: WHITE_NOISE_FIELDS routes them to the direct path)
+    extra: Optional[object] = None
+    extra_s2: Optional[jax.Array] = None
     #: number of leading timing-model columns in the stack
     ktm: int = field(metadata=dict(static=True), default=0)
 
@@ -272,11 +278,15 @@ class ReducedGP:
         ECORR noise AND the GP basis layout; its phi values are not
         retained (evaluations supply their own via
         :func:`phi_for_recipe`)."""
+        from ..covariance.structure import recipe_cov_s2
+
         if dtype is None:
             dtype = batch.toas_s.dtype
         sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
+        extra = recipe.noise_cov
+        extra_s2 = recipe_cov_s2(recipe, dtype)
         _winv, c0inv, logdet_c0 = white_ecorr_solver(
-            batch, sigma2, ecorr2, dtype
+            batch, sigma2, ecorr2, dtype, extra=extra, extra_s2=extra_s2
         )
         cols = []
         zero_col = None
@@ -304,7 +314,8 @@ class ReducedGP:
             TNT=TNT, CiT=CiT, logdet_c0=logdet_c0,
             sigma2=jnp.asarray(sigma2, dtype),
             ecorr2=None if ecorr2 is None else jnp.asarray(ecorr2, dtype),
-            zero_col=zero_col, ndof=ndof, ktm=ktm,
+            zero_col=zero_col, ndof=ndof, extra=extra,
+            extra_s2=extra_s2, ktm=ktm,
         )
 
     @property
@@ -320,7 +331,8 @@ class ReducedGP:
         projection and the precompute cannot price different C0s."""
         dtype = self.CiT.dtype
         _winv, c0inv, _logdet = white_ecorr_solver(
-            batch, self.sigma2, self.ecorr2, dtype
+            batch, self.sigma2, self.ecorr2, dtype,
+            extra=self.extra, extra_s2=self.extra_s2,
         )
         r = jnp.asarray(residuals, dtype) * batch.mask
         y = c0inv(r[..., None])[..., 0]
@@ -347,9 +359,9 @@ class ReducedGP:
             active[:, :, None] * active[:, None, :]
         )
         S = TNT_uu + jnp.eye(self.ngp, dtype=dtype) / phi_safe[:, None, :]
-        L = jnp.linalg.cholesky(S)
+        L = jnp.linalg.cholesky(S)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
         d_u = proj.d[:, k:] * active
-        z = solve_triangular(L, d_u[..., None], lower=True)[..., 0]
+        z = solve_triangular(L, d_u[..., None], lower=True)[..., 0]  # graftlint: disable=cov-f32-cholesky  # same oracle-pinned contract as the factor above
         quad = proj.rNr - jnp.sum(z * z, axis=-1)
         logdet = self.logdet_c0 + _chol_logdet(L) + jnp.sum(
             jnp.log(phi_safe) * active, axis=-1
@@ -363,13 +375,13 @@ class ReducedGP:
             A = A + jnp.eye(k, dtype=dtype) * self.zero_col[
                 :, None, :
             ].astype(dtype)
-            La = jnp.linalg.cholesky(A)
+            La = jnp.linalg.cholesky(A)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
             bm = proj.d[:, :k] - jnp.einsum(
                 "pkr,pr->pk", TNT_mu,
                 cho_solve((L, True), d_u[..., None])[..., 0],
                 precision="highest",
             )
-            zm = solve_triangular(La, bm[..., None], lower=True)[..., 0]
+            zm = solve_triangular(La, bm[..., None], lower=True)[..., 0]  # graftlint: disable=cov-f32-cholesky  # same oracle-pinned contract as the factor above
             quad = quad - jnp.sum(zm * zm, axis=-1)
             logdet = logdet + _chol_logdet(La)
         ll = -0.5 * (quad + logdet + self.ndof * dtype.type(_LOG_2PI))
@@ -404,22 +416,24 @@ def dense_loglikelihood(
     """Oracle-grade dense-covariance reference: numpy float64, one
     explicit (n, n) covariance Cholesky per pulsar.
 
-    Builds C = N + U_ec diag(ecorr2) U_ec^T + U diag(phi) U^T from the
-    same :func:`gls_noise_model` components the Woodbury path consumes
-    — what this verifies is the ENTIRE rank-reduced evaluation
-    (analytic ECORR inversion, Woodbury quad/determinant, exact
+    The covariance comes from the ONE shared dense assembler
+    (:func:`~pta_replicator_tpu.covariance.structure.
+    dense_noise_covariance`) — C = N + U_ec diag(ecorr2) U_ec^T +
+    U diag(phi) U^T + s2 X, built from the same
+    :func:`gls_noise_model` components (and the same CovOp) the
+    Woodbury/structured paths consume, so the oracle and the engine
+    can never disagree about C. What this verifies is the ENTIRE
+    rank-reduced evaluation (analytic ECORR inversion, Woodbury quad/
+    determinant, the structured correlated-noise solve, exact
     timing-model marginalization), while the components themselves are
     validated against the enterprise-convention dense oracle in
     tests/test_batched.py. O(Nt^3): tests only.
     """
-    sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
-    sigma2 = np.asarray(sigma2, np.float64)
-    ecorr2 = None if ecorr2 is None else np.asarray(ecorr2, np.float64)
-    U = None if U is None else np.asarray(U, np.float64)
-    phi = None if phi is None else np.asarray(phi, np.float64)
+    from ..covariance.structure import dense_noise_covariance
+
+    C_all = dense_noise_covariance(batch, recipe)
     r_all = np.asarray(residuals, np.float64)
     mask = np.asarray(batch.mask)
-    epoch_index = np.asarray(batch.epoch_index)
     design = None if design is None else np.asarray(design, np.float64)
 
     out = np.zeros(batch.npsr)
@@ -427,16 +441,8 @@ def dense_loglikelihood(
         idx = np.nonzero(mask[p] > 0)[0]
         n = idx.size
         r = r_all[p, idx]
-        C = np.diag(sigma2[p, idx])
-        if ecorr2 is not None:
-            E = ecorr2.shape[1]
-            onehot = (
-                epoch_index[p, idx][:, None] == np.arange(E)[None, :]
-            ).astype(np.float64)
-            C = C + (onehot * ecorr2[p][None, :]) @ onehot.T
-        if U is not None:
-            Up = U[p][idx]
-            C = C + (Up * phi[p][None, :]) @ Up.T
+        C = C_all[p][np.ix_(idx, idx)]
+        # graftlint: disable=cov-f32-cholesky  # numpy-float64 oracle by construction (dense_noise_covariance returns f64)
         L = np.linalg.cholesky(C)
         half = np.linalg.solve(L, r)
         quad = float(half @ half)
@@ -453,6 +459,7 @@ def dense_loglikelihood(
             rL = half
             A = MnL.T @ MnL
             bm = MnL.T @ rL
+            # graftlint: disable=cov-f32-cholesky  # numpy-float64 oracle (design cast to f64 above)
             La = np.linalg.cholesky(A)
             zm = np.linalg.solve(La, bm)
             quad -= float(zm @ zm)

@@ -1111,6 +1111,18 @@ class Recipe:
     #: injected into pulsar ``transient_psr``
     transient_waveform: Optional[jax.Array] = None
     transient_grid: Optional[jax.Array] = None
+    #: structured beyond-diagonal correlated-noise block: a
+    #: covariance.structure CovOp (unit-normalized; a nested pytree, so
+    #: its arrays trace/shard like any other leaf). Sampled into every
+    #: realization from ``fold_in(key, COV_STREAM_FOLD)`` — NOT from a
+    #: widened family split, so enabling it leaves every existing
+    #: family's draws bit-identical — and priced by the GLS refit and
+    #: the GP likelihood through the generalized white_ecorr_solver.
+    noise_cov: Optional[object] = None
+    #: correlated-noise amplitude: the block's covariance is scaled by
+    #: 10^(2 cov_log10_sigma) (scalar or (Np,)). A flat Recipe leaf on
+    #: purpose: hyperparameter grids and map_fit address it by name.
+    cov_log10_sigma: Optional[jax.Array] = None
 
     tnequad: bool = field(metadata=dict(static=True), default=False)
     gwb_turnover: bool = field(metadata=dict(static=True), default=False)
@@ -1243,6 +1255,17 @@ def _validate_recipe(r: "Recipe"):
         "a power-law GWB needs gwb_gamma alongside gwb_log10_amplitude "
         "(or a gwb_user_spectrum, which overrides the power law)",
     )
+    need(
+        r.cov_log10_sigma is None or r.noise_cov is not None,
+        "cov_log10_sigma scales the correlated-noise block — set "
+        "noise_cov too (covariance.structure builders), or drop it",
+    )
+    need(
+        r.noise_cov is None or hasattr(r.noise_cov, "sample"),
+        "noise_cov must be a covariance.structure CovOp (or any object "
+        "with the matvec/solve/logdet/sample/dense contract), got "
+        f"{type(r.noise_cov).__name__}",
+    )
 
     cgw_shape = _leaf_shape(r.cgw_params)
     if cgw_shape is not None:
@@ -1281,7 +1304,13 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
     ``rows=(npsr_global, row_start)`` runs the stochastic draws as exact
     row windows of the global streams (pulsar-sharded SPMD — see
     :func:`_rows_draw`; the GWB handles its own globality through the
-    sharded ORF rows)."""
+    sharded ORF rows).
+
+    Stream contract: the 5-way split below is public (STREAM_VERSION;
+    the fuzz harness replays it). The correlated-noise block draws from
+    ``fold_in(key, covariance.COV_STREAM_FOLD)`` instead of a widened
+    split, so enabling it leaves every family's stream bit-identical
+    (pinned by tests/test_covariance.py)."""
     k_wn, k_ec, k_rn, k_chrom, k_gwb = jax.random.split(key, 5)
     total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
     if recipe.efac is not None or recipe.log10_equad is not None:
@@ -1346,6 +1375,13 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
             power=recipe.gwb_power,
             synthesis_precision=recipe.gwb_synthesis_precision,
         )
+    if recipe.noise_cov is not None:
+        from ..covariance.structure import COV_STREAM_FOLD, recipe_cov_s2
+
+        k_cov = jax.random.fold_in(key, COV_STREAM_FOLD)
+        total = total + recipe.noise_cov.sample(
+            k_cov, s2=recipe_cov_s2(recipe, total.dtype), rows=rows
+        ) * batch.mask
     return total
 
 
@@ -1486,7 +1522,8 @@ def gls_noise_model(batch: PulsarBatch, recipe: "Recipe"):
     return sigma2, ecorr2, U, phi
 
 
-def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype):
+def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype,
+                       extra=None, extra_s2=None):
     """The white+ECORR block C0 = N + U_ec diag(ecorr2) U_ec^T as an
     inverse-applicator plus its masked log-determinant — the analytic
     per-epoch Woodbury every consumer of the rank-reduced noise model
@@ -1494,15 +1531,47 @@ def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype):
     ``likelihood/gp.py``), so the two can never disagree about the C0
     algebra.
 
+    ``extra`` generalizes C0 beyond the diagonal: a structured
+    :mod:`~pta_replicator_tpu.covariance` CovOp (a Recipe's
+    ``noise_cov``) scaled by ``extra_s2`` joins the block,
+    C0 = N + ECORR + s2 X. The solve stays structured where the
+    structure allows it — a :class:`~pta_replicator_tpu.covariance.
+    structure.BandedCov` without ECORR folds the white diagonal into
+    its block-tridiagonal factor (O(Nt b^2)); every other combination
+    (Kronecker/dense/low-rank extras, or banded + ECORR) materializes
+    C0 once and pays one blocked dense Cholesky per evaluation — the
+    documented fallback rung of the solver ladder (docs/covariance.md).
+    With ``extra=None`` the path below is the original analytic
+    Woodbury, unchanged.
+
     Returns ``(winv, c0inv_mat, logdet_c0)``: the masked N^-1 diagonal
-    (Np, Nt), a map ``(Np, Nt, Q) -> (Np, Nt, Q)`` applying C0^-1, and
-    the (Np,) log-determinant over VALID TOAs only (padding rows, whose
-    sigma2 is zero, contribute nothing — they are excluded by the mask,
-    not priced at log 0). Epochs are disjoint, so U_ec^T N^-1 U_ec is
-    diagonal and both the solve and the determinant are exact with no
-    dense (Nt, E) one-hot ever materialized:
+    (Np, Nt) (the white diagonal's inverse even when ``extra`` is set —
+    callers use it for diagnostics only), a map ``(Np, Nt, Q) ->
+    (Np, Nt, Q)`` applying C0^-1, and the (Np,) log-determinant over
+    VALID TOAs only (padding rows, whose sigma2 is zero, contribute
+    nothing — they are excluded by the mask, not priced at log 0).
+    Epochs are disjoint, so U_ec^T N^-1 U_ec is diagonal and both the
+    solve and the determinant are exact with no dense (Nt, E) one-hot
+    ever materialized:
     log det C0 = sum_t log sigma2_t + sum_e log(1 + ecorr2_e s_e)."""
     winv = jnp.where(batch.mask > 0, 1.0 / sigma2, 0.0)  # N^-1 diagonal
+    if extra is not None:
+        from ..covariance.structure import (
+            BandedCov,
+            banded_combined_solver,
+            dense_combined_solver,
+        )
+
+        safe_sigma2 = jnp.where(batch.mask > 0, sigma2, 1.0)
+        if isinstance(extra, BandedCov) and ecorr2 is None:
+            c0inv_mat, logdet_c0 = banded_combined_solver(
+                extra, safe_sigma2, extra_s2, dtype
+            )
+        else:
+            c0inv_mat, logdet_c0 = dense_combined_solver(
+                batch, safe_sigma2, ecorr2, extra, extra_s2, dtype
+            )
+        return winv, c0inv_mat, logdet_c0
     psr_rows = jnp.arange(batch.npsr)[:, None]
 
     def seg_sum(x):
@@ -1547,10 +1616,16 @@ def _gls_design_system(batch: PulsarBatch, design, recipe: "Recipe",
     normal matrix A = N^-1 (M^T C^-1 M) N^-1 (+ ridge and padding-column
     unit rows), its normalization, and the C^-1 operator itself. Split
     out so :func:`gls_fit_uncertainties` prices the SAME system
-    gls_fit_subtract solves — the two can never drift apart."""
+    gls_fit_subtract solves — the two can never drift apart. A recipe
+    carrying a structured ``noise_cov`` block weights by it through
+    the generalized solver (the covariance-aware GLS path)."""
+    from ..covariance.structure import recipe_cov_s2
+
     sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
     _winv, c0inv_mat, _logdet = white_ecorr_solver(
-        batch, sigma2, ecorr2, dtype
+        batch, sigma2, ecorr2, dtype,
+        extra=recipe.noise_cov,
+        extra_s2=recipe_cov_s2(recipe, dtype),
     )
 
     design = jnp.asarray(design, dtype) * batch.mask[..., None]

@@ -213,7 +213,7 @@ def add_gwb(
             locs[i] = ra, np.pi / 2.0 - dec  # (phi, theta)
         orf = assemble_orf(locs, clm=clm, lmax=lmax)
 
-    M = np.linalg.cholesky(orf)
+    M = np.linalg.cholesky(np.asarray(orf, np.float64))
 
     nf = len(f)
     w = np.empty((npsr, nf), dtype=complex)

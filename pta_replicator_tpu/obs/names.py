@@ -72,6 +72,14 @@ SPAN_SCENARIO_COMPILE = "scenario_compile"
 #: (scenarios/fuzz.py run_scenario)
 SPAN_SCENARIO_FUZZ_CASE = "scenario_fuzz_case"
 
+# structured-covariance subsystem (covariance/)
+#: one eager structured solve through a CovOp (covariance/kernels.py
+#: solve_eager — the bench ladder / oracle-harness entry)
+SPAN_COV_SOLVE = "cov_solve"
+#: one eager correlated-noise draw through a CovOp (covariance/
+#: kernels.py sample_eager — the fuzz harness's batched-side entry)
+SPAN_COV_SAMPLE = "cov_sample"
+
 # CLI runner (the top-level span is the subcommand name)
 SPAN_CLI_REALIZE = "realize"
 SPAN_CLI_INFO = "info"
@@ -103,6 +111,7 @@ SPANS = frozenset({
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_LIKELIHOOD_BATCH, SPAN_LIKELIHOOD_SERVE, SPAN_LIKELIHOOD_PROJECT,
     SPAN_SCENARIO_COMPILE, SPAN_SCENARIO_FUZZ_CASE,
+    SPAN_COV_SOLVE, SPAN_COV_SAMPLE,
     SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_CLI_LIKELIHOOD,
     SPAN_CLI_SCENARIO,
     SPAN_INGEST, SPAN_BUILD_RECIPE,
@@ -189,6 +198,13 @@ LIKELIHOOD_DEADLINE_EXPIRED = "likelihood.deadline_expired"
 #: labeled site=/kind= — zero in any run that didn't arm a schedule
 FAULTS_INJECTED = "faults.injected"
 
+# structured-covariance layer (covariance/kernels.py eager helpers):
+# eager CovOp solves priced, and the running fraction of them that
+# took a structured (banded/Kronecker/blocked) path instead of the
+# dense reference — the ladder's adoption gauge
+COV_SOLVES = "cov.solves"
+COV_BLOCKED_FRACTION = "cov.blocked_fraction"
+
 # scenario layer (scenarios/): specs compiled, fuzz cases run,
 # batched-vs-oracle disagreements found (0 in a healthy tree), and
 # shrinker candidate evaluations spent minimizing failures
@@ -238,6 +254,7 @@ METRICS = frozenset({
     LIKELIHOOD_QUEUE_DEPTH, LIKELIHOOD_REJECTED,
     LIKELIHOOD_DEADLINE_EXPIRED,
     FAULTS_INJECTED,
+    COV_SOLVES, COV_BLOCKED_FRACTION,
     SCENARIO_COMPILED, SCENARIO_FUZZ_CASES,
     SCENARIO_FUZZ_DISAGREEMENTS, SCENARIO_SHRINK_STEPS,
     FLIGHTREC_STALLS,
@@ -272,6 +289,7 @@ PIPELINE_PREFIX = "pipeline."
 CW_STREAM_PREFIX = "cw_stream."
 LIKELIHOOD_PREFIX = "likelihood."
 FAULTS_PREFIX = "faults."
+COV_PREFIX = "cov."
 SCENARIO_PREFIX = "scenario."
 OCCUPANCY_PREFIX = "occupancy."
 OBS_PREFIX = "obs."
@@ -287,11 +305,15 @@ JIT_MESH_SHARDMAP_PSR_ENGINE = "mesh.shardmap_psr_engine"
 #: precompute; the serving engine) — likelihood/infer.py
 JIT_LIKELIHOOD_ENGINE = "likelihood.gp_engine"
 JIT_LIKELIHOOD_REDUCED_ENGINE = "likelihood.reduced_engine"
+#: blocked-Cholesky dense factor+solve engine (covariance/kernels.py
+#: dense_solve) — labelled so devprof cost/roofline accounting applies
+JIT_COV_CHOLESKY = "cov.blocked_cholesky"
 
 JIT_LABELS = frozenset({
     JIT_REALIZE_ENGINE, JIT_MESH_CONSTRAINT_ENGINE,
     JIT_MESH_SHARDMAP_ENGINE, JIT_MESH_SHARDMAP_PSR_ENGINE,
     JIT_LIKELIHOOD_ENGINE, JIT_LIKELIHOOD_REDUCED_ENGINE,
+    JIT_COV_CHOLESKY,
 })
 
 #: every registered name, for membership checks that don't care about kind

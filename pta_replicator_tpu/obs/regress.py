@@ -88,6 +88,12 @@ _HIGHER_BETTER_TOKENS = (
     # "per_s"/"rate" already match these leaves; spelled out so the
     # gate's contract for the series is explicit.
     "scenarios_per_s", "agreement_rate",
+    # COV solver ladder (benchmarks/cov_solve.py, ISSUE 13): the
+    # structured-vs-dense solve speedups per size arm. "speedup"
+    # already matches; spelled out so the gate's contract for the
+    # series is explicit (solve/factor times ride the *_ms lower-better
+    # suffix, oracle deviations ride "disagreement" below).
+    "speedup_banded", "speedup_kron",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves
@@ -133,6 +139,10 @@ _NO_DIRECTION_FRAGMENTS = (
     "jax.cost.", "flops_per_chunk", "duty", "intensity", "ridge",
     "wall_reduction_vs_serial", "attainable_speedup", "util_cores",
     ".samples", ".stride", "dropped_series",
+    # cov.blocked_fraction describes WHICH solver rung ran (a property
+    # of the workload mix), not a score — a dense-heavy bench round
+    # must not read as a regression
+    "blocked_fraction",
 )
 
 

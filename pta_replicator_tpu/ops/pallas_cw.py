@@ -428,6 +428,83 @@ def _cw_kernel(toas_ref, src_ref, psrc_ref, out_ref, *, psr_term, evolve):
     out_ref[:, :] = prev + partial
 
 
+# --------------------------------------------------------------------
+# Blocked-Cholesky trailing update (covariance/kernels.py)
+#
+# The O(n^3) bulk of a blocked Cholesky factorization is the SYRK
+# trailing update C <- C - L L^T after each panel factorization. The
+# covariance subsystem (covariance/kernels.py blocked_cholesky) tiles
+# that update explicitly for the MXU: the kernel below computes one
+# (T, T) output tile per grid program from two (T, b) panel slices —
+# pure batched matmul work, sources on the contraction axis. The
+# pure-XLA fallback in covariance/kernels.py runs the SAME
+# :func:`cov_tile_update` per tile, so on CPU (`interpret=True`) the
+# two backends are bit-identical by construction (pinned by
+# tests/test_covariance.py) — the same one-op-sequence discipline as
+# :func:`_term_response` above.
+
+def cov_tile_update(c, li, lj):
+    """One trailing-update tile: ``c - li @ lj^T`` over the panel's
+    contraction axis, batched over the leading pulsar axis. The ONE
+    implementation shared by the Pallas kernel and the XLA fallback —
+    backends must run the same op sequence to be comparable bit-level.
+    """
+    return c - jnp.einsum("pik,pjk->pij", li, lj, precision="highest")
+
+
+def _cov_syrk_kernel(c_ref, li_ref, lj_ref, out_ref):
+    # only the lower triangle is ever consumed downstream (the next
+    # step's diagonal-block cholesky reads its lower part, the panel is
+    # strictly lower, and blocked_cholesky tril()s the result) — so
+    # strictly-upper tiles pass through un-updated, halving the O(n^3)
+    # bulk; the XLA fallback skips the same tiles, keeping the two
+    # backends bit-identical
+    out_ref[...] = c_ref[...]
+
+    @pl.when(pl.program_id(1) <= pl.program_id(0))
+    def _update():
+        out_ref[...] = cov_tile_update(
+            c_ref[...], li_ref[...], lj_ref[...]
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def cov_syrk_update(C, L, tile: int = 128, interpret: bool = False):
+    """SYRK trailing update ``C - L @ L^T`` via the Pallas tile kernel.
+
+    ``C``: (Np, m, m) trailing matrix, ``L``: (Np, m, b) panel; ``m``
+    must be a multiple of ``tile`` (covariance/kernels.py pads the
+    factorization to the block grid, so this holds by construction).
+    ``interpret=True`` runs the kernel on CPU for tests.
+    """
+    npsr, m, _ = C.shape
+    b = L.shape[-1]
+    if m % tile:
+        raise ValueError(f"trailing dim {m} not a multiple of tile {tile}")
+    grid = (m // tile, m // tile)
+    mem = {} if _VMEM is None else dict(memory_space=_VMEM)
+    extra = {}
+    if pltpu is not None and not interpret:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        )
+    return pl.pallas_call(
+        _cov_syrk_kernel,
+        out_shape=jax.ShapeDtypeStruct((npsr, m, m), C.dtype),
+        grid=grid,
+        **extra,
+        in_specs=[
+            pl.BlockSpec((npsr, tile, tile), lambda i, j: (0, i, j), **mem),
+            pl.BlockSpec((npsr, tile, b), lambda i, j: (0, i, 0), **mem),
+            pl.BlockSpec((npsr, tile, b), lambda i, j: (0, j, 0), **mem),
+        ],
+        out_specs=pl.BlockSpec(
+            (npsr, tile, tile), lambda i, j: (0, i, j), **mem
+        ),
+        interpret=interpret,
+    )(C, L, L)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(

@@ -52,6 +52,7 @@ FAMILY_IDS = {
     "memory": 9,
     "transient": 10,
     "realize": 11,
+    "covariance": 12,
 }
 
 
@@ -169,6 +170,13 @@ def spec_families(spec: ScenarioSpec) -> Tuple[str, ...]:
     if spec.transient is not None:
         out.append("glitch" if spec.transient.get("kind") == "glitch"
                    else "transient")
+    if spec.covariance is not None:
+        kind = spec.covariance.get(
+            "kind",
+            "kron" if spec.covariance.get("preset") == "solar_wind"
+            else "banded",
+        )
+        out.append("cov_" + kind)
     return tuple(out)
 
 
@@ -200,7 +208,7 @@ def _orf_cholesky(orf, batch, path: str = "orf") -> Optional[np.ndarray]:
     else:
         mat = assemble_orf(locs, lmax=0)
     try:
-        return np.linalg.cholesky(mat)
+        return np.linalg.cholesky(np.asarray(mat, np.float64))
     except np.linalg.LinAlgError:
         # clm counts are validated statically, but PD-ness of the
         # assembled matrix depends on the values AND the drawn sky
@@ -461,6 +469,55 @@ def _compile_inner(spec, jnp, synthetic_batch, Recipe, dtype):
         kwargs["transient_psr"] = int(spec.transient.get("psr", 0))
         drawn["transient_t0"] = t0
 
+    if spec.covariance is not None:
+        from ..constants import DAY_IN_SEC
+        from ..covariance import (
+            banded_from_times,
+            dense_from_times,
+            kron_time_channel,
+        )
+
+        rng = family_rng(spec.seed, "covariance")
+        cd = dict(spec.covariance)
+        if cd.get("preset") == "solar_wind":
+            # the chromatic solar-wind shape: correlation across
+            # epochs (x) correlation across the observing band
+            base = {"kind": "kron", "log10_sigma": -6.6, "channels": 4,
+                    "time_ell_days": 20.0, "chan_rho": 0.9,
+                    "nugget": 0.05}
+            base.update({k: v for k, v in cd.items() if k != "preset"})
+            cd = base
+        kind = cd["kind"]
+        # draw order: log10_sigma first, then the structure parameters
+        kwargs["cov_log10_sigma"] = per_psr(rng, cd["log10_sigma"])
+        toas = np.asarray(batch.toas_s, np.float64)
+        mask = np.asarray(batch.mask, np.float64)
+        cdtype = batch.toas_s.dtype
+        if kind == "banded":
+            rho = float(_draw(rng, cd.get("rho", 0.5)))
+            corr_d = float(_draw(rng, cd.get("corr_days", 30.0)))
+            op = banded_from_times(
+                toas, mask, rho=rho, corr_s=corr_d * DAY_IN_SEC,
+                block=int(cd.get("block", 16)), dtype=cdtype,
+            )
+        elif kind == "kron":
+            ell_d = float(_draw(rng, cd.get("time_ell_days", 20.0)))
+            chan_rho = float(_draw(rng, cd.get("chan_rho", 0.8)))
+            op = kron_time_channel(
+                toas, channels=int(cd.get("channels", 4)),
+                time_ell_s=ell_d * DAY_IN_SEC, chan_rho=chan_rho,
+                nugget=float(cd.get("nugget", 0.05)), dtype=cdtype,
+                mask=mask,
+            )
+        else:
+            corr_d = float(_draw(rng, cd.get("corr_days", 30.0)))
+            op = dense_from_times(
+                toas, mask, corr_s=corr_d * DAY_IN_SEC,
+                nugget=float(cd.get("nugget", 0.05)), dtype=cdtype,
+            )
+        kwargs["noise_cov"] = op
+        drawn["covariance_kind"] = kind
+
     recipe = Recipe(**kwargs)
 
     sw = dict(spec.sweep or {})
@@ -621,7 +678,7 @@ def flagship_workload(npsr: int = 68, ntoa: int = 7758, nbackend: int = 4,
         "log10_ecorr": rng.uniform(-7.5, -6.3, (npsr, nbackend)),
         "rn_log10_amplitude": rng.uniform(-14.5, -13.0, npsr),
         "rn_gamma": rng.uniform(2.0, 5.0, npsr),
-        "orf_cholesky": np.linalg.cholesky(np.asarray(orf)),
+        "orf_cholesky": np.linalg.cholesky(np.asarray(orf, np.float64)),
     }
     recipe = Recipe(
         efac=jnp.asarray(draws["efac"]),

@@ -30,6 +30,9 @@ cw             3e-3      f32 sin(2*phase) after the f64 plane fold
 burst          1e-3      f32 grid interpolation
 memory         1e-3      f32 ramp arithmetic
 transient      1e-3      f32 grid interpolation (single pulsar)
+covariance     1e-3      f32 structured sampling map vs f64 dense Cholesky
+                         replay of the same z (factors identical by
+                         uniqueness; observed ~1e-6)
 total          1e-3      engine (jit-fused) realization vs summed oracle
 =============  ========  ====================================================
 
@@ -70,6 +73,11 @@ FAMILY_TOLERANCES = {
     "burst": 1e-3,
     "memory": 1e-3,
     "transient": 1e-3,
+    # structured correlated noise: f32 (host-f64-factored) sampling map
+    # vs the f64 dense-Cholesky oracle under the same z draw — the
+    # factor algebra is exact (Cholesky uniqueness), so only the f32
+    # matmul/cast rounding remains
+    "covariance": 1e-3,
     "total": 1e-3,
 }
 
@@ -178,6 +186,14 @@ def batched_family_delays(compiled: CompiledScenario) -> Dict[str, np.ndarray]:
             batch, recipe.transient_psr, recipe.transient_waveform,
             recipe.transient_grid[0], recipe.transient_grid[1],
         ))
+    if recipe.noise_cov is not None:
+        from ..covariance import kernels as covk
+        from ..covariance.structure import COV_STREAM_FOLD, recipe_cov_s2
+
+        k_cov = jax.random.fold_in(key, COV_STREAM_FOLD)
+        out["covariance"] = np.asarray(covk.sample_eager(
+            recipe.noise_cov, k_cov, s2=recipe_cov_s2(recipe)
+        )) * np.asarray(batch.mask)
     return out
 
 
@@ -446,6 +462,28 @@ def oracle_family_delays(compiled: CompiledScenario) -> Dict[str, np.ndarray]:
         block = np.zeros_like(toas)
         block[p] = row
         out["transient"] = block
+
+    if recipe.noise_cov is not None:
+        # the structured sampling map vs a dense f64 Cholesky of the
+        # SAME covariance under the SAME z draw: Cholesky factors are
+        # unique, so the block-tridiagonal / Kronecker-factored L *is*
+        # the dense L and any disagreement is a code bug, not algebra
+        from ..covariance.structure import COV_STREAM_FOLD, recipe_cov_s2
+
+        k_cov = jax.random.fold_in(key, COV_STREAM_FOLD)
+        z = np.asarray(
+            jax.random.normal(k_cov, (npsr, ntoa), dtype), np.float64
+        )
+        C = recipe.noise_cov.dense(pad_identity=True)
+        s2 = recipe_cov_s2(recipe)
+        s2 = 1.0 if s2 is None else np.asarray(s2, np.float64)
+        amp = np.sqrt(np.broadcast_to(s2, (npsr,)))
+        rows = []
+        for p in range(npsr):
+            # graftlint: disable=cov-f32-cholesky  # numpy-float64 oracle replay (dense() returns f64)
+            L = np.linalg.cholesky(C[p])
+            rows.append(L @ z[p])
+        out["covariance"] = np.stack(rows) * amp[:, None] * mask
     return out
 
 
@@ -694,9 +732,29 @@ def sample_spec(root_seed: int, index: int) -> ScenarioSpec:
             "t0_frac": val(0.2, 0.8), "width_frac": val(0.02, 0.1),
             "ngrid": 128,
         }
+    if maybe(0.4):
+        kind = ["banded", "kron", "dense"][int(rng.integers(3))]
+        c: dict = {"kind": kind, "log10_sigma": val(-7.0, -6.2)}
+        if kind == "banded":
+            c["rho"] = val(0.2, 0.8)
+            c["corr_days"] = val(10.0, 60.0)
+            c["block"] = int(rng.choice([8, 16]))
+        elif kind == "kron":
+            if maybe(0.3):
+                # the preset route (defaults + the drawn amplitude)
+                c = {"preset": "solar_wind",
+                     "log10_sigma": val(-7.0, -6.2)}
+            else:
+                c["channels"] = int(rng.choice([2, 4]))
+                c["time_ell_days"] = val(5.0, 40.0)
+                c["chan_rho"] = val(0.3, 0.9)
+        else:
+            c["corr_days"] = val(10.0, 60.0)
+        d["covariance"] = c
     if not any(k in d for k in
                ("white", "ecorr", "red", "chromatic", "gwb",
-                "population", "cw", "burst", "memory", "transient")):
+                "population", "cw", "burst", "memory", "transient",
+                "covariance")):
         d["white"] = {"efac": 1.1}
     if maybe(0.4):
         d["sweep"] = {"nreal": 4, "chunk": 2,
@@ -712,7 +770,8 @@ def _shrink_candidates(d: dict) -> List[dict]:
     within sections. Every candidate is a fresh dict."""
     out = []
     droppable = ("population", "cw", "gwb", "chromatic", "red", "ecorr",
-                 "white", "burst", "memory", "transient", "sweep")
+                 "white", "burst", "memory", "transient", "covariance",
+                 "sweep")
     present = [s for s in droppable if s in d]
     for sec in present:
         if sec != "sweep" and len([
@@ -736,6 +795,8 @@ def _shrink_candidates(d: dict) -> List[dict]:
         ("cw", "nsrc", 1),
         ("population", "n_binaries", 50),
         ("population", "outlier_per_bin", 1),
+        ("covariance", "block", 8),
+        ("covariance", "channels", 2),
     ):
         if sec in d and d[sec].get(key) not in (None, simple):
             c = json.loads(json.dumps(d))

@@ -399,6 +399,90 @@ def _v_transient(d: dict, spec):
         )
 
 
+#: structure-specific covariance keys, by kind (shared keys aside)
+_COV_KIND_KEYS = {
+    "banded": {"rho", "corr_days", "block"},
+    "kron": {"channels", "time_ell_days", "chan_rho", "nugget"},
+    "dense": {"corr_days", "nugget"},
+}
+_COV_PRESETS = ("solar_wind",)
+
+
+def _v_covariance(d: dict, spec):
+    """Beyond-diagonal correlated-noise section: a structured CovOp
+    (banded inter-epoch / Kronecker time-channel / dense temporal)
+    sampled into every realization and priced by the covariance-aware
+    GLS/likelihood paths (docs/covariance.md)."""
+    _check_keys("covariance", d, {
+        "kind", "preset", "log10_sigma",
+        *_COV_KIND_KEYS["banded"], *_COV_KIND_KEYS["kron"],
+        *_COV_KIND_KEYS["dense"],
+    })
+    preset = d.get("preset")
+    if preset is not None and preset not in _COV_PRESETS:
+        raise SpecError(
+            f"covariance.preset must be one of {list(_COV_PRESETS)}, "
+            f"got {preset!r}"
+        )
+    kind = d.get("kind", "kron" if preset == "solar_wind" else None)
+    if kind not in _COV_KIND_KEYS:
+        raise SpecError(
+            'covariance.kind must be "banded", "kron", or "dense" '
+            f"(or use preset: solar_wind), got {kind!r}"
+        )
+    if preset == "solar_wind" and kind != "kron":
+        raise SpecError(
+            "covariance.kind: the solar_wind preset IS the Kronecker "
+            "time-channel structure; drop kind or set it to kron"
+        )
+    if "log10_sigma" not in d and preset is None:
+        raise SpecError("covariance: needs log10_sigma (the correlated-"
+                        "noise amplitude; presets carry a default)")
+    if "log10_sigma" in d:
+        _check_value("covariance.log10_sigma", d["log10_sigma"],
+                     lo=-12.0, hi=0.0, allow_list=True)
+        _check_psr_list("covariance.log10_sigma", d["log10_sigma"], spec)
+    wrong = set(d) & set().union(*(
+        v for k, v in _COV_KIND_KEYS.items() if k != kind
+    )) - _COV_KIND_KEYS[kind]
+    if wrong:
+        raise SpecError(
+            f"covariance: key(s) {sorted(wrong)} do not apply to kind "
+            f"{kind!r} (accepted: {sorted(_COV_KIND_KEYS[kind])})"
+        )
+    if "rho" in d:
+        _check_value("covariance.rho", d["rho"], lo=0.0, hi=0.95)
+    if "corr_days" in d:
+        _check_value("covariance.corr_days", d["corr_days"], lo=0.1,
+                     hi=10000.0)
+    if "block" in d:
+        _check_int("covariance.block", d["block"], lo=2, hi=256)
+    if "channels" in d:
+        _check_int("covariance.channels", d["channels"], lo=2, hi=64)
+    if kind == "kron":
+        # the divisibility contract must hold for the DEFAULT channel
+        # count too (the solar_wind preset's 4), not just an explicit
+        # key — a miss here must be a named SpecError at validate
+        # time, never a raw compile-time ValueError
+        channels = d.get("channels", 4)
+        ntoa = (spec.array or {}).get("ntoa", 256)
+        if isinstance(ntoa, int) and isinstance(channels, int) \
+                and ntoa % channels:
+            raise SpecError(
+                f"covariance.channels = {channels} must divide "
+                f"array.ntoa = {ntoa} (the Kronecker structure needs a "
+                "full (epochs x channels) TOA grid)"
+            )
+    if "time_ell_days" in d:
+        _check_value("covariance.time_ell_days", d["time_ell_days"],
+                     lo=0.1, hi=10000.0)
+    if "chan_rho" in d:
+        _check_value("covariance.chan_rho", d["chan_rho"], lo=0.0,
+                     hi=0.95)
+    if "nugget" in d:
+        _check_value("covariance.nugget", d["nugget"], lo=1e-4, hi=1.0)
+
+
 def _v_sweep(d: dict, spec):
     _check_keys("sweep", d, {"nreal", "chunk", "pipeline_depth", "fit"})
     nreal = d.get("nreal", 16)
@@ -430,6 +514,7 @@ SECTIONS = {
     "burst": _v_burst,
     "memory": _v_memory,
     "transient": _v_transient,
+    "covariance": _v_covariance,
     "sweep": _v_sweep,
 }
 
@@ -473,6 +558,7 @@ class ScenarioSpec:
     burst: Optional[dict] = None
     memory: Optional[dict] = None
     transient: Optional[dict] = None
+    covariance: Optional[dict] = None
     sweep: Optional[dict] = None
 
     # ------------------------------------------------------- validation

@@ -78,6 +78,7 @@ def gls_fit(residuals_s, cov, M, xp=np, jitter: float = 0.0,
     r = xp.asarray(residuals_s)
     n = r.shape[-1]
     C = xp.asarray(cov) + jitter * xp.eye(n)
+    # graftlint: disable=cov-f32-cholesky  # xp-generic solver: the default xp=np oracle path is float64 end to end; device (f32) use is validated against that oracle in tests/test_gls_direct.py
     L = xp.linalg.cholesky(C)
     # whiten by solving L x = v
     Mw = xp.linalg.solve(L, M)
@@ -359,6 +360,37 @@ def covariance_from_recipe(
             chrom_ref_freq_mhz=recipe.chrom_ref_freq_mhz,
             freqs_mhz=psr.toas.freqs_mhz,
         )
+    extra_cov = None
+    if getattr(recipe, "noise_cov", None) is not None:
+        # structured beyond-diagonal block: the CovOp's own dense f64
+        # oracle, scaled by the recipe amplitude and selected for this
+        # pulsar. Valid when the op was built on this pulsar's TOA grid
+        # (the scenario compiler's case — it builds ops from the same
+        # synthetic batch the oracle pulsars mirror); ragged oracle
+        # pulsars slice the leading TOA window.
+        from ..covariance.structure import recipe_cov_s2
+
+        dense_all = _np.asarray(
+            recipe.noise_cov.dense(pad_identity=False), _np.float64
+        )
+        if psr_index is None and dense_all.shape[0] != 1:
+            # same resolve-exactly-never-average contract as row():
+            # the structured block is inherently per-pulsar
+            raise ValueError(
+                "recipe carries a per-pulsar noise_cov block; pass "
+                "psr_index (the pulsar's row in the CovOp)"
+            )
+        p = psr_index if psr_index is not None else 0
+        dense = dense_all[p]
+        s2 = recipe_cov_s2(recipe)
+        if s2 is not None:
+            s2 = _np.asarray(s2, _np.float64)
+            s2 = float(s2) if s2.ndim == 0 else float(s2[p])
+        else:
+            s2 = 1.0
+        nt = len(mjds)
+        extra_cov = s2 * dense[:nt, :nt]
+
     gwb_spectrum = None
     if (
         getattr(recipe, "gwb_log10_amplitude", None) is not None
@@ -382,7 +414,7 @@ def covariance_from_recipe(
                 else np.asarray(recipe.gwb_user_spectrum)
             ),
         )
-    return noise_covariance(
+    C = noise_covariance(
         psr.toas.errors_s,
         efac=efac,
         equad_s=equad,
@@ -399,3 +431,6 @@ def covariance_from_recipe(
         gwb_nmodes=getattr(recipe, "gwb_gls_nmodes", 30),
         xp=xp,
     )
+    if extra_cov is not None:
+        C = C + xp.asarray(extra_cov)
+    return C

@@ -33,9 +33,20 @@ echo "== scenario fuzz (fast arm: batched vs oracle differential) =="
 # 8 generated scenarios at a fixed seed through the batched-vs-oracle
 # differential (scenarios/fuzz.py), incl. the pipelined-vs-sync sweep
 # byte-identity arm on every 4th — exit 1 on any disagreement.
+# At seed 0 the first 8 scenarios exercise all three correlated-noise
+# covariance kinds (banded/kron/dense) against the dense f64 oracle,
+# so the beyond-diagonal family is differentially gated on every push.
 # Seconds-scale, fixture-free, CPU-only (docs/scenarios.md).
 JAX_PLATFORMS=cpu python -m pta_replicator_tpu scenario fuzz --fast \
     > /dev/null
+
+echo "== covariance solver ladder (fast arm) =="
+# the fast arm of benchmarks/cov_solve.py: structured (banded/
+# Kronecker) solves vs dense Cholesky + every CovOp pinned <= 1e-8 to
+# its f64 dense oracle + the inject->map_fit round trip within 3
+# Fisher sigma (exit 1 on any gate miss). Seconds-scale, fixture-free,
+# CPU-only (docs/covariance.md).
+JAX_PLATFORMS=cpu python benchmarks/cov_solve.py --fast > /dev/null
 
 echo "== chaos smoke (seeded faults, byte-identity gate) =="
 # the fast arm of benchmarks/chaos_sweep.py: one seeded schedule

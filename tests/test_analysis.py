@@ -938,3 +938,74 @@ def test_swallowed_exception_suppression_and_scope(tmp_path):
         rules_robust.RULES,
     )
     assert outside == []
+
+
+# ----------------------------------------------------------- cov pack
+
+def test_cov_f32_cholesky_fires_on_caller_dtype_factor(tmp_path):
+    """cov-f32-cholesky: cholesky/solve_triangular at the caller's
+    dtype in package code fires, one finding per call site."""
+    from pta_replicator_tpu.analysis import rules_cov
+
+    src = """
+        import jax.numpy as jnp
+        from jax.scipy.linalg import solve_triangular
+
+        def factor(C, b):
+            L = jnp.linalg.cholesky(C)
+            return solve_triangular(L, b, lower=True)
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"pta_replicator_tpu/covariance/bad.py": src},
+        rules_cov.RULES,
+    )
+    assert rule_ids(findings) == ["cov-f32-cholesky"] * 2
+
+
+def test_cov_f32_cholesky_non_firing_shapes(tmp_path):
+    """Non-firing: an explicit float64 cast inside the call, a
+    dtype=np.float64 operand, a suppression on the call line or the
+    line above, and anything outside the package (tests/benchmarks)."""
+    from pta_replicator_tpu.analysis import rules_cov
+
+    src = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def ok(C, D, E):
+            a = np.linalg.cholesky(np.asarray(C, np.float64))
+            b = jnp.linalg.cholesky(D.astype(np.float64))
+            c = jnp.linalg.cholesky(E)  # graftlint: disable=cov-f32-cholesky  # serving path validated vs oracle
+            # graftlint: disable=cov-f32-cholesky  # reason on the line above
+            d = jnp.linalg.cholesky(E)
+            return a, b, c, d
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"pta_replicator_tpu/covariance/good.py": src},
+        rules_cov.RULES,
+    )
+    assert findings == []
+
+    outside, _ = lint_tree(
+        tmp_path, {
+            "tests/test_x.py": "import numpy as np\n"
+                               "L = np.linalg.cholesky([[1.0]])\n",
+            "benchmarks/b.py": "import numpy as np\n"
+                               "L = np.linalg.cholesky([[1.0]])\n",
+        },
+        rules_cov.RULES,
+    )
+    assert outside == []
+
+
+def test_cov_f32_cholesky_clean_on_real_tree():
+    """The shipped tree carries no unsuppressed caller-dtype
+    factorizations (the empty-baseline-delta satellite)."""
+    from pta_replicator_tpu.analysis import rules_cov
+
+    pkg = os.path.join(REPO, "pta_replicator_tpu")
+    found = engine.iter_python_files([pkg], str(REPO))
+    mods, problems = engine.parse_modules(found, str(REPO))
+    active, _ = engine.run_rules(mods, rules_cov.RULES)
+    assert problems == []
+    assert [f for f in active] == []
