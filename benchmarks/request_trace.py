@@ -1,0 +1,527 @@
+"""Request-trace + SLO evidence bench: causal tracing proven stitched,
+error budgets proven scored, overhead proven negligible.
+
+The causal-tracing PR (docs/tracing.md) is only worth committing if a
+chaos-loaded run demonstrably yields COMPLETE traces — so this bench
+drives the two traced production paths under capture and parses the
+evidence back out of events.jsonl:
+
+* **serving arm** — a bounded/deadline'd ``LikelihoodServer`` flooded
+  past capacity from concurrent clients, with a seeded transient
+  engine flap (``likelihood_batch:raise@call=1``) absorbed by the
+  in-place retry (gated: the ``faults.retry`` event must appear in the
+  capture). Gates: every SERVED request's trace stitches
+  submit -> queue-wait -> (a ``likelihood_batch`` span linking its
+  trace_id) -> resolution; every REJECTED/EXPIRED request leaves its
+  trace_id in the stamped exception message AND a matching
+  ``likelihood.rejected``/``likelihood.deadline_expired`` event; the
+  SLO engine scored both configured objectives and the saturation arm
+  fired ``slo.breach``; the merged timeline renders the request
+  traces as chrome flow arrows (``trace_flow_events > 0``).
+* **sweep arm** — a pipelined sweep under ``drain:raise@chunk=1`` with
+  supervised recovery. Gates: every chunk's trace carries dispatch +
+  drain + io_write; the retried chunk's trace holds BOTH dispatch
+  attempts (trace ids derive from (checkpoint path, chunk), so the
+  retry re-joins the same trace) plus a trace-stamped ``faults.retry``
+  event.
+* **overhead arm** — the tracing machinery's cost per span measured
+  directly (K spans with vs without a live TraceContext, same tracer,
+  no sink), scaled by the spans-per-chunk the sweep actually emits,
+  against the measured wall of one flagship-shaped realize step.
+  Gate: < 1% (``RT_OVERHEAD_GATE``). Measured this way — rather than
+  A/B-ing two whole sweeps — because the context cost is nanoseconds
+  against a multi-second step: a wall-clock A/B would be 100% noise.
+
+Prints one JSON line (committed as ``TRACE_r14_cpu.json``); exit 1 on
+any gate miss, with the reasons on stderr (stdout is routinely
+/dev/null'd in CI — the PR 12/13 lesson).
+
+Usage: python benchmarks/request_trace.py [--fast] [--out PATH]
+  env: RT_REQUESTS / RT_NPSR / RT_NTOA / RT_NREAL_BANK / RT_SWEEP_NREAL
+       / RT_SWEEP_CHUNK / RT_STEP_NPSR / RT_STEP_NTOA / RT_STEP_CHUNK
+       reshape the workload (--fast presets a seconds-scale CI arm).
+"""
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from pta_replicator_tpu import likelihood as lk  # noqa: E402
+from pta_replicator_tpu import obs  # noqa: E402
+from pta_replicator_tpu.batch import synthetic_batch  # noqa: E402
+from pta_replicator_tpu.faults import inject  # noqa: E402
+from pta_replicator_tpu.faults.retry import RetryPolicy  # noqa: E402
+from pta_replicator_tpu.models.batched import Recipe, realize  # noqa: E402
+from pta_replicator_tpu.obs import names  # noqa: E402
+from pta_replicator_tpu.obs.timeline import build_timeline  # noqa: E402
+from pta_replicator_tpu.obs.trace import (  # noqa: E402
+    Tracer,
+    adopt,
+    chunk_trace_context,
+    new_trace_context,
+)
+from pta_replicator_tpu.utils.provenance import (  # noqa: E402
+    EVIDENCE_SCHEMA_VERSION,
+    provenance_stamp,
+)
+from pta_replicator_tpu.utils.sweep import sweep  # noqa: E402
+
+#: tracing-overhead gate: the trace-context machinery must cost < 1%
+#: of the flagship CPU step
+RT_OVERHEAD_GATE = 0.01
+
+#: the serving arm's SLO objectives: a latency objective the loaded
+#: server can mostly meet, and an availability objective the
+#: saturation flood is GUARANTEED to breach — admitted-but-expired
+#: requests are a sub-stream of likelihood.requests (the BAD ⊆ TOTAL
+#: contract), and the 50 ms deadline against a flooded 8-deep queue
+#: expires far more than the 1% allowance — so the bench proves both
+#: the scoring and the breach path
+SLO_SPEC = (
+    "serve=likelihood_batch:p99_ms<=500@95%;"
+    "admit=err(likelihood.deadline_expired/likelihood.requests)@99.5%"
+)
+
+RETRY_POLICY = RetryPolicy(max_attempts=5, base_delay_s=0.05,
+                           multiplier=2.0, max_delay_s=0.5, jitter=0.25)
+
+
+def _load_events(capture_dir):
+    events = []
+    with open(os.path.join(capture_dir, "events.jsonl")) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return events
+
+
+def _trace_spans(events):
+    """{trace_id: [span name, ...]} over the span records."""
+    out = {}
+    for rec in events:
+        if rec.get("type") == "span" and "trace_id" in rec:
+            out.setdefault(rec["trace_id"], []).append(rec["name"])
+    return out
+
+
+def _batch_links(events):
+    """Every trace_id named in a likelihood_batch span's links field."""
+    linked = set()
+    for rec in events:
+        if rec.get("type") == "span" and \
+                rec.get("name") == names.SPAN_LIKELIHOOD_BATCH:
+            linked.update(rec.get("links") or [])
+    return linked
+
+
+def run_serving_arm(n_requests, npsr, ntoa, nreal_bank, failures):
+    """The chaos-loaded server under capture; returns the evidence
+    block and appends gate misses to ``failures``."""
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=3)
+    recipe = Recipe(
+        efac=jnp.ones(npsr),
+        rn_log10_amplitude=jnp.full(npsr, -13.5),
+        rn_gamma=jnp.full(npsr, 4.0),
+    )
+    bank = np.asarray(
+        realize(jax.random.PRNGKey(7), batch, recipe, nreal=nreal_bank)
+    )
+    d = tempfile.mkdtemp(prefix="request_trace_serve_")
+    obs.start_capture(d, heartbeat_interval_s=0.05, stall_timeout_s=None,
+                      slo=SLO_SPEC)
+    served, rejected_msgs, expired_msgs = [], [], []
+    futs_lock = threading.Lock()
+    try:
+        server = lk.LikelihoodServer(
+            lk.RealizationBank.from_array(bank), batch, recipe,
+            axes=("rn_log10_amplitude",),
+            max_batch=4, max_delay_s=0.002,
+            max_queue=8, request_deadline_s=0.05,
+        )
+        futs = []
+
+        def flood(lo, hi):
+            rng = np.random.default_rng(lo)
+            for _ in range(lo, hi):
+                try:
+                    f = server.submit(
+                        rn_log10_amplitude=float(
+                            rng.uniform(-14.5, -13.0))
+                    )
+                except lk.ServerSaturated as exc:
+                    rejected_msgs.append(str(exc))
+                    continue
+                with futs_lock:
+                    futs.append(f)
+
+        with server:
+            server.evaluate(rn_log10_amplitude=-13.5)  # compile
+            server.reset_stats()
+            with inject.armed(
+                f"{inject.SITE_SERVER_ENGINE}:raise@call=1", seed=1
+            ):
+                # flood phase: 4 threads slam the bounded queue; the
+                # first engine call under the schedule is a transient
+                # flap the in-place retry absorbs
+                bounds = [k * n_requests // 4 for k in range(5)]
+                threads = [
+                    threading.Thread(target=flood,
+                                     args=(bounds[k], bounds[k + 1]))
+                    for k in range(4)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            # let the flood's queued tail drain before the expiry
+            # phase (its submits must not be shed by admission control)
+            wait_until = time.monotonic() + 30.0
+            while time.monotonic() < wait_until and server._pending:
+                time.sleep(0.01)
+            # deadline-expiry phase (deterministic): stall the next
+            # engine batch well past the 50 ms request deadline, then
+            # queue requests behind it — they expire while the worker
+            # is held inside the stalled batch, whatever the host's
+            # speed. This is what pushes the admit objective past its
+            # 0.5% allowance (the breach-path evidence).
+            with inject.armed(
+                f"{inject.SITE_SERVER_ENGINE}:stall=0.4@call=1", seed=2
+            ):
+                futs.append(server.submit(rn_log10_amplitude=-13.5))
+                time.sleep(0.05)  # the worker enters the stalled batch
+                for k in range(6):
+                    futs.append(server.submit(
+                        deadline_s=0.05,
+                        rn_log10_amplitude=-13.5 - 0.01 * k,
+                    ))
+        stats = server.stats()
+        for f in futs:
+            if not f.done():
+                failures.append("serving: stranded future after stop()")
+                continue
+            exc = f.exception()
+            if exc is None:
+                served.append(f.trace_id)
+            elif isinstance(exc, lk.DeadlineExpired):
+                expired_msgs.append((f.trace_id, str(exc)))
+            else:
+                failures.append(f"serving: unexpected failure {exc!r}")
+        # let the sampler tick at least once after the load so the
+        # availability objective's counter deltas land in its window
+        time.sleep(0.4)
+        slo_doc = None
+        slo_path = os.path.join(d, "slo.json")
+        if os.path.exists(slo_path):
+            with open(slo_path) as fh:
+                slo_doc = json.load(fh)
+    finally:
+        obs.finish_capture()
+
+    events = _load_events(d)
+    spans = _trace_spans(events)
+    linked = _batch_links(events)
+    stitched = 0
+    for tid in served:
+        got = spans.get(tid, [])
+        ok = (
+            names.SPAN_LIKELIHOOD_SUBMIT in got
+            and names.SPAN_LIKELIHOOD_QUEUE_WAIT in got
+            and names.SPAN_LIKELIHOOD_RESOLVE in got
+            and tid in linked
+        )
+        if ok:
+            stitched += 1
+        else:
+            failures.append(
+                f"serving: request {tid} trace incomplete: spans={got}"
+                f" linked={tid in linked}"
+            )
+    # shed requests are greppable by exactly their stamped trace id
+    event_traces = {
+        name: {
+            rec.get("trace_id") for rec in events
+            if rec.get("type") == "event" and rec.get("name") == name
+        }
+        for name in (names.EVENT_LIKELIHOOD_REJECTED,
+                     names.EVENT_LIKELIHOOD_DEADLINE_EXPIRED)
+    }
+    for msg in rejected_msgs:
+        tid = msg.rsplit("(trace ", 1)[-1].rstrip(")")
+        if tid not in event_traces[names.EVENT_LIKELIHOOD_REJECTED] or \
+                names.SPAN_LIKELIHOOD_SUBMIT not in spans.get(tid, []):
+            failures.append(
+                f"serving: rejected request {tid} not greppable "
+                "(no stamped event/submit span)"
+            )
+    for tid, msg in expired_msgs:
+        if f"(trace {tid})" not in msg:
+            failures.append(
+                f"serving: DeadlineExpired message not stamped: {msg!r}"
+            )
+        if tid not in event_traces[
+            names.EVENT_LIKELIHOOD_DEADLINE_EXPIRED
+        ]:
+            failures.append(
+                f"serving: expired request {tid} has no stamped event"
+            )
+    if not rejected_msgs:
+        failures.append("serving: flood produced no ServerSaturated")
+    breaches = sum(
+        1 for rec in events
+        if rec.get("type") == "event"
+        and rec.get("name") == names.EVENT_SLO_BREACH
+    )
+    # the armed engine flap must actually have been absorbed: count
+    # the serve-scope faults.retry events the in-place retry emitted
+    # (a hardcoded claim would survive the schedule silently not
+    # firing — the evidence must come from the capture)
+    engine_retries = sum(
+        1 for rec in events
+        if rec.get("type") == "event"
+        and rec.get("name") == names.EVENT_FAULT_RETRY
+        and (rec.get("attrs") or {}).get("scope") == "serve"
+    )
+    if engine_retries < 1:
+        failures.append(
+            "serving: the armed transient engine flap left no "
+            "faults.retry event — the retry path was not exercised"
+        )
+    if slo_doc is None or set(slo_doc.get("objectives", {})) != \
+            {"serve", "admit"}:
+        failures.append(f"serving: slo.json incomplete: {slo_doc!r}")
+    elif "admit" not in (slo_doc.get("breached") or []) or not breaches:
+        failures.append(
+            "serving: the saturation flood did not breach the admit "
+            f"objective (breached={slo_doc.get('breached')}, "
+            f"breach events={breaches})"
+        )
+    timeline = build_timeline(d)
+    trace_flows = timeline["otherData"]["trace_flow_events"]
+    if not trace_flows:
+        failures.append("serving: timeline rendered no trace flow events")
+    return {
+        "requests": n_requests,
+        "served": len(served),
+        "stitched": stitched,
+        "stitched_fraction": (
+            round(stitched / len(served), 4) if served else None
+        ),
+        "rejected": stats["rejected"],
+        "deadline_expired": stats["deadline_expired"],
+        "engine_retries_absorbed": engine_retries,
+        "latency": stats["latency"],
+        "slo": slo_doc,
+        "slo_breach_events": breaches,
+        "timeline_trace_flow_events": trace_flows,
+    }
+
+
+def run_sweep_arm(nreal, chunk, npsr, ntoa, failures):
+    """The faulted sweep under capture; returns the evidence block."""
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=1)
+    recipe = Recipe(efac=jnp.ones(npsr))
+    d = tempfile.mkdtemp(prefix="request_trace_sweep_")
+    obs.start_capture(d, heartbeat_interval_s=0.2, stall_timeout_s=None)
+    try:
+        ck = os.path.join(d, "sweep.npz")
+        with inject.armed(f"{inject.SITE_DRAIN}:raise@chunk=1", seed=0):
+            sweep(jax.random.PRNGKey(0), batch, recipe, nreal=nreal,
+                  chunk=chunk, checkpoint_path=ck, reduce_fn=None,
+                  chunk_retries=2, retry_policy=RETRY_POLICY)
+    finally:
+        obs.finish_capture()
+    events = _load_events(d)
+    nchunks = nreal // chunk
+    by_chunk = {}
+    for rec in events:
+        if rec.get("type") != "span" or "trace_id" not in rec:
+            continue
+        c = (rec.get("attrs") or {}).get("chunk")
+        if c is None:
+            continue
+        by_chunk.setdefault(int(c), {}).setdefault(
+            rec["trace_id"], []
+        ).append(rec["name"])
+    complete = 0
+    retried_attempts = 0
+    for c in range(nchunks):
+        traces = by_chunk.get(c, {})
+        if len(traces) != 1:
+            failures.append(
+                f"sweep: chunk {c} spans split over {len(traces)} "
+                "trace ids (expected exactly one)"
+            )
+            continue
+        ((tid, spans_c),) = traces.items()
+        if {names.SPAN_DISPATCH, names.SPAN_DRAIN,
+                names.SPAN_IO_WRITE} <= set(spans_c):
+            complete += 1
+        else:
+            failures.append(
+                f"sweep: chunk {c} trace incomplete: {sorted(spans_c)}"
+            )
+        if c == 1:
+            retried_attempts = spans_c.count(names.SPAN_DISPATCH)
+            if retried_attempts < 2:
+                failures.append(
+                    "sweep: retried chunk 1 shows "
+                    f"{retried_attempts} dispatch attempt(s) in its "
+                    "trace (expected a multi-attempt trace)"
+                )
+            retry_stamped = any(
+                rec.get("type") == "event"
+                and rec.get("name") == names.EVENT_FAULT_RETRY
+                and rec.get("trace_id") == tid
+                for rec in events
+            )
+            if not retry_stamped:
+                failures.append(
+                    "sweep: no faults.retry event stamped with the "
+                    "retried chunk's trace id"
+                )
+    return {
+        "nchunks": nchunks,
+        "complete_chunk_traces": complete,
+        "retried_chunk_attempts": retried_attempts,
+    }
+
+
+def run_overhead_arm(step_npsr, step_ntoa, step_chunk, failures):
+    """Per-span trace-context cost x spans-per-chunk vs the measured
+    flagship-shaped step wall."""
+    k = 4000
+    tracer = Tracer()  # private, no sink: measures the machinery only
+
+    def spin():
+        for _ in range(k):
+            with tracer.span(names.SPAN_DISPATCH):
+                pass
+
+    spin()  # warm
+    t0 = time.perf_counter()
+    spin()
+    t_plain = time.perf_counter() - t0
+    with adopt(new_trace_context()):
+        spin()  # warm the traced path
+        t0 = time.perf_counter()
+        spin()
+        t_traced = time.perf_counter() - t0
+    per_span_s = max(0.0, (t_traced - t_plain) / k)
+    t0 = time.perf_counter()
+    for i in range(k):
+        chunk_trace_context("overhead-probe", i)
+    ctx_create_s = (time.perf_counter() - t0) / k
+
+    batch = synthetic_batch(npsr=step_npsr, ntoa=step_ntoa, seed=5)
+    recipe = Recipe(
+        efac=jnp.ones(step_npsr),
+        rn_log10_amplitude=jnp.full(step_npsr, -13.5),
+        rn_gamma=jnp.full(step_npsr, 4.0),
+    )
+    key = jax.random.PRNGKey(2)
+    np.asarray(realize(key, batch, recipe, nreal=step_chunk))  # compile
+    walls = []
+    for rep in range(3):
+        t0 = time.perf_counter()
+        np.asarray(realize(jax.random.fold_in(key, rep), batch, recipe,
+                           nreal=step_chunk))
+        walls.append(time.perf_counter() - t0)
+    step_wall = float(np.median(walls))
+    # a pipelined sweep chunk emits 3 stage spans (dispatch/drain/
+    # io_write) + 1 context derivation; everything else (engine spans)
+    # exists with or without tracing
+    spans_per_chunk = 3
+    overhead_s = ctx_create_s + spans_per_chunk * per_span_s
+    fraction = overhead_s / step_wall if step_wall > 0 else 0.0
+    if fraction >= RT_OVERHEAD_GATE:
+        failures.append(
+            f"overhead: tracing costs {100 * fraction:.3f}% of the "
+            f"step ({overhead_s * 1e6:.2f} us vs {step_wall:.3f} s) — "
+            f"gate {100 * RT_OVERHEAD_GATE:g}%"
+        )
+    return {
+        "per_span_ctx_s": round(per_span_s, 9),
+        "ctx_create_s": round(ctx_create_s, 9),
+        "spans_per_chunk": spans_per_chunk,
+        "step_wall_s": round(step_wall, 4),
+        "step_shape": f"{step_npsr}x{step_ntoa}x{step_chunk}",
+        "overhead_fraction": round(fraction, 8),
+        "overhead_gate": RT_OVERHEAD_GATE,
+    }
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv[1:]
+    out_path = None
+    if "--out" in sys.argv[1:]:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    n_requests = int(os.environ.get("RT_REQUESTS",
+                                    "64" if fast else "240"))
+    npsr = int(os.environ.get("RT_NPSR", "4"))
+    ntoa = int(os.environ.get("RT_NTOA", "96" if fast else "256"))
+    nreal_bank = int(os.environ.get("RT_NREAL_BANK",
+                                    "6" if fast else "16"))
+    sweep_nreal = int(os.environ.get("RT_SWEEP_NREAL",
+                                     "16" if fast else "64"))
+    sweep_chunk = int(os.environ.get("RT_SWEEP_CHUNK",
+                                     "4" if fast else "16"))
+    step_npsr = int(os.environ.get("RT_STEP_NPSR", "4" if fast else "8"))
+    step_ntoa = int(os.environ.get("RT_STEP_NTOA",
+                                   "512" if fast else "4096"))
+    step_chunk = int(os.environ.get("RT_STEP_CHUNK",
+                                    "16" if fast else "64"))
+
+    failures = []
+    serving = run_serving_arm(n_requests, npsr, ntoa, nreal_bank,
+                              failures)
+    sweep_block = run_sweep_arm(sweep_nreal, sweep_chunk, npsr, ntoa,
+                                failures)
+    overhead = run_overhead_arm(step_npsr, step_ntoa, step_chunk,
+                                failures)
+
+    rec = {
+        "bench": "request_trace",
+        "backend": jax.default_backend(),
+        "fast": fast,
+        "serving": serving,
+        "sweep": sweep_block,
+        "overhead": overhead,
+        "ok": not failures,
+        "failures": failures,
+        **provenance_stamp(
+            EVIDENCE_SCHEMA_VERSION,
+            repo_root=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ),
+        ),
+    }
+    payload = json.dumps(rec)
+    print(payload)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(payload + "\n")
+    for reason in failures:
+        # stdout is routinely /dev/null'd in CI: gate-miss reasons
+        # must reach stderr
+        print(f"request_trace GATE MISS: {reason}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -232,11 +232,14 @@ def main(argv=None):
     p.add_argument("--serve", type=int, default=None, metavar="PORT",
                    help="also expose the live run over HTTP while "
                         "watching: /metrics (Prometheus text), "
-                        "/progress, /series — read-only, torn-read-"
-                        "safe against the sampler (docs/observability"
-                        ".md 'Scraping a live run'). Port 0 picks an "
-                        "ephemeral port (printed). The server lives "
-                        "for the duration of the watch")
+                        "/progress, /series, /slo (error budgets), "
+                        "/healthz + /readyz (503 on a fast-burn SLO "
+                        "breach, docs/tracing.md) — read-only, torn-"
+                        "read-safe against the sampler (docs/"
+                        "observability.md 'Scraping a live run'). "
+                        "Port 0 picks an ephemeral port (printed). "
+                        "The server lives for the duration of the "
+                        "watch")
     p.add_argument("--bind", default="127.0.0.1", metavar="HOST",
                    help="interface for --serve (default loopback; "
                         "0.0.0.0 exposes the run to the network)")
@@ -364,7 +367,8 @@ def main(argv=None):
             server = serve_directory(args.dir, args.serve,
                                      host=args.bind, background=True)
             print(f"serving {serve_url(server)} "
-                  "(/metrics /progress /series)", file=sys.stderr)
+                  "(/metrics /progress /series /slo /readyz)",
+                  file=sys.stderr)
         try:
             rc = watch_progress(args.dir, interval=args.interval,
                                 once=args.once)

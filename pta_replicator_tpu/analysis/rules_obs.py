@@ -1,4 +1,5 @@
-"""graftlint rule pack: bounded-buffer discipline in the obs subsystem.
+"""graftlint rule pack: bounded-buffer + trace-handoff discipline in
+threaded telemetry paths.
 
 The telemetry layer runs for the LIFE of a multi-hour capture, on
 daemon threads (the flight recorder's sampler, the tracer's listeners,
@@ -33,6 +34,21 @@ file", not "is this exact call site guarded" — a ring that prunes in
 ``observe`` and appends in ``offer`` is bounded even though the append
 itself is bare. That keeps the rule quiet on correct code and loud on
 the one shape that actually leaks: a buffer that only ever grows.
+
+* ``obs-orphan-thread-span`` — anywhere in PACKAGE code (not just
+  obs/): a ``threading.Thread(target=...)`` (or executor
+  ``.submit(fn)``) whose target function opens spans but shows NO
+  visible trace/ancestry handoff — no ``carry()``/``adopt()`` (the
+  TraceContext handoff pair, docs/tracing.md) and no
+  ``TRACER.inherit`` (the span-ancestry handoff) anywhere in the
+  module. Such a worker records orphan spans: they land at the root of
+  the span tree AND outside any causal trace, which is exactly how a
+  coalesced batch becomes unattributable to the requests it served.
+  The evidence check is module-wide like the buffer rule's — a worker
+  whose body delegates to a helper that adopts is handed off; a module
+  with threads, spans in the targets, and no handoff anywhere is the
+  orphan shape. Intentionally unstitched workers carry an inline
+  ``# graftlint: disable=obs-orphan-thread-span`` with the reason.
 """
 from __future__ import annotations
 
@@ -219,4 +235,102 @@ class UnboundedObsBuffer(Rule):
             )
 
 
-RULES = [UnboundedObsBuffer()]
+#: call names that count as a visible trace/ancestry handoff
+_HANDOFF_NAMES = {"carry", "adopt", "inherit"}
+#: the package subtree the orphan-thread-span rule polices
+_PKG_PREFIX = "pta_replicator_tpu/"
+
+
+def _is_thread_spawn(mod: Module, node: ast.Call):
+    """The target-function expression of a worker spawn, or None:
+    ``threading.Thread(target=f)`` / ``Thread(target=f)``, and executor
+    ``pool.submit(f, ...)`` where ``f`` is a name/attribute reference
+    (a server's ``submit(**params)`` request API takes no callable and
+    never matches)."""
+    resolved = mod.resolve(node.func) or ""
+    if resolved.rsplit(".", 1)[-1] == "Thread":
+        for kw in node.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "submit"
+        and node.args
+        and isinstance(node.args[0], (ast.Name, ast.Attribute))
+    ):
+        return node.args[0]
+    return None
+
+
+def _target_function(mod: Module, expr: ast.AST):
+    """The FunctionDef a spawn target references, resolved by terminal
+    name anywhere in the module (covers nested worker defs and
+    ``self._run``-style methods); None for lambdas/imported targets —
+    not statically attributable."""
+    name = _terminal(expr)
+    if name is None:
+        return None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _opens_spans(fn: ast.AST) -> bool:
+    """True when the function body calls a span producer directly
+    (``span(...)`` / ``TRACER.span(...)`` / ``tracer.span(...)``).
+    Synthesized records (``record_span``) don't count — they take the
+    context explicitly, which IS a handoff."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                _terminal(node.func) == "span":
+            return True
+    return False
+
+
+def _has_handoff(mod: Module) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and \
+                _terminal(node.func) in _HANDOFF_NAMES:
+            return True
+    return False
+
+
+class OrphanThreadSpan(Rule):
+    id = "obs-orphan-thread-span"
+    severity = "error"
+    description = (
+        "thread/executor target opens spans with no visible "
+        "carry()/adopt()/inherit handoff — its spans land at the span-"
+        "tree root and outside any causal trace (docs/tracing.md)"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not mod.relpath.startswith(_PKG_PREFIX):
+            return
+        handoff = None  # computed lazily: most modules spawn nothing
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = _is_thread_spawn(mod, node)
+            if target is None:
+                continue
+            fn = _target_function(mod, target)
+            if fn is None or not _opens_spans(fn):
+                continue
+            if handoff is None:
+                handoff = _has_handoff(mod)
+            if handoff:
+                continue
+            yield self.finding(
+                mod, node.lineno,
+                f"thread target {_terminal(target)!r} opens spans but "
+                "this module shows no carry()/adopt()/inherit handoff "
+                "— wrap the worker body in TRACER.inherit(...) and/or "
+                "trace.adopt(carry()) (or suppress with the reason)",
+            )
+
+
+RULES = [UnboundedObsBuffer(), OrphanThreadSpan()]

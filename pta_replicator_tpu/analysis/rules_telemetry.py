@@ -37,6 +37,9 @@ from .engine import Finding, Module, Rule
 _PRODUCER_KINDS = {
     "span": "span",
     "traced": "span",
+    # synthesized span records (Tracer.record_span — the queue-wait/
+    # resolution shape): same name namespace as live spans
+    "record_span": "span",
     "event": "event",
     "counter": "metric",
     "gauge": "metric",
@@ -271,6 +274,27 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/likelihood/serve.py", "metric", n.LIKELIHOOD_REJECTED),
         (f"{pkg}/likelihood/serve.py", "metric",
          n.LIKELIHOOD_DEADLINE_EXPIRED),
+        # causal tracing + SLO layer (PR 14, docs/tracing.md): the
+        # request-trace hop spans and per-request rejection/expiry
+        # events on the serving path, the open-request gauge, and the
+        # SLO engine's budget/burn gauges + breach event — the
+        # request-level accountability story must not silently
+        # un-instrument
+        (f"{pkg}/likelihood/serve.py", "span", n.SPAN_LIKELIHOOD_SUBMIT),
+        (f"{pkg}/likelihood/serve.py", "span",
+         n.SPAN_LIKELIHOOD_QUEUE_WAIT),
+        (f"{pkg}/likelihood/serve.py", "span",
+         n.SPAN_LIKELIHOOD_RESOLVE),
+        (f"{pkg}/likelihood/serve.py", "event",
+         n.EVENT_LIKELIHOOD_REJECTED),
+        (f"{pkg}/likelihood/serve.py", "event",
+         n.EVENT_LIKELIHOOD_DEADLINE_EXPIRED),
+        (f"{pkg}/likelihood/serve.py", "metric", n.TRACE_OPEN_REQUESTS),
+        (f"{pkg}/obs/slo.py", "metric", n.SLO_ERROR_BUDGET_REMAINING),
+        (f"{pkg}/obs/slo.py", "metric", n.SLO_BURN_RATE_FAST),
+        (f"{pkg}/obs/slo.py", "metric", n.SLO_BURN_RATE_SLOW),
+        (f"{pkg}/obs/slo.py", "metric", n.SLO_BREACHES),
+        (f"{pkg}/obs/slo.py", "event", n.EVENT_SLO_BREACH),
         (f"{pkg}/obs/flightrec.py", "metric", n.FLIGHTREC_STALLS),
         (f"{pkg}/obs/flightrec.py", "event", n.EVENT_FLIGHTREC_STALL),
         # structured-covariance subsystem (ISSUE 13): the eager solve/

@@ -38,6 +38,17 @@ percentiles for free), and request-latency p50/p95/p99 tracked by the
 streaming P^2 estimators of obs/series.py — :meth:`LikelihoodServer.
 stats` returns the whole SLO block, and benchmarks/likelihood_serve.py
 commits it as the LIKELIHOOD bench series.
+
+Causal tracing (PR 14, docs/tracing.md): every submit mints a
+:class:`~..obs.trace.TraceContext` (``future.trace_id``); the request's
+life — the ``likelihood_submit`` span on the client thread, the
+synthesized ``likelihood_queue_wait``/``likelihood_resolve`` spans on
+the worker, the coalesced ``likelihood_batch`` span that served it
+(via its ``links`` fan-in field), and any rejection/expiry event — all
+share that trace_id, so one grep of the capture reconstructs one
+request end to end. Open (unresolved) request traces register in
+obs.trace's bounded registry, which the flight recorder's postmortem
+flushes — a killed server names the in-flight requests it took down.
 """
 from __future__ import annotations
 
@@ -56,8 +67,17 @@ from ..batch import PulsarBatch
 from ..faults import inject as faults
 from ..faults.retry import RetryPolicy, is_transient, retry_call
 from ..models.batched import Recipe
-from ..obs import counter, gauge, names, span
+from ..obs import counter, event, gauge, names, span
 from ..obs.series import SpanQuantiles
+from ..obs.trace import (
+    TRACER,
+    TraceContext,
+    adopt,
+    new_trace_context,
+    open_request_count,
+    register_open_request,
+    resolve_open_request,
+)
 from . import gp
 from .infer import _check_axes, _reduced_grid_engine_bank, _reducible
 
@@ -224,6 +244,8 @@ class _Request:
     theta: np.ndarray
     future: Future
     t_submit: float  # monotonic
+    t_submit_wall: float  # wall clock (trace-span t0 stamps)
+    ctx: TraceContext  # the request's causal trace (docs/tracing.md)
     deadline: Optional[float] = None  # monotonic; None = no deadline
 
 
@@ -378,7 +400,16 @@ class LikelihoodServer:
         still unserved when it expires has its future raise
         :class:`DeadlineExpired` instead of being evaluated late.
         Raises :class:`ServerSaturated` — without enqueueing — when
-        the bounded queue (``max_queue``) is full."""
+        the bounded queue (``max_queue``) is full.
+
+        Every request gets a causal :class:`~..obs.trace.TraceContext`
+        at submit (exposed as ``future.trace_id``, and stamped into a
+        rejection/expiry exception message), so a caller can grep the
+        capture for exactly their request: the ``likelihood_submit``
+        span here, the synthesized queue-wait and resolution spans on
+        the worker, and the coalesced ``likelihood_batch`` span that
+        served it (via its ``links`` fan-in field) all share the
+        trace_id (docs/tracing.md)."""
         if set(params) != set(self.axes):
             raise ValueError(
                 f"request must supply exactly {self.axes}, got "
@@ -386,6 +417,8 @@ class LikelihoodServer:
             )
         theta = np.asarray([float(params[k]) for k in self.axes])
         fut: Future = Future()
+        ctx = new_trace_context()
+        fut.trace_id = ctx.trace_id
         now = time.monotonic()
         if deadline_s is None:
             deadline_s = self.request_deadline_s
@@ -398,21 +431,48 @@ class LikelihoodServer:
         # pending count can never over-admit under concurrent submits
         # (the worker only ever SHRINKS it concurrently — a race there
         # rejects one request early, never admits one past the bound).
-        with self._lock:
-            if self._worker is None or self._closing:
-                raise RuntimeError("server not started (or stopping)")
-            if (
-                self.max_queue is not None
-                and self._pending >= self.max_queue
-            ):
-                self._rejected += 1
+        # The submit span wraps the whole admission decision, so even a
+        # REJECTED request leaves a span carrying its trace_id.
+        with adopt(ctx), span(names.SPAN_LIKELIHOOD_SUBMIT) as sp:
+            with self._lock:
+                if self._worker is None or self._closing:
+                    raise RuntimeError("server not started (or stopping)")
+                rejected = (
+                    self.max_queue is not None
+                    and self._pending >= self.max_queue
+                )
+                if rejected:
+                    self._rejected += 1
+                else:
+                    self._pending += 1
+                    # registration precedes the enqueue (the worker
+                    # cannot dequeue — and resolve — what is not yet
+                    # queued), so the open-request registry can never
+                    # leak a register that arrives after its resolve
+                    register_open_request(
+                        ctx, kind="likelihood_request",
+                        params={k: float(params[k]) for k in self.axes},
+                    )
+                    self._queue.put(_Request(
+                        theta, fut, now, time.time(), ctx,
+                        deadline=deadline,
+                    ))
+            # telemetry and the stamped exception run OUTSIDE the
+            # admission lock: under saturation every submit lands here,
+            # and the event emission is a line-buffered sink write —
+            # concurrent submitters must not serialize their admission
+            # checks behind each other's disk I/O
+            if rejected:
                 counter(names.LIKELIHOOD_REJECTED).inc()
+                sp["rejected"] = True
+                event(names.EVENT_LIKELIHOOD_REJECTED,
+                      max_queue=self.max_queue)
                 raise ServerSaturated(
                     f"request queue at max_queue={self.max_queue} — "
-                    "load shed; back off and resubmit"
+                    "load shed; back off and resubmit "
+                    f"(trace {ctx.trace_id})"
                 )
-            self._pending += 1
-            self._queue.put(_Request(theta, fut, now, deadline=deadline))
+        gauge(names.TRACE_OPEN_REQUESTS).set(open_request_count())
         counter(names.LIKELIHOOD_REQUESTS).inc()
         gauge(names.LIKELIHOOD_QUEUE_DEPTH).set(self._queue.qsize())
         return fut
@@ -487,12 +547,27 @@ class LikelihoodServer:
                 self._deadline_expired += len(expired)
             counter(names.LIKELIHOOD_DEADLINE_EXPIRED).inc(len(expired))
             for r in expired:
+                # the trace still closes: the queue-wait span records
+                # where the request died, the expiry event carries its
+                # trace_id, and the exception message stamps it so the
+                # caller can grep the capture for exactly this request
+                with adopt(r.ctx):
+                    TRACER.record_span(
+                        names.SPAN_LIKELIHOOD_QUEUE_WAIT,
+                        r.t_submit_wall, now - r.t_submit,
+                        expired=True,
+                    )
+                    event(names.EVENT_LIKELIHOOD_DEADLINE_EXPIRED,
+                          waited_s=round(now - r.t_submit, 6))
+                resolve_open_request(r.ctx)
                 if r.future.set_running_or_notify_cancel():
                     r.future.set_exception(DeadlineExpired(
                         f"request expired after {now - r.t_submit:.3f}s "
                         "in the queue (deadline "
-                        f"{r.deadline - r.t_submit:.3f}s)"
+                        f"{r.deadline - r.t_submit:.3f}s) "
+                        f"(trace {r.ctx.trace_id})"
                     ))
+            gauge(names.TRACE_OPEN_REQUESTS).set(open_request_count())
         return live
 
     def _serve_batch(self, reqs) -> None:
@@ -505,6 +580,16 @@ class LikelihoodServer:
             gauge(names.LIKELIHOOD_QUEUE_DEPTH).set(self._queue.qsize())
             return
         nb = len(reqs)
+        # queue-wait spans: the dequeue instant closes each request's
+        # queue residence (synthesized — the interval's endpoints live
+        # on two different threads)
+        t_deq = time.monotonic()
+        for r in reqs:
+            with adopt(r.ctx):
+                TRACER.record_span(
+                    names.SPAN_LIKELIHOOD_QUEUE_WAIT,
+                    r.t_submit_wall, max(0.0, t_deq - r.t_submit),
+                )
         theta = np.stack([r.theta for r in reqs])
         if nb < self.max_batch:
             # pad to the fixed device batch shape: ONE compiled program
@@ -527,18 +612,33 @@ class LikelihoodServer:
             )
 
         try:
-            with span(names.SPAN_LIKELIHOOD_BATCH, requests=nb,
-                      capacity=self.max_batch):
+            # links= is the fan-in: ONE coalesced batch span naming the
+            # trace of every request it serves, so each request's trace
+            # stitches through the shared engine evaluation
+            with span(names.SPAN_LIKELIHOOD_BATCH,
+                      links=[r.ctx.trace_id for r in reqs],
+                      requests=nb, capacity=self.max_batch):
                 # one in-place retry of a transient engine failure: a
                 # flapped device call must not fail max_batch client
                 # futures at once (fatal errors still do, immediately)
                 out = retry_call(_eval, policy=_ENGINE_RETRY,
                                  classify=is_transient, scope="serve")
         except BaseException as exc:  # noqa: BLE001 — delivered per-future
+            fail_wall = time.time()
             for r in reqs:
+                # the trace closes on the failure path too — a resolve
+                # span with the error, so a failed request is never an
+                # open-ended trace
+                with adopt(r.ctx):
+                    TRACER.record_span(
+                        names.SPAN_LIKELIHOOD_RESOLVE, fail_wall, 0.0,
+                        error=repr(exc)[:200],
+                    )
+                resolve_open_request(r.ctx)
                 if not r.future.set_running_or_notify_cancel():
                     continue
                 r.future.set_exception(exc)
+            gauge(names.TRACE_OPEN_REQUESTS).set(open_request_count())
             return
         done = time.monotonic()
         with self._lock:
@@ -554,10 +654,22 @@ class LikelihoodServer:
         gauge(names.LIKELIHOOD_BATCH_SIZE).set(nb)
         gauge(names.LIKELIHOOD_COALESCE_EFFICIENCY).set(round(eff, 6))
         gauge(names.LIKELIHOOD_QUEUE_DEPTH).set(self._queue.qsize())
+        done_wall = time.time()
         for k, r in enumerate(reqs):
-            if not r.future.set_running_or_notify_cancel():
-                continue
-            r.future.set_result(out[k])
+            if r.future.set_running_or_notify_cancel():
+                r.future.set_result(out[k])
+            # resolution closes the trace: t0 = the engine-done
+            # instant, duration = the time to hand this future its
+            # result (synthesized; adopt() makes the record a child of
+            # the request's root, like the queue-wait span)
+            with adopt(r.ctx):
+                TRACER.record_span(
+                    names.SPAN_LIKELIHOOD_RESOLVE, done_wall,
+                    max(0.0, time.monotonic() - done),
+                    latency_s=round(done - r.t_submit, 6),
+                )
+            resolve_open_request(r.ctx)
+        gauge(names.TRACE_OPEN_REQUESTS).set(open_request_count())
 
     # ------------------------------------------------------------ SLOs
 

@@ -37,6 +37,7 @@ from . import (
     report,
     serve,
     series,
+    slo,
     timeline,
     trace,
 )
@@ -50,7 +51,17 @@ from .jaxhooks import (
     tree_nbytes,
 )
 from .metrics import REGISTRY, counter, gauge, histogram
-from .trace import TRACER, configure, event, span, traced
+from .trace import (
+    TRACER,
+    TraceContext,
+    adopt,
+    carry,
+    configure,
+    current_trace,
+    event,
+    span,
+    traced,
+)
 
 install_jax_hooks = jaxhooks.install
 
@@ -62,6 +73,7 @@ __all__ = [
     "telemetry_summary", "reset_all", "metrics", "trace", "report",
     "jaxhooks", "flightrec", "regress", "FlightRecorder", "StallWarning",
     "names", "devprof", "occupancy", "series", "timeline", "serve",
+    "slo", "TraceContext", "adopt", "carry", "current_trace",
 ]
 
 
@@ -72,6 +84,7 @@ def start_capture(
     heartbeat_interval_s: float = 1.0,
     stall_timeout_s: float = 300.0,
     crash_hooks: bool = True,
+    slo: object = None,
 ) -> None:
     """Begin streaming telemetry to ``directory`` and install the JAX
     compile-accounting hooks. Safe to call early (before jax init).
@@ -88,7 +101,14 @@ def start_capture(
     ``stall_timeout_s`` (None disables just the watchdog), and — when
     ``crash_hooks`` and running on the main thread — SIGTERM/SIGINT +
     excepthook chaining that flushes ``postmortem.json`` before the
-    process dies. ``finish_capture`` stops it."""
+    process dies. ``finish_capture`` stops it.
+
+    ``slo`` declares the capture's objectives (a grammar string, a
+    spec list, or ``obs.slo.Objective`` objects — see docs/tracing.md;
+    default: the ``PTA_SLO`` env var): the flight recorder then scores
+    them continuously, embeds the verdict in the heartbeat, and writes
+    the ``slo.json`` live artifact the ``/slo`` and ``/readyz``
+    endpoints serve."""
     stale = flightrec.active()
     if stale is not None:
         # back-to-back captures without finish_capture: the previous
@@ -106,7 +126,7 @@ def start_capture(
 
     for stale_artifact in ("progress.json", "postmortem.json",
                            "series.json", "series.jsonl",
-                           "timeline.json", "metrics.prom"):
+                           "timeline.json", "metrics.prom", "slo.json"):
         try:
             _os.remove(_os.path.join(directory, stale_artifact))
         except OSError:
@@ -117,6 +137,7 @@ def start_capture(
             directory,
             interval_s=heartbeat_interval_s,
             stall_timeout_s=stall_timeout_s,
+            slo_objectives=slo,
         ).start()
         if crash_hooks:
             flightrec.install_crash_hooks()

@@ -60,7 +60,8 @@ import traceback
 import warnings
 from typing import Optional
 
-from . import names, occupancy, series as series_mod
+from . import names, occupancy, series as series_mod, slo as slo_mod
+from . import trace as trace_mod
 from .jaxhooks import device_memory_snapshot
 from .metrics import REGISTRY
 from .trace import TRACER
@@ -69,8 +70,13 @@ from .trace import TRACER
 #: window + bottleneck verdict); v3 adds the "trends" block (per-series
 #: latest value, rate/s, and rising/falling/flat direction over the
 #: trailing window, derived from the obs.series ring recorder the
-#: sampler now drives). Readers stay tolerant of older files.
-PROGRESS_SCHEMA_VERSION = 3
+#: sampler now drives); v4 adds the "slo" block (per-objective error
+#: budget + burn rates from the obs.slo engine; empty objectives when
+#: no SLO is configured) and the postmortem's "open_traces" list
+#: (request traces submitted but never resolved — the in-flight
+#: requests a killed serving process took with it). Readers stay
+#: tolerant of older files.
+PROGRESS_SCHEMA_VERSION = 4
 
 #: Required fields (and JSON types) of progress.json — the heartbeat
 #: contract consumed by the ``watch`` subcommand and validated by
@@ -85,6 +91,7 @@ PROGRESS_SCHEMA = {
     "sweep": dict,          # chunks_done/chunks_total/inflight/rate/eta_s
     "occupancy": dict,      # {"stages": {name: duty}, "bottleneck": ...}
     "trends": dict,         # {series: {latest, rate_per_s, trend}}
+    "slo": dict,            # {"objectives": {...}, "breached": [...]}
     "jax": dict,            # compiles / traces counters
     "stalls": float,        # flightrec.stalls counter
     "finished": bool,       # True only in the final heartbeat
@@ -97,6 +104,7 @@ POSTMORTEM_SCHEMA = {
     "heartbeat": dict,      # final heartbeat (PROGRESS_SCHEMA shape)
     "ring": list,           # last N span/event records (EVENT_SCHEMA)
     "metrics": dict,        # MetricsRegistry.to_json() snapshot
+    "open_traces": list,    # unresolved request traces (obs.trace)
 }
 
 
@@ -167,6 +175,7 @@ class FlightRecorder:
         interval_s: float = 1.0,
         ring_size: int = 256,
         stall_timeout_s: Optional[float] = 300.0,
+        slo_objectives=None,
     ):
         self.directory = directory
         self.interval_s = float(interval_s)
@@ -185,6 +194,15 @@ class FlightRecorder:
         #: is its rate/trend derivation. Persisted as series.jsonl on
         #: stop, and as the live series.json window every tick.
         self.series = series_mod.SeriesRecorder()
+        #: SLO engine (obs/slo.py): objectives from the constructor,
+        #: else the PTA_SLO env var, else none (every hook is then a
+        #: no-op). Scored from the same tracer listener + sampler tick;
+        #: verdict lands in the heartbeat's "slo" block and the
+        #: slo.json live artifact (the /slo and /readyz surface).
+        self.slo = slo_mod.SLOEngine(
+            slo_objectives if slo_objectives is not None
+            else slo_mod.from_env()
+        )
         self._thread: Optional[threading.Thread] = None
         self._lifecycle_lock = threading.Lock()
         self._stop = threading.Event()
@@ -256,6 +274,7 @@ class FlightRecorder:
         self.ring.append(rec)
         self.occupancy.observe(rec)
         self.series.observe_span(rec)
+        self.slo.observe_span(rec)
 
     #: live scrape artifacts refresh every Nth sampler tick: at the 1 s
     #: default cadence the endpoint's worst-case staleness is N seconds,
@@ -322,6 +341,7 @@ class FlightRecorder:
             while not self._stop.wait(wait_s):
                 try:
                     self.series.sample()
+                    self.slo.sample()
                     self.write_heartbeat()
                     if tick % self.LIVE_ARTIFACT_EVERY == 0:
                         self._write_live_artifacts()
@@ -366,6 +386,13 @@ class FlightRecorder:
             os.path.join(self.directory, "metrics.prom"),
             REGISTRY.to_prometheus(),
         )
+        if self.slo.armed:
+            # the /slo scrape + /readyz verdict surface; absent when no
+            # objectives are configured (the route then 404s honestly)
+            _atomic_text(
+                os.path.join(self.directory, "slo.json"),
+                json.dumps(self.slo.status(), default=repr),
+            )
 
     def _sweep_block(self, metrics=None) -> dict:
         snap = {}
@@ -462,6 +489,9 @@ class FlightRecorder:
             "trends": self.series.trends(
                 timeout=1.0 if emergency else None
             ),
+            "slo": self.slo.heartbeat_block(
+                timeout=1.0 if emergency else None
+            ),
             "jax": {
                 name.split(".", 1)[1]: val
                 for name in (names.JAX_COMPILES, names.JAX_TRACES)
@@ -539,6 +569,13 @@ class FlightRecorder:
                                          emergency=emergency),
             "ring": list(self.ring),
             "metrics": REGISTRY.to_json(
+                timeout=1.0 if emergency else None
+            ),
+            # request traces submitted but never resolved: the
+            # in-flight requests this process is taking with it (the
+            # likelihood server registers/resolves; obs.trace owns the
+            # bounded registry). Bounded lock in an emergency.
+            "open_traces": trace_mod.open_requests(
                 timeout=1.0 if emergency else None
             ),
         }

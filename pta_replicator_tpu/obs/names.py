@@ -64,6 +64,13 @@ SPAN_LIKELIHOOD_BATCH = "likelihood_batch"
 SPAN_LIKELIHOOD_SERVE = "likelihood_serve"
 #: one-time bank projection pass through the ReducedGP precompute
 SPAN_LIKELIHOOD_PROJECT = "likelihood_project"
+# request-trace hops (PR 14, docs/tracing.md): each request's causal
+# trace stitches submit -> queue-wait -> (likelihood_batch via links=)
+# -> future resolution; the submit span is live on the client thread,
+# the other two are synthesized from timestamps (Tracer.record_span)
+SPAN_LIKELIHOOD_SUBMIT = "likelihood_submit"
+SPAN_LIKELIHOOD_QUEUE_WAIT = "likelihood_queue_wait"
+SPAN_LIKELIHOOD_RESOLVE = "likelihood_resolve"
 
 # scenario compiler + differential fuzz harness (scenarios/)
 #: one spec -> (batch, recipe, plan) compile (scenarios/compile.py)
@@ -110,6 +117,8 @@ SPANS = frozenset({
     SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE, SPAN_MULTICHIP_SWEEP,
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_LIKELIHOOD_BATCH, SPAN_LIKELIHOOD_SERVE, SPAN_LIKELIHOOD_PROJECT,
+    SPAN_LIKELIHOOD_SUBMIT, SPAN_LIKELIHOOD_QUEUE_WAIT,
+    SPAN_LIKELIHOOD_RESOLVE,
     SPAN_SCENARIO_COMPILE, SPAN_SCENARIO_FUZZ_CASE,
     SPAN_COV_SOLVE, SPAN_COV_SAMPLE,
     SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_CLI_LIKELIHOOD,
@@ -135,9 +144,24 @@ EVENT_FAULT_FIRED = "faults.fired"
 #: a wedged one goes silent
 EVENT_FAULT_RETRY = "faults.retry"
 
+#: an SLO objective's fast-window burn rate crossed its breach
+#: threshold (obs/slo.py) — once per breach episode, re-armed on
+#: recovery, mirrored into /readyz's verdict
+EVENT_SLO_BREACH = "slo.breach"
+#: a submit was refused by admission control / a queued request's
+#: deadline passed (likelihood/serve.py). Each carries the request's
+#: trace_id, so the caller holding the stamped exception can grep the
+#: capture for exactly their request. (The identically-named METRICS
+#: below are the aggregate counters; these are the per-request
+#: flight-recorder breadcrumbs.)
+EVENT_LIKELIHOOD_REJECTED = "likelihood.rejected"
+EVENT_LIKELIHOOD_DEADLINE_EXPIRED = "likelihood.deadline_expired"
+
 EVENTS = frozenset({
     EVENT_FLIGHTREC_STALL, EVENT_DEVICE_TRACE,
     EVENT_FAULT_FIRED, EVENT_FAULT_RETRY,
+    EVENT_SLO_BREACH,
+    EVENT_LIKELIHOOD_REJECTED, EVENT_LIKELIHOOD_DEADLINE_EXPIRED,
 })
 
 # ------------------------------------------------------------- metrics
@@ -213,6 +237,20 @@ SCENARIO_FUZZ_CASES = "scenario.fuzz_cases"
 SCENARIO_FUZZ_DISAGREEMENTS = "scenario.fuzz_disagreements"
 SCENARIO_SHRINK_STEPS = "scenario.shrink_steps"
 
+# SLO engine (obs/slo.py): per-objective gauges over the rolling
+# windows — the remaining fraction of the error budget (1.0 = untouched,
+# < 0 = blown), the fast/slow-window burn rates (1.0 = consuming budget
+# exactly at the sustainable rate), and the cumulative breach-episode
+# counter. All labeled objective=<name>.
+SLO_ERROR_BUDGET_REMAINING = "slo.error_budget_remaining"
+SLO_BURN_RATE_FAST = "slo.burn_rate_fast"
+SLO_BURN_RATE_SLOW = "slo.burn_rate_slow"
+SLO_BREACHES = "slo.breaches"
+
+#: request traces submitted but not yet resolved/expired (obs/trace.py
+#: open-request registry; the postmortem flushes the survivors)
+TRACE_OPEN_REQUESTS = "trace.open_requests"
+
 # flight recorder
 FLIGHTREC_STALLS = "flightrec.stalls"
 
@@ -257,6 +295,9 @@ METRICS = frozenset({
     COV_SOLVES, COV_BLOCKED_FRACTION,
     SCENARIO_COMPILED, SCENARIO_FUZZ_CASES,
     SCENARIO_FUZZ_DISAGREEMENTS, SCENARIO_SHRINK_STEPS,
+    SLO_ERROR_BUDGET_REMAINING, SLO_BURN_RATE_FAST, SLO_BURN_RATE_SLOW,
+    SLO_BREACHES,
+    TRACE_OPEN_REQUESTS,
     FLIGHTREC_STALLS,
     OBS_OVERHEAD_S, PROC_RSS_BYTES,
     OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
@@ -291,6 +332,8 @@ LIKELIHOOD_PREFIX = "likelihood."
 FAULTS_PREFIX = "faults."
 COV_PREFIX = "cov."
 SCENARIO_PREFIX = "scenario."
+SLO_PREFIX = "slo."
+TRACE_PREFIX = "trace."
 OCCUPANCY_PREFIX = "occupancy."
 OBS_PREFIX = "obs."
 PROC_PREFIX = "proc."

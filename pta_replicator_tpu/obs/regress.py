@@ -94,6 +94,12 @@ _HIGHER_BETTER_TOKENS = (
     # series is explicit (solve/factor times ride the *_ms lower-better
     # suffix, oracle deviations ride "disagreement" below).
     "speedup_banded", "speedup_kron",
+    # TRACE/SLO series (benchmarks/request_trace.py, PR 14): a falling
+    # stitched-trace fraction is a causal-tracing correctness
+    # regression, and per-objective error budget remaining is the SLO
+    # engine's higher-is-healthier score (burn rates are lower-better
+    # overrides below — "rate" must NOT pull them higher-better)
+    "stitched", "budget_remaining",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves
@@ -117,7 +123,17 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
                         # are costs — a rising max_rel_disagreement is
                         # precision (or correctness) eroding even while
                         # every scenario still passes its tolerance
-                        "disagreement")
+                        "disagreement",
+                        # SLO breach-episode counts and open-at-exit
+                        # trace counts are costs (PR 14)
+                        "breach", "open_traces")
+#: leaf fragments that must classify lower-better BEFORE the
+#: higher-better token scan: burn_rate_* contains "rate" (a
+#: higher-better token) but a rising SLO burn rate is budget being
+#: consumed faster, and "unstitched" contains "stitched" (the
+#: stitched-fraction higher-better token) but a rising unstitched
+#: count is causal tracing breaking — both strictly worse
+_LOWER_BETTER_OVERRIDES = ("burn_rate", "unstitched")
 #: name fragments with NO better direction: jax.cost.* gauges are
 #: properties of the compiled program (flops per chunk changing is a
 #: workload change, not a perf verdict — even though "flops" is a
@@ -207,6 +223,8 @@ def metric_direction(name: str) -> Optional[bool]:
     # metric instances may carry a {label=...} suffix (telemetry_summary
     # keys); the label text must not leak into leaf-token matching
     leaf = name.split("{", 1)[0].rsplit(".", 1)[-1].lower()
+    if any(t in leaf for t in _LOWER_BETTER_OVERRIDES):
+        return False
     if any(t in leaf for t in _HIGHER_BETTER_TOKENS):
         return True
     if leaf.endswith(_LOWER_BETTER_SUFFIXES) or any(

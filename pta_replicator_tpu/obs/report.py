@@ -55,7 +55,7 @@ def load_telemetry(directory: str) -> dict:
     out = {
         "directory": directory, "events": [], "metrics": None,
         "meta": None, "progress": None, "postmortem": None,
-        "series": None, "problems": [],
+        "series": None, "slo": None, "problems": [],
     }
     if not os.path.isdir(directory):
         out["problems"].append(f"{directory}: not a directory")
@@ -76,6 +76,7 @@ def load_telemetry(directory: str) -> dict:
         ("meta", "meta.json"),
         ("progress", "progress.json"),
         ("postmortem", "postmortem.json"),
+        ("slo", "slo.json"),
     ):
         p = os.path.join(directory, fname)
         if not os.path.exists(p):
@@ -206,6 +207,7 @@ def render_report(
              "progress": data["progress"],
              "postmortem": data["postmortem"],
              "series": data["series"],
+             "slo": data["slo"],
              "utilization": occupancy.analyze(data["events"]),
              "problems": data["problems"]},
             indent=1, sort_keys=True,
@@ -239,6 +241,12 @@ def render_report(
     if util:
         parts.append("")
         parts.append(render_utilization(util))
+
+    if data["slo"]:
+        section = render_slo(data["slo"])
+        if section:
+            parts.append("")
+            parts.append(section)
 
     if data["series"]:
         trends = (data["progress"] or {}).get("trends")
@@ -414,6 +422,41 @@ def render_percentiles(series: dict) -> str:
         "\n".join(rows)
 
 
+def render_slo(slo: dict) -> str:
+    """The report's SLO section from a loaded ``slo.json``: one row per
+    objective — SLI vs target, error budget remaining, fast/slow burn
+    rates, with a loud BREACH marker (docs/tracing.md)."""
+    objectives = (slo or {}).get("objectives") or {}
+    if not objectives:
+        return ""
+    rows = ["slo (error budgets over the rolling window):"]
+    for name in sorted(objectives):
+        st = objectives[name]
+        if not isinstance(st, dict):
+            continue
+        sli = st.get("sli")
+        target = st.get("target")
+        budget = st.get("error_budget_remaining")
+        row = f"  {name:<18}"
+        if sli is not None and target is not None:
+            row += f" sli {100 * sli:7.3f}% (target {100 * target:g}%)"
+        if budget is not None:
+            row += f"  budget {100 * budget:6.1f}%"
+        if st.get("burn_rate_fast") is not None:
+            row += (f"  burn {st['burn_rate_fast']:.2f}x fast / "
+                    f"{st.get('burn_rate_slow', 0.0):.2f}x slow")
+        if st.get("breach"):
+            row += "  ** BREACH **"
+        rows.append(row)
+    breached = (slo or {}).get("breached") or []
+    if breached:
+        rows.append(
+            f"  SLO BREACH: {', '.join(breached)} — fast-window burn "
+            "past threshold (see docs/tracing.md; /readyz serves 503)"
+        )
+    return "\n".join(rows)
+
+
 def render_utilization(util: dict) -> str:
     """The report's utilization section from an :func:`occupancy.analyze`
     result: per-stage duty table, overlap efficiency, bottleneck
@@ -509,6 +552,20 @@ def render_heartbeat(hb: dict) -> str:
     occ = hb.get("occupancy") or {}
     if occ.get("bottleneck"):
         parts.append(occ["bottleneck"])
+    slo = hb.get("slo") or {}
+    breached = slo.get("breached") or []
+    if breached:
+        parts.append("SLO BREACH " + ",".join(str(b) for b in breached))
+    elif slo.get("objectives"):
+        worst = min(
+            (o.get("budget_remaining") for o in
+             slo["objectives"].values()
+             if isinstance(o, dict)
+             and o.get("budget_remaining") is not None),
+            default=None,
+        )
+        if worst is not None:
+            parts.append(f"slo budget {100 * worst:.0f}%")
     open_spans = hb.get("open_spans") or {}
     if open_spans:
         deepest = max(open_spans.values(), key=len)

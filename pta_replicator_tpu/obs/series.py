@@ -94,6 +94,11 @@ DEFAULT_PREFIXES: Tuple[str, ...] = (
     names.JAX_MEMORY_PREFIX,
     names.OBS_PREFIX,
     names.PROC_PREFIX,
+    # the SLO engine's budget/burn gauges and the open-request-trace
+    # gauge (PR 14): an eroding error budget is exactly the kind of
+    # evolution the series layer exists to sparkline
+    names.SLO_PREFIX,
+    names.TRACE_PREFIX,
 )
 
 

@@ -13,10 +13,16 @@ access to the box:
 * ``/progress`` — the current heartbeat JSON (also ``/progress.json``)
 * ``/series``   — the recent series windows + span percentiles (also
   ``/series.json``)
-* ``/healthz``  — health/readiness verdict computed from the artifacts
+* ``/healthz``  — liveness verdict computed from the artifacts
   (200 while the heartbeat is fresh; 503 on no heartbeat, a stale one,
   or a postmortem — what a load balancer or the chaos bench polls to
   decide the run is alive, docs/robustness.md)
+* ``/readyz``   — readiness: everything /healthz checks PLUS the
+  active SLO verdict (503 with state "slo-breach" while any
+  objective's fast-window burn rate is past its breach threshold —
+  a live-but-burning server should shed traffic, docs/tracing.md)
+* ``/slo``      — the SLO engine's full status (``slo.json``: per-
+  objective error budget remaining + fast/slow burn rates)
 * ``/``         — a JSON index of the above
 
 Read-only by construction: GET/HEAD only, no path component of the URL
@@ -49,6 +55,8 @@ ROUTES = {
     "/series.json": ("series.json", "application/json"),
     "/postmortem": ("postmortem.json", "application/json"),
     "/postmortem.json": ("postmortem.json", "application/json"),
+    "/slo": ("slo.json", "application/json"),
+    "/slo.json": ("slo.json", "application/json"),
 }
 
 
@@ -67,11 +75,18 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if self.command != "HEAD":
             self.wfile.write(body)
 
-    def _healthz(self) -> None:
-        """Health/readiness verdict from the capture artifacts: 200
-        while the heartbeat is fresh, 503 otherwise — truthful for a
-        run that never started a flight recorder (no heartbeat = not
-        ready) and for one that died (postmortem = not healthy)."""
+    def _healthz(self, readiness: bool = False) -> None:
+        """Health verdict from the capture artifacts: 200 while the
+        heartbeat is fresh, 503 otherwise — truthful for a run that
+        never started a flight recorder (no heartbeat = not ready) and
+        for one that died (postmortem = not healthy).
+
+        ``readiness`` (the /readyz route) additionally folds in the
+        active SLO verdict from ``slo.json``: a live run whose
+        fast-window burn rate breached goes 503 "slo-breach" — alive,
+        but a load balancer should stop sending it traffic until the
+        burn subsides. /healthz stays pure liveness (a breaching
+        server must NOT be restarted by a liveness probe)."""
         directory = self.server.directory
         doc = {"ok": False}
         if os.path.exists(os.path.join(directory, "postmortem.json")):
@@ -92,28 +107,51 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                     doc.update(ok=True, state="live")
                 else:
                     doc["state"] = "stale"
+        if readiness and doc["ok"]:
+            breached = self._slo_breach()
+            if breached:
+                doc.update(ok=False, state="slo-breach",
+                           breached=breached)
         self._respond(
             200 if doc["ok"] else 503,
             json.dumps(doc).encode(), "application/json",
         )
+
+    def _slo_breach(self) -> list:
+        """Breached objective names from the live slo.json (empty when
+        no SLO is configured, the file is absent, or it is torn — a
+        readiness probe must degrade to the liveness verdict, never
+        503 a healthy run on a parse error)."""
+        from .slo import any_breach
+
+        try:
+            with open(os.path.join(self.server.directory, "slo.json"),
+                      "rb") as fh:
+                return any_breach(json.loads(fh.read()))
+        except (OSError, json.JSONDecodeError):
+            return []
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib handler contract
         path = self.path.split("?", 1)[0]
         if path in ("/", "/index.json"):
             body = json.dumps({
                 "directory": self.server.directory,
-                "endpoints": sorted(set(ROUTES) | {"/healthz"}),
+                "endpoints": sorted(
+                    set(ROUTES) | {"/healthz", "/readyz"}
+                ),
             }, indent=1).encode()
             self._respond(200, body, "application/json")
             return
         if path in ("/healthz", "/readyz"):
-            self._healthz()
+            self._healthz(readiness=(path == "/readyz"))
             return
         route = ROUTES.get(path)
         if route is None:
             self._respond(404, json.dumps({
                 "error": f"unknown endpoint {path!r}",
-                "endpoints": sorted(set(ROUTES) | {"/healthz"}),
+                "endpoints": sorted(
+                    set(ROUTES) | {"/healthz", "/readyz"}
+                ),
             }).encode(), "application/json")
             return
         fname, ctype = route

@@ -23,6 +23,12 @@ clock offset. This module merges everything into ONE
   A wedged shard is then one click along its arrow, not a grep over
   events.jsonl. Sharded-sweep chunks carry the same ``chunk`` key, so
   shard lineage rides the same links.
+* **request trace links** — spans stamped with a request-level
+  ``trace_id`` (the likelihood serving path's submit/queue-wait/
+  resolution hops) chain as their own flow arrows, and a coalesced
+  ``likelihood_batch`` span joins every trace named in its ``links``
+  fan-in field — so one request's life renders as one arrow chain
+  through the shared batch (docs/tracing.md).
 * **device trace events** — every trace dir registered in meta.json's
   ``device_traces`` is scanned for TensorBoard-format
   ``*.trace.json(.gz)`` files; their events are shifted onto the wall
@@ -97,13 +103,30 @@ class _StageTracks:
 
 def _host_events(events: List[dict], pid: int) -> Tuple[list, list]:
     """(trace events, flow events) from the span records. Flow events
-    link spans sharing a ``chunk`` attr across the pipeline stages."""
+    link spans sharing a ``chunk`` attr across the pipeline stages, and
+    — since the causal-tracing PR — spans sharing a request-level
+    ``trace_id`` (plus the coalesced batch spans that name a trace in
+    their ``links`` fan-in field), so one request's submit ->
+    queue-wait -> batch -> resolution reads as one arrow chain."""
     tracks = _StageTracks()
     out: List[dict] = []
     # chunk id -> [(stage rank, ts_us, tid)] for flow emission
     chunk_points: Dict[object, List[Tuple[int, float, int]]] = {}
     flow_order = {names.SPAN_DISPATCH: 0, names.SPAN_DRAIN: 1,
                   names.SPAN_IO_WRITE: 2}
+    # trace_id -> [(ts_us, tid)] for request-trace flow emission.
+    # CHUNK traces are excluded entirely (any trace_id seen on a
+    # chunk-stage span, which also covers its nested engine spans), or
+    # every chunk would render a second, redundant arrow chain next to
+    # the chunk flows that already draw that lineage.
+    trace_points: Dict[str, List[Tuple[float, int]]] = {}
+    chunk_trace_ids = {
+        rec["trace_id"] for rec in events
+        if rec.get("type") == "span"
+        and isinstance(rec.get("trace_id"), str)
+        and rec.get("name") in flow_order
+        and "chunk" in (rec.get("attrs") or {})
+    }
     for rec in events:
         if rec.get("type") != "span":
             continue
@@ -120,10 +143,21 @@ def _host_events(events: List[dict], pid: int) -> Tuple[list, list]:
             "ts": ts, "dur": dur, "pid": pid, "tid": tid,
             "args": {**attrs, "path": rec.get("path", name)},
         })
-        if name in flow_order and "chunk" in attrs:
+        is_chunk_stage = name in flow_order and "chunk" in attrs
+        if is_chunk_stage:
             chunk_points.setdefault(attrs["chunk"], []).append(
                 (flow_order[name], ts + dur / 2.0, tid)
             )
+        else:
+            point = (ts + dur / 2.0, tid)
+            tid_rec = rec.get("trace_id")
+            if isinstance(tid_rec, str) and \
+                    tid_rec not in chunk_trace_ids:
+                trace_points.setdefault(tid_rec, []).append(point)
+            for linked in rec.get("links") or []:
+                if isinstance(linked, str) and \
+                        linked not in chunk_trace_ids:
+                    trace_points.setdefault(linked, []).append(point)
     flows: List[dict] = []
     for chunk, points in chunk_points.items():
         points.sort()
@@ -139,6 +173,24 @@ def _host_events(events: List[dict], pid: int) -> Tuple[list, list]:
             }
             if ph == "f":
                 flow["bp"] = "e"  # bind to the enclosing slice
+            flows.append(flow)
+    for trace_id, points in trace_points.items():
+        points.sort()
+        if len(points) < 2:
+            continue
+        # 48 bits of the trace id: chrome flow ids must be integers;
+        # collisions across distinct request traces are negligible at
+        # any realistic request count
+        flow_id = int(trace_id[:12], 16)
+        for i, (ts, tid) in enumerate(points):
+            ph = "s" if i == 0 else ("f" if i == len(points) - 1 else "t")
+            flow = {
+                "name": "trace", "cat": "trace", "ph": ph,
+                "id": flow_id, "ts": ts, "pid": pid, "tid": tid,
+                "args": {"trace_id": trace_id},
+            }
+            if ph == "f":
+                flow["bp"] = "e"
             flows.append(flow)
     meta = [
         {"name": "process_name", "ph": "M", "pid": pid,
@@ -294,6 +346,9 @@ def build_timeline(directory: str) -> dict:
             "source": directory,
             "host_spans": n_spans,
             "flow_events": len(flows),
+            "trace_flow_events": sum(
+                1 for f in flows if f.get("cat") == "trace"
+            ),
             "device_events": n_device,
             "device_traces": len(trace_dirs),
             "problems": problems,

@@ -17,19 +17,33 @@ contract: every library span name is registered in :mod:`.names` and
 cross-checked statically by graftlint (docs/static-analysis.md). A Perfetto/``chrome://tracing``
 view of the same spans is written by :meth:`Tracer.chrome_trace`.
 
+Causal identity is layered on top of span timing: a propagable
+:class:`TraceContext` (128-bit trace_id + span_id + parent_id, carried
+by a contextvar and handed across threads with :func:`carry` /
+:func:`adopt`) stamps every span/event recorded while it is live, and
+a coalescing span links the traces it serves via the ``links=`` fan-in
+field — so one request's life (submit -> queue-wait -> batch -> future
+resolution) and one sweep chunk's life (dispatch -> drain -> io_write
+-> retries -> checkpoint) each read as ONE grep of events.jsonl
+(docs/tracing.md).
+
 Device-side (XLA) tracing is a separate concern: capture it alongside
 host telemetry with :func:`pta_replicator_tpu.utils.profiling.device_trace`
 (see docs/observability.md).
 """
 from __future__ import annotations
 
+import collections
 import contextlib
+import contextvars
+import dataclasses
+import hashlib
 import itertools
 import json
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 SCHEMA_VERSION = 1
 
@@ -54,6 +68,206 @@ EVENT_SCHEMA = {
     },
     "meta": {"type": str, "schema": int, "t0": float},
 }
+
+#: OPTIONAL trace-context fields a span/event record may carry when a
+#: :class:`TraceContext` was live at record time (and the ``links``
+#: fan-in field of a coalescing span). Not part of the required
+#: EVENT_SCHEMA — a record without a trace is still valid — but when
+#: present the fields must have exactly these shapes, which
+#: ``scripts/check_telemetry_schema.py`` validates:
+#: ``trace_id`` 32 lowercase hex chars (128-bit), ``span_id`` /
+#: ``parent_id`` 16 hex chars (64-bit), ``links`` a list of trace_ids.
+TRACE_FIELDS = {
+    "trace_id": str,
+    "span_id": str,
+    "parent_id": str,
+    "links": list,
+}
+
+#: hex lengths of the id fields (the schema checker's shape contract)
+TRACE_ID_HEX = 32
+SPAN_ID_HEX = 16
+
+
+# ---------------------------------------------------------------------
+# Trace context: request/chunk-level causal identity across threads.
+#
+# Span *nesting* is thread-local (the ancestry stacks above); causal
+# identity is NOT — one request's life crosses the submitting client
+# thread, the coalescing worker, and the engine batch that served N
+# requests at once. A TraceContext is the propagable identity:
+# a 128-bit trace_id naming the causal chain, a 64-bit span_id naming
+# the current hop, and the parent hop's id. It rides a contextvar
+# (automatic within a thread), and crosses threads only by EXPLICIT
+# handoff: the dispatching side snapshots with carry(), the worker
+# wraps its stage in adopt() — graftlint's obs-orphan-thread-span rule
+# makes the handoff mechanically required wherever a thread target
+# opens spans.
+#
+# Ids are allocated from a seeded counter reset at capture start
+# (Tracer.configure), so a replayed run allocates the same ids in the
+# same order — captures are diffable. Chunk-shaped work instead derives
+# ids purely from content (deterministic_trace_context), so a retried
+# sweep chunk's second attempt lands in the SAME trace as its first,
+# whatever else ran in between: a multi-attempt trace is one grep.
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Propagable causal identity: ``trace_id`` (128-bit hex) names the
+    request/chunk, ``span_id`` (64-bit hex) the current hop,
+    ``parent_id`` the hop that caused it (None at the root)."""
+
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str] = None
+
+
+#: the live context of the current thread of execution (contextvars:
+#: nested spans inherit it automatically; threads need carry()/adopt())
+_CTX: "contextvars.ContextVar[Optional[TraceContext]]" = (
+    contextvars.ContextVar("pta_trace_ctx", default=None)
+)
+
+# id allocation state: ONE (epoch, counter) tuple swapped atomically —
+# the epoch bumps on Tracer.configure so each capture's id stream
+# restarts deterministically. Allocators read the tuple in a single
+# (GIL-atomic) list access, so a reader racing a reset gets either the
+# old pair (whose counter keeps advancing — still unique) or the new
+# one, never a fresh counter under a stale epoch (which would re-mint
+# epoch-E ids already handed out). next() itself is GIL-atomic — the
+# uniqueness the concurrent-submit hammer test pins.
+_ID_STATE = [(0, itertools.count())]
+
+
+def _digest(text: str, nhex: int) -> str:
+    return hashlib.blake2b(
+        text.encode(), digest_size=nhex // 2
+    ).hexdigest()
+
+
+def reset_trace_ids() -> None:
+    """Restart the id stream (new capture epoch). Called by
+    ``Tracer.configure``/``reset`` so a capture's ids depend only on
+    allocation order within the capture — replays are diffable."""
+    with _OPEN_LOCK:
+        epoch, _counter = _ID_STATE[0]
+        _ID_STATE[0] = (epoch + 1, itertools.count())
+        _OPEN_REQUESTS.clear()
+
+
+def new_trace_context() -> TraceContext:
+    """A fresh root context (one per request). Deterministic given the
+    capture's allocation order; unique within the process."""
+    epoch, counter = _ID_STATE[0]  # one atomic read (see _ID_STATE)
+    n = next(counter)
+    return TraceContext(
+        _digest(f"trace:{epoch}:{n}", TRACE_ID_HEX),
+        _digest(f"root:{epoch}:{n}", SPAN_ID_HEX),
+    )
+
+
+def _new_span_id() -> str:
+    epoch, counter = _ID_STATE[0]  # one atomic read (see _ID_STATE)
+    return _digest(f"span:{epoch}:{next(counter)}", SPAN_ID_HEX)
+
+
+def deterministic_trace_context(*parts) -> TraceContext:
+    """A root context derived purely from ``parts`` — the same parts
+    always name the same trace, independent of allocation order. This
+    is what makes a retried sweep chunk's second attempt land in the
+    SAME trace as its first (a multi-attempt trace), and a resumed
+    sweep's chunk lineage survive the process boundary."""
+    base = ":".join(str(p) for p in parts)
+    return TraceContext(
+        _digest(f"trace:{base}", TRACE_ID_HEX),
+        _digest(f"root:{base}", SPAN_ID_HEX),
+    )
+
+
+def chunk_trace_context(scope, i: int) -> TraceContext:
+    """The canonical chunk trace: ``scope`` is the sweep's identity
+    (utils.sweep passes the checkpoint path, so retries AND resumes of
+    the same sweep stitch into the same per-chunk traces), ``i`` the
+    chunk index."""
+    return deterministic_trace_context("chunk", scope, int(i))
+
+
+def current_trace() -> Optional[TraceContext]:
+    """The live context of this thread of execution (None untraced)."""
+    return _CTX.get()
+
+
+def carry() -> Optional[TraceContext]:
+    """Snapshot the live context for handoff to another thread — the
+    dispatching half of the carry()/adopt() pair. (An alias of
+    :func:`current_trace`, named for the handoff idiom so the
+    obs-orphan-thread-span lint rule can recognize the dispatch site.)"""
+    return _CTX.get()
+
+
+@contextlib.contextmanager
+def adopt(ctx: Optional[TraceContext]):
+    """Adopt ``ctx`` as this thread's live trace context for the
+    duration — the worker half of the carry()/adopt() handoff. ``None``
+    adopts "untraced" (a no-op shield), so workers can adopt whatever
+    carry() returned without branching."""
+    token = _CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CTX.reset(token)
+
+
+# -- open-request registry ---------------------------------------------
+# Requests whose trace is still open (submitted, not yet resolved or
+# expired). The likelihood server registers/resolves; the flight
+# recorder's postmortem flushes the survivors — so a killed serving
+# process names exactly which in-flight requests died with it. Bounded:
+# oldest entries drop past the cap (an OrderedDict ring).
+
+_OPEN_LOCK = threading.Lock()
+_OPEN_REQUESTS: "collections.OrderedDict[str, dict]" = (
+    collections.OrderedDict()
+)
+OPEN_REQUESTS_CAP = 1024
+
+
+def register_open_request(ctx: TraceContext, **info) -> None:
+    with _OPEN_LOCK:
+        if len(_OPEN_REQUESTS) >= OPEN_REQUESTS_CAP:
+            _OPEN_REQUESTS.popitem(last=False)
+        _OPEN_REQUESTS[ctx.trace_id] = {
+            "trace_id": ctx.trace_id,
+            "since": time.time(),
+            **{k: _json_safe(v) for k, v in info.items()},
+        }
+
+
+def resolve_open_request(ctx: TraceContext) -> None:
+    with _OPEN_LOCK:
+        _OPEN_REQUESTS.pop(ctx.trace_id, None)
+
+
+def open_request_count() -> int:
+    return len(_OPEN_REQUESTS)
+
+
+def open_requests(timeout: Optional[float] = None) -> List[dict]:
+    """Snapshot of the still-open request traces (oldest first). The
+    bounded acquire serves the signal-time postmortem flush, degrading
+    to an unlocked best-effort copy — same convention as the tracer."""
+    acquired = _OPEN_LOCK.acquire(
+        timeout=-1 if timeout is None else timeout
+    )
+    try:
+        try:
+            return [dict(v) for v in _OPEN_REQUESTS.values()]
+        except RuntimeError:  # torn dict iteration (unlocked read)
+            return []
+    finally:
+        if acquired:
+            _OPEN_LOCK.release()
 
 
 def _json_safe(value):
@@ -113,6 +327,9 @@ class Tracer:
                 self._sink = None
             self._dir = directory
             if directory is not None:
+                # new capture epoch: the trace-id stream restarts so a
+                # replayed run allocates the same ids in the same order
+                reset_trace_ids()
                 os.makedirs(directory, exist_ok=True)
                 self._sink = open(
                     os.path.join(directory, "events.jsonl"), "w", buffering=1
@@ -185,27 +402,44 @@ class Tracer:
                 pass
 
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, links=None, **attrs):
         """Time a nested stage. Yields the (mutable) attrs dict so callers
         can attach results computed inside the span::
 
             with tracer.span("freeze", npsr=n) as sp:
                 ...
                 sp["ntoa_max"] = nt
+
+        When a :class:`TraceContext` is live (``adopt``/``new_trace_
+        context``), the record carries ``trace_id``/``span_id``/
+        ``parent_id`` and nested spans chain under this one. ``links``
+        is the fan-in field: a coalescing span (one ``likelihood_batch``
+        serving N requests) passes the trace_ids of every request it
+        served, so each request's trace stitches through the shared
+        batch. Untraced spans pay one contextvar read.
         """
         stack = self._stack()
         path = "/".join(stack + [name])
         stack.append(name)
         self.last_activity = time.monotonic()
         attrs = dict(attrs)
+        ctx = _CTX.get()
+        token = None
+        trace_fields = None
+        if ctx is not None:
+            sid = _new_span_id()
+            trace_fields = (ctx.trace_id, sid, ctx.span_id)
+            token = _CTX.set(TraceContext(ctx.trace_id, sid, ctx.span_id))
         t0 = time.time()
         w0 = time.perf_counter()
         c0 = time.process_time()
         try:
             yield attrs
         finally:
+            if token is not None:
+                _CTX.reset(token)
             stack.pop()
-            self._record({
+            rec = {
                 "type": "span",
                 "name": name,
                 "path": path,
@@ -215,7 +449,45 @@ class Tracer:
                 "tid": threading.get_ident(),
                 "seq": next(self._seq),
                 "attrs": {k: _json_safe(v) for k, v in attrs.items()},
-            })
+            }
+            if trace_fields is not None:
+                rec["trace_id"], rec["span_id"], rec["parent_id"] = (
+                    trace_fields
+                )
+            if links:
+                rec["links"] = [str(t) for t in links]
+            self._record(rec)
+
+    def record_span(
+        self, name: str, t0: float, wall_s: float, *,
+        ctx: Optional[TraceContext] = None, links=None, **attrs
+    ) -> None:
+        """Record a *synthesized* span measured from timestamps instead
+        of a live scope — the shape queue-wait and future-resolution
+        need: the interval is known only after the fact, from stamps
+        taken on two different threads. ``ctx`` (default: the live
+        context) supplies the trace identity; the record is otherwise a
+        normal span record (``path`` is the bare name — synthesized
+        spans have no thread-local ancestry)."""
+        rec = {
+            "type": "span",
+            "name": name,
+            "path": name,
+            "t0": float(t0),
+            "wall_s": float(wall_s),
+            "cpu_s": 0.0,
+            "tid": threading.get_ident(),
+            "seq": next(self._seq),
+            "attrs": {k: _json_safe(v) for k, v in attrs.items()},
+        }
+        ctx = ctx if ctx is not None else _CTX.get()
+        if ctx is not None:
+            rec["trace_id"] = ctx.trace_id
+            rec["span_id"] = _new_span_id()
+            rec["parent_id"] = ctx.span_id
+        if links:
+            rec["links"] = [str(t) for t in links]
+        self._record(rec)
 
     def current_stack(self) -> tuple:
         """The calling thread's open-span ancestry (for :meth:`inherit`)."""
@@ -297,15 +569,23 @@ class Tracer:
                 self._thread_stacks[tid] = restored
 
     def event(self, name: str, **attrs) -> None:
-        """Record an instant (zero-duration) event."""
-        self._record({
+        """Record an instant (zero-duration) event. A live
+        :class:`TraceContext` stamps the record with ``trace_id`` and
+        ``parent_id`` (the enclosing span) — so a ``faults.fired``
+        inside a chunk's drain span greps by the chunk's trace id."""
+        rec = {
             "type": "event",
             "name": name,
             "t0": time.time(),
             "tid": threading.get_ident(),
             "seq": next(self._seq),
             "attrs": {k: _json_safe(v) for k, v in attrs.items()},
-        })
+        }
+        ctx = _CTX.get()
+        if ctx is not None:
+            rec["trace_id"] = ctx.trace_id
+            rec["parent_id"] = ctx.span_id
+        self._record(rec)
 
     # -- inspection / export -------------------------------------------
     def summary(self) -> Dict[str, dict]:
@@ -412,11 +692,14 @@ class Tracer:
             self._lock.release()
 
     def reset(self) -> None:
-        """Drop buffered events and aggregates (sink file is kept open)."""
+        """Drop buffered events and aggregates (sink file is kept open).
+        Also restarts the trace-id stream and clears the open-request
+        registry — a reset tracer describes a fresh run."""
         with self._lock:
             self._events.clear()
             self._agg.clear()
             self._dropped = 0
+        reset_trace_ids()
 
 
 #: the process-global tracer used by all library instrumentation

@@ -48,6 +48,7 @@ captured run (docs/performance.md).
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -57,7 +58,12 @@ import numpy as np
 
 from ..faults import inject as faults
 from ..obs import counter, gauge, names, occupancy, span
-from ..obs.trace import TRACER
+from ..obs.trace import TRACER, adopt, chunk_trace_context
+
+#: default trace scopes for callers that pass none: a per-call counter,
+#: so two pipelines in one process never share chunk trace ids (the
+#: sweep passes its checkpoint path instead — stable across retries)
+_RUN_COUNT = itertools.count()
 
 
 class DrainTimeout(RuntimeError):
@@ -84,6 +90,26 @@ def _stop_aware_put(q: queue.Queue, item, stop: threading.Event) -> bool:
     return False
 
 
+def _mark_chunk(exc: BaseException, chunk: int) -> None:
+    """Attach the failing chunk index to a stage exception (best
+    effort — slotted exception types just skip it). The sweep's
+    supervised-recovery loop reads it back to stamp its ``faults.retry``
+    event with the FAILING chunk's trace context: the sidecar's done
+    marker alone can't name it, because a depth-N failure may out-race
+    the previous chunk's sidecar write."""
+    try:
+        exc.pta_chunk = int(chunk)
+    except (AttributeError, TypeError):
+        pass
+
+
+def failed_chunk(exc: BaseException) -> Optional[int]:
+    """The chunk index a pipeline stage attached to ``exc`` (None when
+    the failure never named one — e.g. a pre-dispatch error)."""
+    chunk = getattr(exc, "pta_chunk", None)
+    return None if chunk is None else int(chunk)
+
+
 def _stage_overdue(started_box: list, timeout_s: Optional[float]) -> bool:
     """True when the single-writer heartbeat ``started_box[0]`` (the
     monotonic start of the stage operation currently in flight, None
@@ -102,6 +128,7 @@ def run_pipelined(
     depth: int = 2,
     fetch: Callable[[object], np.ndarray] = np.asarray,
     drain_timeout_s: Optional[float] = 900.0,
+    trace_scope: Optional[str] = None,
 ) -> dict:
     """Run ``dispatch -> fetch -> write`` over ``indices`` with a bounded
     in-flight window of ``depth`` chunks.
@@ -128,6 +155,19 @@ def run_pipelined(
     error, files already written are valid completed chunks — the
     crash-safety ordering means a resume recomputes only chunks whose
     sidecar never landed.
+
+    **Causal tracing** (docs/tracing.md): every chunk gets a
+    deterministic :class:`~..obs.trace.TraceContext` derived from
+    ``(trace_scope, chunk index)``; the dispatch span opens under it on
+    the caller's thread, and the context is CARRIED through the queues
+    so the reader's ``drain`` span and the writer's ``io_write`` span
+    (plus any ``faults.fired`` event inside them) adopt the same
+    trace — one chunk's whole life is one trace_id in events.jsonl.
+    ``trace_scope`` defaults to a per-call counter; ``utils.sweep``
+    passes its checkpoint path, so a supervised RETRY (a fresh
+    ``run_pipelined`` call resuming from the sidecar) re-derives the
+    same per-chunk trace ids and the retried chunk's attempts land in
+    ONE multi-attempt trace.
     """
     if depth < 2:
         raise ValueError(
@@ -145,6 +185,10 @@ def run_pipelined(
     stop = threading.Event()
     errors: list = []  # [(stage, exc)] — first entry wins
     stack = TRACER.current_stack()  # nest worker spans under the caller's
+    scope = (
+        trace_scope if trace_scope is not None
+        else f"pipeline:{next(_RUN_COUNT)}"
+    )
 
     # stage heartbeats for the deadline: monotonic start time of the
     # fetch / write currently in flight, None while that worker is
@@ -168,7 +212,9 @@ def run_pipelined(
         with lock:
             busy[stage] += seconds
 
-    def _fail(stage: str, exc: BaseException) -> None:
+    def _fail(stage: str, exc: BaseException, chunk=None) -> None:
+        if chunk is not None:
+            _mark_chunk(exc, chunk)
         with lock:
             errors.append((stage, exc))
         stop.set()
@@ -207,10 +253,13 @@ def run_pipelined(
                 item = drain_q.get()
                 if item is _STOP or stop.is_set():
                     break
-                i, dev = item
+                i, dev, ctx = item
                 try:
                     fetch_started[0] = time.monotonic()
-                    with span(names.SPAN_DRAIN, chunk=i):
+                    # adopt the chunk's carried trace: the drain span
+                    # (and any fault fired inside it) stitches onto the
+                    # same trace_id the dispatch span opened
+                    with adopt(ctx), span(names.SPAN_DRAIN, chunk=i):
                         faults.fire(names.SPAN_DRAIN, chunk=i)
                         block = fetch(dev)
                     _busy(names.SPAN_DRAIN,
@@ -226,9 +275,9 @@ def run_pipelined(
                     window.release()
                 except BaseException as exc:  # noqa: BLE001 — must not die silently
                     fetch_started[0] = None
-                    _fail("drain", exc)
+                    _fail("drain", exc, chunk=i)
                     break
-                if not _put(io_q, (i, block)):
+                if not _put(io_q, (i, block, ctx)):
                     break
             _put(io_q, _STOP)
             # unblock a writer waiting on an empty queue even if the
@@ -245,11 +294,12 @@ def run_pipelined(
                 item = io_q.get()
                 if item is _STOP or stop.is_set():
                     break
-                i, block = item
+                i, block, ctx = item
                 try:
                     write_started[0] = time.monotonic()
-                    with span(names.SPAN_IO_WRITE, chunk=i,
-                              nbytes=int(block.nbytes)):
+                    with adopt(ctx), \
+                            span(names.SPAN_IO_WRITE, chunk=i,
+                                 nbytes=int(block.nbytes)):
                         faults.fire(names.SPAN_IO_WRITE, chunk=i)
                         write(i, block)
                     _busy(names.SPAN_IO_WRITE,
@@ -259,7 +309,7 @@ def run_pipelined(
                         stats["chunks"] += 1
                 except BaseException as exc:  # noqa: BLE001
                     write_started[0] = None
-                    _fail("io_write", exc)
+                    _fail("io_write", exc, chunk=i)
                     break
 
     reader = threading.Thread(target=_reader, name="sweep-drain", daemon=True)
@@ -283,19 +333,20 @@ def run_pipelined(
                 break
             try:
                 t_disp = time.monotonic()
-                with span(names.SPAN_DISPATCH, chunk=i):
+                ctx = chunk_trace_context(scope, i)
+                with adopt(ctx), span(names.SPAN_DISPATCH, chunk=i):
                     faults.fire(names.SPAN_DISPATCH, chunk=i)
                     dev = dispatch(i)
                 _busy(names.SPAN_DISPATCH, time.monotonic() - t_disp)
             except BaseException as exc:  # noqa: BLE001
-                _fail("dispatch", exc)
+                _fail("dispatch", exc, chunk=i)
                 break
             # heartbeat feed: how far ahead of the drained/written
             # chunks the dispatcher is running (sweep.chunks_done lags
             # this by the in-flight window)
             gauge(names.SWEEP_LAST_DISPATCHED_CHUNK).set(i)
             _bump(+1)
-            if not _put(drain_q, (i, dev)):
+            if not _put(drain_q, (i, dev, ctx)):
                 break
     finally:
         def _emergency_sentinels() -> None:

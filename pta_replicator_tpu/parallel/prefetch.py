@@ -55,7 +55,7 @@ import numpy as np
 from ..faults import inject as faults
 from ..faults.retry import is_transient
 from ..obs import counter, event, gauge, names, span, tree_nbytes
-from ..obs.trace import TRACER
+from ..obs.trace import TRACER, adopt, carry
 from ..utils.sweep import durable_replace, npy_bytes
 from .pipeline import DrainTimeout, _stage_overdue, _stop_aware_put
 
@@ -124,9 +124,11 @@ def prefetch_to_device(
     # cw_stream_stage spans (obs.occupancy)
     busy_s = [0.0]
     stack = TRACER.current_stack()  # nest worker spans under the caller's
+    tctx = carry()  # trace handoff: stage spans stitch onto the
+    #                 consumer's live trace (None = untraced, a no-op)
 
     def _worker() -> None:
-        with TRACER.inherit(stack):
+        with TRACER.inherit(stack), adopt(tctx):
             it = iter(tiles)
             i = 0
             while not stop.is_set():
@@ -283,9 +285,10 @@ def prefetch_to_mesh(
     busy = {d: [0.0] for d in devs}
     treedef_box = [None]
     stack = TRACER.current_stack()  # nest worker spans under the caller's
+    tctx = carry()  # trace handoff for producer + per-device stagers
 
     def _producer() -> None:
-        with TRACER.inherit(stack):
+        with TRACER.inherit(stack), adopt(tctx):
             it = iter(tiles)
             while not stop.is_set():
                 while not window.acquire(timeout=0.1):
@@ -328,7 +331,7 @@ def prefetch_to_mesh(
                     pass
 
     def _stager(d) -> None:
-        with TRACER.inherit(stack):
+        with TRACER.inherit(stack), adopt(tctx):
             beat = stage_started[d]
             label = str(getattr(d, "id", d))
             k = 0

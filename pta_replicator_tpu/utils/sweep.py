@@ -650,13 +650,27 @@ def sweep(
                 if attempts > chunk_retries:
                     raise
                 from ..obs import counter, event, names
+                from ..obs.trace import adopt, chunk_trace_context
+                from ..parallel.pipeline import failed_chunk
 
                 counter(names.SWEEP_CHUNK_RETRIES).inc()
-                event(
-                    names.EVENT_FAULT_RETRY, scope="sweep",
-                    attempt=attempts, done=done,
-                    error=repr(exc)[:200],
-                )
+                # stamp the retry event with the FAILING chunk's trace
+                # id, so the multi-attempt trace carries the retry
+                # breadcrumb between its attempts. The executor
+                # annotates stage failures with their chunk index
+                # (pipeline.failed_chunk) — the sidecar's done marker
+                # alone can't name it, because a depth-N failure may
+                # out-race the previous chunk's sidecar write; done is
+                # the fallback for failures outside any stage
+                fail_chunk = failed_chunk(exc)
+                fail_chunk = done if fail_chunk is None else fail_chunk
+                with adopt(chunk_trace_context(checkpoint_path,
+                                               fail_chunk)):
+                    event(
+                        names.EVENT_FAULT_RETRY, scope="sweep",
+                        attempt=attempts, done=done, chunk=fail_chunk,
+                        error=repr(exc)[:200],
+                    )
                 _time.sleep(backoff_delay(attempts, policy))
 
 
@@ -816,29 +830,49 @@ def _sweep_impl(
             progress(i + 1, nchunks)
 
     if pipeline_depth <= 1:
+        from ..obs.trace import adopt, chunk_trace_context
+
         # the synchronous reference loop: dispatch, fence, write — the
-        # behavior every pipelined run must reproduce byte-for-byte
+        # behavior every pipelined run must reproduce byte-for-byte.
+        # Each chunk adopts the SAME deterministic trace context the
+        # pipelined executor derives (scope = checkpoint path), so a
+        # chunk's trace means the same thing at every depth
+        from ..parallel.pipeline import _mark_chunk
+
         for i in range(done, nchunks):
-            with span(names.SPAN_SWEEP_CHUNK, chunk=i, nreal=chunk):
-                # same injection sites the pipelined executor fires, so
-                # a chaos schedule means the same thing at every depth
-                faults.fire(faults.SITE_DISPATCH, chunk=i)
-                out = dispatch_chunk(i)
-                # the host readback is the device-sync fence: this span
-                # is where queued device work (incl. collectives) drains
-                with span(names.SPAN_READBACK_FENCE):
-                    faults.fire(faults.SITE_DRAIN, chunk=i)
-                    block = fetch_fn(out)
-            host = (block.assemble() if isinstance(block, ShardedBlock)
-                    else block)
-            # same stage span the pipelined writer thread emits, so the
-            # occupancy report attributes the synchronous loop's disk
-            # time too (without it an fsync-bound depth-1 run reads as
-            # compute-bound)
-            with span(names.SPAN_IO_WRITE, chunk=i,
-                      nbytes=int(block.nbytes)):
-                faults.fire(faults.SITE_IO_WRITE, chunk=i)
-                write_chunk(i, block if shard_checkpoint else host)
+            try:
+                with adopt(chunk_trace_context(checkpoint_path, i)):
+                    with span(names.SPAN_SWEEP_CHUNK, chunk=i,
+                              nreal=chunk):
+                        # same injection sites the pipelined executor
+                        # fires, so a chaos schedule means the same
+                        # thing at every depth
+                        faults.fire(faults.SITE_DISPATCH, chunk=i)
+                        out = dispatch_chunk(i)
+                        # the host readback is the device-sync fence:
+                        # this span is where queued device work (incl.
+                        # collectives) drains
+                        with span(names.SPAN_READBACK_FENCE):
+                            faults.fire(faults.SITE_DRAIN, chunk=i)
+                            block = fetch_fn(out)
+                    host = (block.assemble()
+                            if isinstance(block, ShardedBlock)
+                            else block)
+                    # same stage span the pipelined writer thread
+                    # emits, so the occupancy report attributes the
+                    # synchronous loop's disk time too (without it an
+                    # fsync-bound depth-1 run reads as compute-bound)
+                    with span(names.SPAN_IO_WRITE, chunk=i,
+                              nbytes=int(block.nbytes)):
+                        faults.fire(faults.SITE_IO_WRITE, chunk=i)
+                        write_chunk(i,
+                                    block if shard_checkpoint else host)
+            except BaseException as exc:  # noqa: BLE001 — annotated, re-raised
+                # name the failing chunk for the supervised-recovery
+                # loop's trace-stamped retry event (same contract as
+                # the pipelined executor's stage failures)
+                _mark_chunk(exc, i)
+                raise
             blocks.append(host)
     elif done < nchunks:
         from ..parallel.pipeline import run_pipelined
@@ -909,6 +943,11 @@ def _sweep_impl(
                     depth=pipeline_depth,
                     fetch=fetch_fn,
                     drain_timeout_s=drain_timeout_s,
+                    # chunk traces scoped to the sweep's identity: a
+                    # supervised retry (and a cross-process resume)
+                    # re-derives the SAME per-chunk trace ids, so a
+                    # retried chunk's attempts land in one trace
+                    trace_scope=checkpoint_path,
                 )
                 sp.update(stats)
         except BaseException:

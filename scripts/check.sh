@@ -27,7 +27,8 @@ echo "== obs/analysis/faults test subset (fixture-free) =="
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_obs.py tests/test_flightrec.py tests/test_occupancy.py \
     tests/test_series.py tests/test_timeline_serve.py \
-    tests/test_analysis.py tests/test_pipeline.py tests/test_faults.py
+    tests/test_analysis.py tests/test_pipeline.py tests/test_faults.py \
+    tests/test_trace_slo.py
 
 echo "== scenario fuzz (fast arm: batched vs oracle differential) =="
 # 8 generated scenarios at a fixed seed through the batched-vs-oracle
@@ -55,5 +56,15 @@ echo "== chaos smoke (seeded faults, byte-identity gate) =="
 # to fault-free, server saturation shedding verified (exit 1 on any
 # gate miss). Seconds-scale, fixture-free, CPU-only.
 JAX_PLATFORMS=cpu python benchmarks/chaos_sweep.py --fast > /dev/null
+
+echo "== request-trace + SLO gate (fast arm) =="
+# the fast arm of benchmarks/request_trace.py: a chaos-loaded server
+# must yield a COMPLETE stitched trace for every served request (and
+# greppable stamped events for every shed one), a faulted sweep must
+# yield multi-attempt chunk traces, the SLO engine must score + breach
+# under saturation, and the trace-context overhead must stay under 1%
+# of the step (exit 1 with reasons on stderr). Seconds-scale,
+# fixture-free, CPU-only (docs/tracing.md).
+JAX_PLATFORMS=cpu python benchmarks/request_trace.py --fast > /dev/null
 
 echo "check.sh: all gates green"

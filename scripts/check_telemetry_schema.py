@@ -58,6 +58,67 @@ def check_entrypoints() -> list:
     return [f.format() for f in new]
 
 
+_HEX = set("0123456789abcdef")
+
+
+def _check_trace_fields(path: str, lineno: int, rec: dict) -> list:
+    """Validate the OPTIONAL trace-context fields of one span/event
+    record (obs.trace.TRACE_FIELDS): when present, trace_id is 32
+    lowercase hex chars (128-bit), span_id/parent_id 16 (64-bit), and
+    links a list of trace_ids — the shape the timeline merger and any
+    grep-by-trace-id workflow depend on (docs/tracing.md)."""
+    from pta_replicator_tpu.obs.trace import (
+        SPAN_ID_HEX,
+        TRACE_FIELDS,
+        TRACE_ID_HEX,
+    )
+
+    problems = []
+
+    def _is_hex_id(val, nhex):
+        return (
+            isinstance(val, str) and len(val) == nhex
+            and set(val) <= _HEX
+        )
+
+    for field, ftype in TRACE_FIELDS.items():
+        if field not in rec:
+            continue
+        val = rec[field]
+        if not isinstance(val, ftype):
+            problems.append(
+                f"{path}:{lineno}: {field} is "
+                f"{type(val).__name__}, expected {ftype.__name__}"
+            )
+            continue
+        if field == "trace_id" and not _is_hex_id(val, TRACE_ID_HEX):
+            problems.append(
+                f"{path}:{lineno}: trace_id {val!r} is not "
+                f"{TRACE_ID_HEX} lowercase hex chars"
+            )
+        elif field in ("span_id", "parent_id") and not _is_hex_id(
+            val, SPAN_ID_HEX
+        ):
+            problems.append(
+                f"{path}:{lineno}: {field} {val!r} is not "
+                f"{SPAN_ID_HEX} lowercase hex chars"
+            )
+        elif field == "links":
+            for item in val:
+                if not _is_hex_id(item, TRACE_ID_HEX):
+                    problems.append(
+                        f"{path}:{lineno}: links entry {item!r} is not "
+                        f"a {TRACE_ID_HEX}-hex trace_id"
+                    )
+                    break
+    if "span_id" in rec and "trace_id" not in rec:
+        problems.append(
+            f"{path}:{lineno}: span_id without trace_id — a trace-"
+            "context stamp must carry both"
+        )
+    return problems
+
+
 def validate_events(path: str) -> list:
     from pta_replicator_tpu.obs.trace import EVENT_SCHEMA
 
@@ -104,6 +165,8 @@ def validate_events(path: str) -> list:
                     f"{path}:{lineno}: {kind}.{field} is "
                     f"{type(rec[field]).__name__}, expected {ftype.__name__}"
                 )
+        if kind in ("span", "event"):
+            problems += _check_trace_fields(path, lineno, rec)
     if valid == 0:
         # catches the empty stream AND the single-corrupt-line stream
         # (which the truncated-final-line exemption would otherwise pass)
@@ -112,7 +175,10 @@ def validate_events(path: str) -> list:
 
 
 def generate_sample(directory: str) -> str:
-    """Capture a tiny span/event stream with a private tracer."""
+    """Capture a tiny span/event stream with a private tracer —
+    including a trace-context-stamped chain with a fan-in link, so a
+    fresh run always exercises the TRACE_FIELDS shape validation."""
+    from pta_replicator_tpu.obs import trace as trace_mod
     from pta_replicator_tpu.obs.trace import Tracer
 
     tracer = Tracer()
@@ -123,6 +189,13 @@ def generate_sample(directory: str) -> str:
         with tracer.span("sample_child") as sp:  # graftlint: disable=telemetry-unknown-name
             sp["n"] = 1
     tracer.event("sample_event", ok=True)  # graftlint: disable=telemetry-unknown-name
+    ctx = trace_mod.new_trace_context()
+    with trace_mod.adopt(ctx):
+        with tracer.span("sample_traced"):  # graftlint: disable=telemetry-unknown-name
+            tracer.event("sample_traced_event")  # graftlint: disable=telemetry-unknown-name
+        tracer.record_span("sample_synth", 0.0, 0.001)  # graftlint: disable=telemetry-unknown-name
+    with tracer.span("sample_fanin", links=[ctx.trace_id]):  # graftlint: disable=telemetry-unknown-name
+        pass
     tracer.configure(None)  # close the sink
     return os.path.join(directory, "events.jsonl")
 
@@ -130,8 +203,10 @@ def generate_sample(directory: str) -> str:
 #: heartbeat fields only required from the given PROGRESS_SCHEMA
 #: version on — a v1 capture (pre-occupancy) must keep validating
 #: ("readers stay tolerant of v1 files", obs/flightrec.py). v3 added
-#: the series-derived "trends" block.
-_FIELD_SINCE_VERSION = {"occupancy": 2, "trends": 3}
+#: the series-derived "trends" block; v4 the SLO verdict block and the
+#: postmortem's open-traces list.
+_FIELD_SINCE_VERSION = {"occupancy": 2, "trends": 3, "slo": 4,
+                        "open_traces": 4}
 
 
 def _validate_shape(path: str, doc, schema: dict, kind: str) -> list:
@@ -277,6 +352,44 @@ def validate_series_file(path: str) -> list:
     return problems
 
 
+def validate_slo_file(path: str) -> list:
+    """Validate an ``slo.json`` live artifact (obs/slo.py status shape):
+    an objectives dict whose entries carry the budget/burn numbers, and
+    a breached list naming a subset of the objectives."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except json.JSONDecodeError as exc:
+        return [f"{path}: unparseable JSON ({exc})"]
+    problems = []
+    objectives = doc.get("objectives")
+    if not isinstance(objectives, dict):
+        return [f"{path}: objectives is not an object"]
+    for name, st in objectives.items():
+        if not isinstance(st, dict):
+            problems.append(f"{path}: objective {name!r} not an object")
+            continue
+        for field in ("error_budget_remaining", "burn_rate_fast",
+                      "burn_rate_slow", "target", "sli"):
+            val = st.get(field)
+            if not isinstance(val, (int, float)) or isinstance(val, bool):
+                problems.append(
+                    f"{path}: objective {name!r}.{field} not numeric"
+                )
+        if not isinstance(st.get("breach"), bool):
+            problems.append(
+                f"{path}: objective {name!r}.breach not boolean"
+            )
+    breached = doc.get("breached")
+    if not isinstance(breached, list) or any(
+        b not in objectives for b in breached
+    ):
+        problems.append(
+            f"{path}: breached must list a subset of the objectives"
+        )
+    return problems
+
+
 def validate_device_traces(directory: str) -> list:
     """A capture's meta.json may register managed jax.profiler trace
     dirs (obs.devprof.device_trace). Each registered path — relative
@@ -342,6 +455,9 @@ def main(argv=None) -> int:
             series_path = os.path.join(target, "series.jsonl")
             if os.path.exists(series_path):
                 problems += validate_series_file(series_path)
+            slo_path = os.path.join(target, "slo.json")
+            if os.path.exists(slo_path):
+                problems += validate_slo_file(slo_path)
             problems += validate_device_traces(target)
             target = os.path.join(target, "events.jsonl")
         problems += validate_events(target)

@@ -787,6 +787,133 @@ def test_unbounded_buffer_suppression_is_the_escape_hatch(tmp_path):
     assert rule_ids(suppressed) == ["obs-unbounded-buffer"]
 
 
+def test_orphan_thread_span_fires_without_handoff(tmp_path):
+    """obs-orphan-thread-span: a Thread/executor target that opens
+    spans in a module with no carry()/adopt()/inherit handoff fires at
+    the spawn site — anywhere in package code, not just obs/."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    src = """
+        import threading
+
+        from ..obs import span
+
+        def worker():
+            with span("dispatch"):
+                pass
+
+        class Pool:
+            def submit(self, fn):
+                pass
+
+        def start(pool):
+            threading.Thread(target=worker).start()
+            pool.submit(worker)
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"pta_replicator_tpu/parallel/orphan.py": src},
+        rules_obs.RULES,
+    )
+    assert rule_ids(findings) == ["obs-orphan-thread-span"] * 2
+    assert "'worker'" in findings[0].message
+
+
+def test_orphan_thread_span_respects_handoff_and_scope(tmp_path):
+    """Non-firing shapes: an inherit() handoff, an adopt(carry())
+    handoff, a target with no spans, an unresolvable target, and
+    non-package code — plus the suppression escape hatch."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    inherit_ok = """
+        import threading
+
+        from ..obs import span
+        from ..obs.trace import TRACER
+
+        def worker(stack):
+            with TRACER.inherit(stack):
+                with span("drain"):
+                    pass
+
+        threading.Thread(target=worker).start()
+    """
+    adopt_ok = """
+        import threading
+
+        from ..obs import span
+        from ..obs.trace import adopt, carry
+
+        def start():
+            ctx = carry()
+
+            def worker():
+                with adopt(ctx):
+                    with span("io_write"):
+                        pass
+
+            threading.Thread(target=worker).start()
+    """
+    no_spans = """
+        import threading
+
+        def beat():
+            pass
+
+        threading.Thread(target=beat).start()
+    """
+    outside_pkg = """
+        import threading
+
+        from pta_replicator_tpu.obs import span
+
+        def worker():
+            with span("dispatch"):
+                pass
+
+        threading.Thread(target=worker).start()
+    """
+    suppressed_src = """
+        import threading
+
+        from ..obs import span
+
+        def worker():
+            with span("dispatch"):
+                pass
+
+        threading.Thread(target=worker).start()  # graftlint: disable=obs-orphan-thread-span
+    """
+    findings, suppressed = lint_tree(
+        tmp_path,
+        {
+            "pta_replicator_tpu/parallel/ih.py": inherit_ok,
+            "pta_replicator_tpu/likelihood/ad.py": adopt_ok,
+            "pta_replicator_tpu/obs/quiet.py": no_spans,
+            "benchmarks/bench_thing.py": outside_pkg,
+            "pta_replicator_tpu/io/sup.py": suppressed_src,
+        },
+        rules_obs.RULES,
+    )
+    assert findings == []
+    assert rule_ids(suppressed) == ["obs-orphan-thread-span"]
+
+
+def test_orphan_thread_span_clean_on_real_tree():
+    """Every thread target that opens spans in the shipped package
+    (pipeline reader/writer, both prefetchers' workers, the likelihood
+    serving worker) carries its handoff — zero findings, empty
+    baseline delta."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    pkg = os.path.join(REPO, "pta_replicator_tpu")
+    files = engine.iter_python_files([pkg], REPO)
+    mods, _problems = engine.parse_modules(files, REPO)
+    active, _suppressed = engine.run_rules(
+        mods, [rules_obs.OrphanThreadSpan()]
+    )
+    assert active == []
+
+
 def test_unbounded_buffer_clean_on_real_obs_tree():
     """The shipped obs/ package lints clean under the new rule with an
     EMPTY baseline delta: the series rings are provably bounded, and

@@ -235,7 +235,7 @@ def test_serve_routes_and_read_only(tmp_path):
         status, body = _get(base + "/")
         assert status == 200
         assert set(json.loads(body)["endpoints"]) == (
-            set(ROUTES) | {"/healthz"}
+            set(ROUTES) | {"/healthz", "/readyz"}
         )
         status, body = _get(base + "/progress")
         assert status == 200 and json.loads(body)["schema"] == 3
@@ -385,6 +385,22 @@ def test_serve_healthz_readiness_ladder(tmp_path):
         doc = json.loads(body)
         assert status == 200 and doc["ok"] and doc["state"] == "live"
         assert doc["heartbeat_age_s"] >= 0
+
+        # the SLO rung (PR 14): a fast-burn breach turns /readyz 503
+        # ("slo-breach") while /healthz — pure liveness — stays 200
+        with open(os.path.join(d, "slo.json"), "w") as fh:
+            json.dump({"objectives": {"x": {"breach": True}},
+                       "breached": ["x"]}, fh)
+        status, _body = _get(url)
+        assert status == 200  # healthz unchanged
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(serve_url(srv, "/readyz"),
+                                   timeout=5.0)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["state"] == "slo-breach"
+        os.remove(os.path.join(d, "slo.json"))
+        status, _body = _get(serve_url(srv, "/readyz"))
+        assert status == 200  # breach cleared: ready again
 
         old = time.time() - 30.0
         os.utime(os.path.join(d, "progress.json"), (old, old))

@@ -1,0 +1,622 @@
+"""Causal tracing + SLO engine (PR 14): TraceContext propagation and
+determinism, request-trace stitching under races (concurrent submits,
+stop()-drain, postmortem flush of open traces), chunk-trace identity
+across retry/resume, the SLO grammar/budget/burn/breach math, the
+/slo + /readyz surface, timeline trace flows, and the bench-diff
+direction contract for the TRACE series."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pta_replicator_tpu import likelihood as lk
+from pta_replicator_tpu import obs
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models.batched import Recipe, realize
+from pta_replicator_tpu.obs import names, slo as slo_mod
+from pta_replicator_tpu.obs import trace as trace_mod
+from pta_replicator_tpu.obs.trace import (
+    TRACER,
+    Tracer,
+    adopt,
+    carry,
+    chunk_trace_context,
+    deterministic_trace_context,
+    new_trace_context,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    batch = synthetic_batch(npsr=4, ntoa=96, seed=7)
+    recipe = Recipe(
+        efac=jnp.asarray(1.1),
+        rn_log10_amplitude=jnp.asarray(-13.5),
+        rn_gamma=jnp.asarray(4.0),
+        rn_nmodes=8,
+    )
+    bank = np.asarray(
+        realize(jax.random.PRNGKey(0), batch, recipe, nreal=6)
+    )
+    return batch, recipe, bank
+
+
+def _traced_spans(tracer=None):
+    out = {}
+    for rec in (tracer or TRACER).events():
+        if rec.get("type") == "span" and "trace_id" in rec:
+            out.setdefault(rec["trace_id"], []).append(rec)
+    return out
+
+
+# ----------------------------------------------------- trace contexts
+
+def test_span_records_carry_trace_fields_and_nest():
+    tracer = Tracer()
+    ctx = new_trace_context()
+    with adopt(ctx):
+        with tracer.span("outer"):
+            assert carry().trace_id == ctx.trace_id
+            with tracer.span("inner"):
+                pass
+        tracer.record_span("synth", time.time(), 0.001)
+    recs = {r["name"]: r for r in tracer.events()}
+    outer, inner, synth = recs["outer"], recs["inner"], recs["synth"]
+    assert outer["trace_id"] == inner["trace_id"] == ctx.trace_id
+    assert synth["trace_id"] == ctx.trace_id
+    # the chain: outer's parent is the root span id, inner's parent is
+    # outer's own span id (causal nesting, not just shared trace)
+    assert outer["parent_id"] == ctx.span_id
+    assert inner["parent_id"] == outer["span_id"]
+    assert synth["parent_id"] == ctx.span_id
+    assert len(outer["trace_id"]) == 32 and len(outer["span_id"]) == 16
+    # untraced spans carry no trace fields
+    with tracer.span("plain"):
+        pass
+    plain = [r for r in tracer.events() if r["name"] == "plain"][0]
+    assert "trace_id" not in plain and "span_id" not in plain
+
+
+def test_links_and_event_stamping():
+    tracer = Tracer()
+    ctx = new_trace_context()
+    with tracer.span("fanin", links=[ctx.trace_id, "other"]):
+        pass
+    rec = tracer.events()[-1]
+    assert rec["links"] == [ctx.trace_id, "other"]
+    with adopt(ctx):
+        tracer.event("probe", k=1)
+    ev = tracer.events()[-1]
+    assert ev["trace_id"] == ctx.trace_id
+    assert ev["parent_id"] == ctx.span_id
+
+
+def test_deterministic_chunk_contexts():
+    a = chunk_trace_context("ckpt.npz", 3)
+    b = chunk_trace_context("ckpt.npz", 3)
+    c = chunk_trace_context("ckpt.npz", 4)
+    d = chunk_trace_context("other.npz", 3)
+    assert a == b
+    assert len({a.trace_id, c.trace_id, d.trace_id}) == 3
+    assert deterministic_trace_context("x", 1) == \
+        deterministic_trace_context("x", 1)
+
+
+def test_trace_id_stream_resets_per_capture_epoch():
+    trace_mod.reset_trace_ids()
+    first = [new_trace_context() for _ in range(3)]
+    trace_mod.reset_trace_ids()
+    second = [new_trace_context() for _ in range(3)]
+    # same epoch-relative allocation order after a reset would collide
+    # across epochs if the epoch were not folded into the digest
+    assert [c.trace_id for c in first] != [c.trace_id for c in second]
+    # within one epoch the stream is unique
+    assert len({c.trace_id for c in second}) == 3
+
+
+def test_adopt_none_is_a_shield():
+    ctx = new_trace_context()
+    with adopt(ctx):
+        with adopt(None):
+            assert carry() is None
+        assert carry() == ctx
+
+
+# -------------------------------------------- request-trace stitching
+
+def test_concurrent_submits_get_unique_trace_ids(setup):
+    """Hammer: submits racing from many threads never share a
+    trace_id (id allocation is atomic under the GIL)."""
+    batch, recipe, bank = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank), batch, recipe,
+        axes=("rn_log10_amplitude",), max_batch=8, max_delay_s=0.001,
+    )
+    futs = []
+    lock = threading.Lock()
+
+    def client(k):
+        f = server.submit(rn_log10_amplitude=-13.5 - 1e-3 * k)
+        with lock:
+            futs.append(f)
+
+    with server:
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            f.result(timeout=60)
+    tids = [f.trace_id for f in futs]
+    assert len(set(tids)) == 32
+
+
+def test_stop_drained_futures_still_close_their_traces(setup):
+    """A request served by the stop() drain still gets queue-wait +
+    resolution spans and leaves the open-request registry."""
+    obs.reset_all()
+    batch, recipe, bank = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank), batch, recipe,
+        axes=("rn_log10_amplitude",), max_batch=4, max_delay_s=10.0,
+    )
+    server.start()
+    futs = [server.submit(rn_log10_amplitude=-13.5 + 0.01 * i)
+            for i in range(5)]
+    assert trace_mod.open_request_count() == 5
+    server.stop()
+    for f in futs:
+        assert f.exception() is None
+    spans = _traced_spans()
+    for f in futs:
+        got = [r["name"] for r in spans[f.trace_id]]
+        assert names.SPAN_LIKELIHOOD_SUBMIT in got
+        assert names.SPAN_LIKELIHOOD_QUEUE_WAIT in got
+        assert names.SPAN_LIKELIHOOD_RESOLVE in got
+    assert trace_mod.open_request_count() == 0
+    # the coalesced batch span links every request it served
+    linked = set()
+    for rec in TRACER.events():
+        if rec.get("name") == names.SPAN_LIKELIHOOD_BATCH:
+            linked.update(rec.get("links") or [])
+    assert {f.trace_id for f in futs} <= linked
+    obs.reset_all()
+
+
+def test_rejection_and_expiry_stamp_trace_ids(setup):
+    """ServerSaturated/DeadlineExpired messages carry the trace id, the
+    matching per-request events are stamped, and expired requests leave
+    the open-request registry."""
+    obs.reset_all()
+    batch, recipe, bank = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank), batch, recipe,
+        axes=("rn_log10_amplitude",), max_batch=1, max_delay_s=0.001,
+        max_queue=1, request_deadline_s=0.02,
+    )
+    entered = threading.Event()
+    release = threading.Event()
+
+    def gated_engine(theta, *a, **k):
+        entered.set()
+        release.wait(30.0)
+        return np.zeros((theta.shape[0], bank.shape[0]))
+
+    server._engine = gated_engine
+    with server:
+        first = server.submit(rn_log10_amplitude=-13.5)
+        assert entered.wait(10.0)
+        stale = server.submit(rn_log10_amplitude=-13.6)
+        with pytest.raises(lk.ServerSaturated) as exc:
+            server.submit(rn_log10_amplitude=-13.7)
+        assert "(trace " in str(exc.value)
+        rejected_tid = str(exc.value).rsplit("(trace ", 1)[1].rstrip(")")
+        time.sleep(0.1)  # the queued request expires
+        release.set()
+    assert first.exception() is None
+    with pytest.raises(lk.DeadlineExpired, match=stale.trace_id):
+        stale.result(timeout=0)
+    events = {
+        (r["name"], r.get("trace_id"))
+        for r in TRACER.events() if r.get("type") == "event"
+    }
+    assert (names.EVENT_LIKELIHOOD_REJECTED, rejected_tid) in events
+    assert (names.EVENT_LIKELIHOOD_DEADLINE_EXPIRED,
+            stale.trace_id) in events
+    # even the rejected request left a greppable submit span
+    assert rejected_tid in _traced_spans()
+    assert trace_mod.open_request_count() == 0
+    obs.reset_all()
+
+
+def test_postmortem_flushes_open_request_traces(tmp_path, setup):
+    """A postmortem written while requests are in flight lists them
+    under open_traces (the black box names what died with the run)."""
+    obs.reset_all()
+    batch, recipe, bank = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank), batch, recipe,
+        axes=("rn_log10_amplitude",), max_batch=1, max_delay_s=0.001,
+    )
+    entered = threading.Event()
+    release = threading.Event()
+
+    def gated_engine(theta, *a, **k):
+        entered.set()
+        release.wait(30.0)
+        return np.zeros((theta.shape[0], bank.shape[0]))
+
+    server._engine = gated_engine
+    from pta_replicator_tpu.obs.flightrec import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path), stall_timeout_s=None)
+    with server:
+        server.submit(rn_log10_amplitude=-13.5)
+        assert entered.wait(10.0)
+        queued = server.submit(rn_log10_amplitude=-13.6)
+        pm_path = rec.write_postmortem("test-flush")
+        release.set()
+    queued.result(timeout=30)
+    pm = json.loads(open(pm_path).read())
+    open_ids = {t["trace_id"] for t in pm["open_traces"]}
+    assert queued.trace_id in open_ids
+    assert all(
+        t.get("kind") == "likelihood_request" for t in pm["open_traces"]
+    )
+    obs.reset_all()
+
+
+# -------------------------------------------------- chunk trace identity
+
+def test_sweep_chunk_traces_identical_across_depths_and_resume(
+        tmp_path, setup):
+    """Chunk trace ids derive from (checkpoint path, chunk): the sync
+    loop, the pipelined executor, and a resumed sweep all stitch onto
+    the same per-chunk traces."""
+    obs.reset_all()
+    batch, recipe, _bank = setup
+    key = jax.random.PRNGKey(4)
+
+    ck1 = str(tmp_path / "a.npz")
+    sweep_kwargs = dict(nreal=8, chunk=4, reduce_fn=None)
+    from pta_replicator_tpu.utils.sweep import sweep
+
+    sweep(key, batch, recipe, checkpoint_path=ck1, pipeline_depth=1,
+          **sweep_kwargs)
+    depth1 = _traced_spans()
+    obs.reset_all()
+    ck2 = str(tmp_path / "b.npz")
+    sweep(key, batch, recipe, checkpoint_path=ck2, pipeline_depth=2,
+          **sweep_kwargs)
+    depth2 = _traced_spans()
+    # same chunk + same path => same trace id, at any depth
+    assert set(depth1) != set(depth2)  # different paths differ
+    assert chunk_trace_context(ck1, 0).trace_id in depth1
+    assert chunk_trace_context(ck2, 0).trace_id in depth2
+    for i in (0, 1):
+        tid = chunk_trace_context(ck2, i).trace_id
+        got = {r["name"] for r in depth2[tid]}
+        assert {names.SPAN_DISPATCH, names.SPAN_DRAIN,
+                names.SPAN_IO_WRITE} <= got
+    obs.reset_all()
+
+
+def test_sweep_retry_joins_the_same_chunk_trace(tmp_path, setup):
+    """A supervised retry resumes into the SAME per-chunk trace: the
+    retried chunk shows two dispatch attempts plus a trace-stamped
+    faults.retry event (the multi-attempt trace contract)."""
+    from pta_replicator_tpu.faults import inject
+    from pta_replicator_tpu.faults.retry import RetryPolicy
+    from pta_replicator_tpu.utils.sweep import sweep
+
+    obs.reset_all()
+    batch, recipe, _bank = setup
+    ck = str(tmp_path / "retry.npz")
+    pol = RetryPolicy(max_attempts=4, base_delay_s=0.05, max_delay_s=0.2)
+    with inject.armed("drain:raise@chunk=1", seed=0):
+        sweep(jax.random.PRNGKey(5), batch, recipe, nreal=8, chunk=4,
+              checkpoint_path=ck, reduce_fn=None, chunk_retries=2,
+              retry_policy=pol)
+    tid = chunk_trace_context(ck, 1).trace_id
+    spans = _traced_spans()[tid]
+    assert [r["name"] for r in spans].count(names.SPAN_DISPATCH) >= 2
+    retry_evs = [
+        r for r in TRACER.events()
+        if r.get("type") == "event"
+        and r.get("name") == names.EVENT_FAULT_RETRY
+    ]
+    assert any(r.get("trace_id") == tid for r in retry_evs)
+    obs.reset_all()
+
+
+def test_prefetch_workers_adopt_callers_trace(setup):
+    """The carry()/adopt() handoff: cw_stream_stage spans recorded on
+    the prefetch worker thread stitch onto the consumer's live trace."""
+    from pta_replicator_tpu.parallel.prefetch import prefetch_to_device
+
+    obs.reset_all()
+    ctx = new_trace_context()
+    with adopt(ctx):
+        tiles = [np.ones(4), np.ones(4)]
+        out = list(prefetch_to_device(iter(tiles), depth=2,
+                                      place=lambda t: t))
+    assert len(out) == 2
+    staged = [
+        r for r in TRACER.events()
+        if r.get("name") == names.SPAN_CW_STREAM_STAGE
+    ]
+    assert staged and all(
+        r.get("trace_id") == ctx.trace_id for r in staged
+    )
+    obs.reset_all()
+
+
+# --------------------------------------------------------- SLO engine
+
+def test_slo_grammar_parses_and_rejects():
+    obj = slo_mod.parse_objective(
+        "serve=likelihood_batch:p99_ms<=60@99.9%"
+    )
+    assert obj.kind == "latency" and obj.span == "likelihood_batch"
+    assert obj.threshold_s == pytest.approx(0.060)
+    assert obj.target == pytest.approx(0.999)
+    assert obj.spec_str() == "serve=likelihood_batch:p99_ms<=60@99.9%"
+    avail = slo_mod.parse_objective(
+        "admit=err(likelihood.deadline_expired/likelihood.requests)@99%"
+    )
+    assert avail.kind == "availability"
+    assert avail.bad_metric == "likelihood.deadline_expired"
+    for bad in (
+        "noname@99%", "x=foo@99%", "x=span:p99_ms<=60",
+        "x=span:p99_ms<=60@101%", "x=span:p99_ms<=60@0%",
+        "x=span:p99_ms<=abc@99%",
+    ):
+        with pytest.raises(slo_mod.SLOSpecError):
+            slo_mod.parse_objective(bad)
+    # labeled metric instances are refused at parse time: _metric_total
+    # sums families by bare name, so a label suffix would parse and
+    # then silently score nothing
+    with pytest.raises(slo_mod.SLOSpecError, match="labeled"):
+        slo_mod.parse_objective(
+            "x=err(faults.injected{site=drain}/faults.injected)@99%"
+        )
+    with pytest.raises(slo_mod.SLOSpecError, match="duplicate"):
+        slo_mod.parse_objectives(
+            "a=s:p99_ms<=1@99%;a=t:p99_ms<=1@99%"
+        )
+
+
+def test_slo_latency_budget_and_burn_math():
+    from pta_replicator_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    engine = slo_mod.SLOEngine(
+        "lat=probe_span:p99_ms<=10@90%", registry=reg
+    )
+    # 8 good + 2 bad of 10 events: bad_frac 0.2, allowance 0.1 ->
+    # burn 2.0, budget remaining 1 - 2.0 = -1.0
+    for wall in [0.001] * 8 + [0.5] * 2:
+        engine.observe_span(
+            {"type": "span", "name": "probe_span", "wall_s": wall}
+        )
+    st = engine.status()["objectives"]["lat"]
+    assert st["events"] == 10 and st["bad"] == 2
+    assert st["sli"] == pytest.approx(0.8)
+    assert st["burn_rate_slow"] == pytest.approx(2.0)
+    assert st["error_budget_remaining"] == pytest.approx(-1.0)
+    # 2/10 bad at 10% allowance = 2x burn: under the 14.4 page point
+    assert not st["breach"]
+
+
+def test_slo_breach_fires_once_per_episode():
+    from pta_replicator_tpu.obs.metrics import MetricsRegistry
+
+    obs.reset_all()
+    reg = MetricsRegistry()
+    engine = slo_mod.SLOEngine("lat=probe_span:p99_ms<=10@99%",
+                               registry=reg)
+    for _ in range(20):
+        engine.observe_span(
+            {"type": "span", "name": "probe_span", "wall_s": 0.5}
+        )
+    engine.sample()
+    engine.sample()  # still breaching: no second event
+    breaches = [
+        r for r in TRACER.events()
+        if r.get("type") == "event"
+        and r.get("name") == names.EVENT_SLO_BREACH
+    ]
+    assert len(breaches) == 1
+    assert breaches[0]["attrs"]["objective"] == "lat"
+    st = engine.status()["objectives"]["lat"]
+    assert st["breach"] and st["breaches"] == 1
+    gauges = {
+        (m.name, tuple(m.labels)): m.value for m in reg.metrics()
+    }
+    assert gauges[
+        (names.SLO_BURN_RATE_FAST, (("objective", "lat"),))
+    ] == pytest.approx(100.0)
+    obs.reset_all()
+
+
+def test_slo_availability_clamps_disjoint_counters():
+    from pta_replicator_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    engine = slo_mod.SLOEngine("a=err(bad.count/total.count)@99%",
+                               registry=reg)
+    engine.sample()  # baseline
+    reg.counter("total.count").inc(10)
+    reg.counter("bad.count").inc(3)
+    engine.sample()
+    st = engine.status()["objectives"]["a"]
+    assert st["events"] == 10 and st["bad"] == 3
+    assert st["sli"] == pytest.approx(0.7)
+    # disjoint misuse (bad > total) clamps to an all-bad window rather
+    # than a negative SLI
+    reg.counter("bad.count").inc(50)
+    engine.sample()
+    st = engine.status()["objectives"]["a"]
+    assert 0.0 <= st["sli"] <= 1.0
+
+
+def test_slo_engine_inert_without_objectives():
+    engine = slo_mod.SLOEngine()
+    assert not engine.armed
+    engine.observe_span({"type": "span", "name": "x", "wall_s": 1.0})
+    engine.sample()
+    assert engine.status()["objectives"] == {}
+    assert engine.heartbeat_block() == {"objectives": {}, "breached": []}
+
+
+def test_capture_writes_slo_artifact_and_heartbeat_block(tmp_path):
+    d = str(tmp_path / "cap")
+    obs.start_capture(d, heartbeat_interval_s=0.05, stall_timeout_s=None,
+                      slo="lat=compute:p99_ms<=0.001@99%")
+    with obs.span(names.SPAN_COMPUTE):
+        time.sleep(0.01)  # guaranteed bad vs the 1 us threshold
+    time.sleep(0.3)
+    hb = json.loads(
+        open(os.path.join(d, "progress.json")).read()
+    )
+    obs.finish_capture()
+    assert hb["schema"] >= 4
+    assert "lat" in hb["slo"]["objectives"]
+    assert hb["slo"]["breached"] == ["lat"]
+    doc = json.loads(open(os.path.join(d, "slo.json")).read())
+    assert doc["objectives"]["lat"]["breach"] is True
+    # the report renders the section and the watch line flags it
+    from pta_replicator_tpu.obs.report import (
+        render_heartbeat,
+        render_report,
+    )
+
+    text = render_report(d)
+    assert "slo (error budgets" in text and "BREACH" in text
+    assert "SLO BREACH lat" in render_heartbeat(hb)
+    # and the schema checker accepts the whole fresh capture
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    assert checker.main([d]) == 0
+
+
+def test_readyz_503_on_fast_burn_breach(tmp_path):
+    """The /readyz half of the readiness ladder: a live heartbeat with
+    a breaching slo.json is 503 slo-breach on /readyz while /healthz
+    stays 200 (liveness must not restart a burning-but-alive server)."""
+    import urllib.error
+    import urllib.request
+
+    from pta_replicator_tpu.obs.serve import serve_directory, serve_url
+
+    d = str(tmp_path / "cap")
+    os.makedirs(d)
+    with open(os.path.join(d, "progress.json"), "w") as fh:
+        json.dump({"schema": 4}, fh)
+    with open(os.path.join(d, "slo.json"), "w") as fh:
+        json.dump({"objectives": {"serve": {"breach": True}},
+                   "breached": ["serve"]}, fh)
+    srv = serve_directory(d, 0, background=True)
+    try:
+        with urllib.request.urlopen(
+            serve_url(srv, "/healthz"), timeout=5.0
+        ) as r:
+            assert r.status == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(serve_url(srv, "/readyz"),
+                                   timeout=5.0)
+        assert exc.value.code == 503
+        doc = json.loads(exc.value.read())
+        assert doc["state"] == "slo-breach"
+        assert doc["breached"] == ["serve"]
+        # /slo serves the artifact itself
+        with urllib.request.urlopen(
+            serve_url(srv, "/slo"), timeout=5.0
+        ) as r:
+            assert json.loads(r.read())["breached"] == ["serve"]
+        # recovery: no breach -> readyz back to 200
+        with open(os.path.join(d, "slo.json"), "w") as fh:
+            json.dump({"objectives": {"serve": {"breach": False}},
+                       "breached": []}, fh)
+        with urllib.request.urlopen(
+            serve_url(srv, "/readyz"), timeout=5.0
+        ) as r:
+            assert r.status == 200
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ----------------------------------------------------- timeline flows
+
+def test_timeline_renders_request_trace_flows(tmp_path, setup):
+    from pta_replicator_tpu.obs.timeline import build_timeline
+
+    d = str(tmp_path / "cap")
+    obs.start_capture(d, flight_recorder=False)
+    batch, recipe, bank = setup
+    server = lk.LikelihoodServer(
+        lk.RealizationBank.from_array(bank), batch, recipe,
+        axes=("rn_log10_amplitude",), max_batch=4, max_delay_s=0.002,
+    )
+    with server:
+        futs = [server.submit(rn_log10_amplitude=-13.5 - 0.01 * i)
+                for i in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+    obs.finish_capture()
+    doc = build_timeline(d)
+    assert doc["otherData"]["trace_flow_events"] > 0
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "trace"]
+    by_id = {}
+    for f in flows:
+        by_id.setdefault(f["id"], []).append(f)
+    # every chain is a well-formed s..t..f arrow
+    for chain in by_id.values():
+        phs = [f["ph"] for f in sorted(chain, key=lambda f: f["ts"])]
+        assert phs[0] == "s" and phs[-1] == "f" and len(phs) >= 2
+    # each request's chain carries its trace id in args
+    chain_tids = {f["args"]["trace_id"] for f in flows}
+    assert {f.trace_id for f in futs} <= chain_tids
+
+
+# ------------------------------------------------- bench-diff contract
+
+def test_trace_bench_diff_directions():
+    from pta_replicator_tpu.obs.regress import bench_diff, metric_direction
+
+    assert metric_direction("serving.stitched_fraction") is True
+    assert metric_direction(
+        "slo.error_budget_remaining{objective=serve}"
+    ) is True
+    assert metric_direction("admit.burn_rate_fast") is False
+    assert metric_direction("admit.burn_rate_slow") is False
+    assert metric_direction("serving.slo_breach_events") is False
+    assert metric_direction("overhead.overhead_fraction") is False
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "TRACE_r14_cpu.json")
+    assert os.path.exists(path), (
+        "TRACE_r14_cpu.json must be committed with the request-trace "
+        "bench evidence"
+    )
+    doc = json.loads(open(path).read())
+    assert doc["ok"] and not doc["failures"]
+    assert doc["serving"]["stitched_fraction"] == 1.0
+    assert doc["overhead"]["overhead_fraction"] < 0.01
+    _table, summary, rc = bench_diff([path, path])
+    assert rc == 0 and summary["regressed"] == 0
