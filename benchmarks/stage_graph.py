@@ -1,0 +1,265 @@
+"""End-to-end overlap A/B for the fused stage-graph sweep (PR 15).
+
+Two identical streamed-CW sweeps (checkpointed, ``reduce_fn=None``,
+durable writes — every chunk hauls a full residual cube through
+readback and an fsync'd checkpoint):
+
+* **stacked** — the classic two-pipeline composition: the streamed CW
+  static precompute runs to completion first (its own tile-build/H2D
+  prefetch window), then the pipelined sweep executor runs its
+  dispatch/drain/io_write window. The two windows never overlap across
+  the compute boundary.
+* **fused** — ``sweep(fused_stream=True)``: ONE stage graph
+  (``static_build -> dispatch -> drain -> io_write``, parallel/
+  stages.py) where chunk ``i+1``'s CW tile-build/H2D stages run
+  concurrently with chunk ``i``'s compute, readback, and checkpoint
+  write.
+
+Headline metric per arm: ``overlap_efficiency_e2e`` — obs.occupancy's
+overlap efficiency computed over the WHOLE end-to-end window (host
+precompute + dispatch + readback + durable write busy vs the arm's
+wall), i.e. how close the composition came to ideal pipelining of
+everything it did. The gate: the fused arm must measure STRICTLY above
+the stacked baseline, with byte-identical checkpoints (sha256).
+
+Honest framing (docs/streaming.md has the long form): on a fixed
+recipe the fused graph re-derives an IDENTICAL static per chunk — it
+spends ``nchunks x`` the host tile-build work of the stacked arm and
+hides it under the compute/IO window, so its wall stays near parity
+(``wall_ratio`` is recorded, not gated) while its end-to-end overlap
+efficiency is far higher. The fused mode is the substrate for sweeps
+whose per-chunk deterministic content varies (and for hosts with spare
+cores where the rebuild is free); this bench pins the SCHEDULING
+property — the stages genuinely run concurrently — and the byte
+identity that makes the fusion safe to turn on.
+
+Prints one JSON line; exit 1 with reasons on stderr when a gate fails.
+
+Usage: python benchmarks/stage_graph.py [--fast]
+  STAGE_GRAPH_NCW/_STREAM_CHUNK/_NTOA/_NMODES/_NREAL/_CHUNK/_NREP
+  reshape the workload (--fast presets a seconds-scale CI shape).
+"""
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from pta_replicator_tpu import obs  # noqa: E402
+from pta_replicator_tpu.batch import synthetic_batch  # noqa: E402
+from pta_replicator_tpu.models.batched import Recipe  # noqa: E402
+from pta_replicator_tpu.obs import names, occupancy  # noqa: E402
+from pta_replicator_tpu.utils.provenance import provenance_stamp  # noqa: E402
+from pta_replicator_tpu.utils.sweep import sweep  # noqa: E402
+
+NPSR = 8
+
+
+def _env(name, default):
+    return int(os.environ.get(name, str(default)))
+
+
+def build_workload(fast: bool):
+    if fast:
+        cfg = dict(ncw=_env("STAGE_GRAPH_NCW", 6000),
+                   stream_chunk=_env("STAGE_GRAPH_STREAM_CHUNK", 1024),
+                   ntoa=_env("STAGE_GRAPH_NTOA", 1024),
+                   nmodes=_env("STAGE_GRAPH_NMODES", 256),
+                   nreal=_env("STAGE_GRAPH_NREAL", 2048),
+                   chunk=_env("STAGE_GRAPH_CHUNK", 512),
+                   nrep=_env("STAGE_GRAPH_NREP", 1))
+    else:
+        cfg = dict(ncw=_env("STAGE_GRAPH_NCW", 10000),
+                   stream_chunk=_env("STAGE_GRAPH_STREAM_CHUNK", 1024),
+                   ntoa=_env("STAGE_GRAPH_NTOA", 2048),
+                   nmodes=_env("STAGE_GRAPH_NMODES", 384),
+                   nreal=_env("STAGE_GRAPH_NREAL", 4096),
+                   chunk=_env("STAGE_GRAPH_CHUNK", 1024),
+                   nrep=_env("STAGE_GRAPH_NREP", 3))
+    batch = synthetic_batch(npsr=NPSR, ntoa=cfg["ntoa"], seed=0)
+    rng = np.random.default_rng(1)
+    ncw = cfg["ncw"]
+    params = np.stack([
+        np.arccos(rng.uniform(-1, 1, ncw)),
+        rng.uniform(0, 2 * np.pi, ncw),
+        10 ** rng.uniform(8, 9.5, ncw),
+        rng.uniform(50, 1000, ncw),
+        10 ** rng.uniform(-8.8, -7.6, ncw),
+        rng.uniform(0, 2 * np.pi, ncw),
+        rng.uniform(0, np.pi, ncw),
+        np.arccos(rng.uniform(-1, 1, ncw)),
+    ])
+    # streamed CW catalog + red noise: the flagship shape in miniature —
+    # a per-chunk host f64 tile build comparable to (but below) the
+    # chunk's device compute + durable I/O, so the fused graph can hide
+    # the rebuild entirely while the stacked arm pays its windows
+    # back to back
+    recipe = Recipe(
+        efac=jnp.ones(NPSR, batch.toas_s.dtype),
+        rn_log10_amplitude=jnp.full(NPSR, -14.0, batch.toas_s.dtype),
+        rn_gamma=jnp.full(NPSR, 4.0, batch.toas_s.dtype),
+        rn_nmodes=cfg["nmodes"],
+        cgw_params=jnp.asarray(params),
+        cgw_stream_chunk=cfg["stream_chunk"],
+    )
+    return batch, recipe, cfg
+
+
+def run_arm(fused, batch, recipe, key, nreal, chunk, workdir):
+    """One sweep into a fresh cold-file subdirectory; returns
+    (wall_s, per-stage busy, overlap stats over the e2e window,
+    checkpoint sha256)."""
+    arm_dir = tempfile.mkdtemp(prefix=f"arm_{'fused' if fused else 'stacked'}_",
+                               dir=workdir)
+    ckpt = os.path.join(arm_dir, "sweep.npz")
+    obs.reset_all()
+    t0 = time.perf_counter()
+    sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+          checkpoint_path=ckpt, reduce_fn=None, pipeline_depth=2,
+          durable=True, fused_stream=fused)
+    wall = time.perf_counter() - t0
+    if obs.TRACER.dropped:
+        raise RuntimeError(
+            f"{obs.TRACER.dropped} span records dropped — arm larger "
+            "than the idle event buffer; shrink the workload"
+        )
+    events = obs.TRACER.events()
+    # the end-to-end stage set of each composition: the host-precompute
+    # stage (the whole static_delays call for stacked, the per-chunk
+    # static_build stage for fused — each CONTAINS its nested CW
+    # tile-stream spans, so neither is double-counted) plus the three
+    # sweep pipeline stages
+    static_span = (names.SPAN_STATIC_BUILD if fused
+                   else names.SPAN_STATIC_DELAYS)
+    stage_set = [static_span, names.SPAN_DISPATCH, names.SPAN_DRAIN,
+                 names.SPAN_IO_WRITE]
+    intervals = occupancy.stage_intervals(events, stages=stage_set)
+    busy = {s: occupancy.busy_seconds(intervals.get(s, []))
+            for s in stage_set}
+    stats = occupancy.overlap_stats(busy, wall)
+    h = hashlib.sha256()
+    with open(ckpt, "rb") as fh:
+        for piece in iter(lambda: fh.read(1 << 22), b""):
+            h.update(piece)
+    shutil.rmtree(arm_dir, ignore_errors=True)
+    return wall, busy, stats, h.hexdigest()
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv[1:]
+    batch, recipe, cfg = build_workload(fast)
+    key = jax.random.PRNGKey(7)
+    workdir = tempfile.mkdtemp(prefix="stage_graph_")
+    arms = {"stacked": [], "fused": []}
+    busies = {}
+    effs = {"stacked": [], "fused": []}
+    digests = {}
+    try:
+        # warm-up: compile the realize engine + stream steps at the
+        # bench shapes, touch the filesystem once
+        run_arm(False, batch, recipe, key, cfg["chunk"], cfg["chunk"],
+                workdir)
+        # interleave arms so filesystem/vCPU drift hits both equally
+        for _ in range(cfg["nrep"]):
+            for name, fused in (("stacked", False), ("fused", True)):
+                wall, busy, stats, digest = run_arm(
+                    fused, batch, recipe, key, cfg["nreal"],
+                    cfg["chunk"], workdir,
+                )
+                arms[name].append(wall)
+                eff = stats.get("overlap_efficiency")
+                if eff is not None:
+                    effs[name].append(eff)
+                if name not in busies or wall <= min(arms[name]):
+                    busies[name] = {k: round(v, 3) for k, v in busy.items()}
+                digests[name] = digest
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    med = lambda xs: float(np.median(xs)) if xs else None  # noqa: E731
+    stacked_eff = med(effs["stacked"])
+    fused_eff = med(effs["fused"])
+    stacked_wall = med(arms["stacked"])
+    fused_wall = med(arms["fused"])
+    bit_identical = digests.get("stacked") == digests.get("fused")
+
+    failures = []
+    if not bit_identical:
+        failures.append(
+            "checkpoints differ between the stacked and fused arms "
+            f"(sha256 {digests.get('stacked')} vs {digests.get('fused')})"
+        )
+    if stacked_eff is None or fused_eff is None:
+        failures.append("an arm produced no overlap-efficiency measure")
+    elif not fused_eff > stacked_eff:
+        failures.append(
+            "fused end-to-end overlap efficiency "
+            f"{fused_eff} is not strictly above the stacked baseline "
+            f"{stacked_eff}"
+        )
+
+    rec = {
+        "bench": "stage_graph",
+        **provenance_stamp(2, repo_root=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+        "fast": fast,
+        "workload": {
+            "npsr": NPSR, **cfg,
+            "nchunks": cfg["nreal"] // cfg["chunk"],
+            "reduce_fn": None, "durable_writes": True,
+            "pipeline_depth": 2,
+        },
+        "stacked": {
+            "wall_s": round(stacked_wall, 3),
+            "all_wall_s": [round(x, 3) for x in arms["stacked"]],
+            "overlap_efficiency_e2e": stacked_eff,
+            "stage_busy_s": busies.get("stacked"),
+        },
+        "fused": {
+            "wall_s": round(fused_wall, 3),
+            "all_wall_s": [round(x, 3) for x in arms["fused"]],
+            "overlap_efficiency_e2e": fused_eff,
+            "stage_busy_s": busies.get("fused"),
+        },
+        "efficiency_gain": (
+            None if None in (fused_eff, stacked_eff)
+            else round(fused_eff - stacked_eff, 3)
+        ),
+        # info, not a gate: at identical per-chunk content the fused
+        # graph does nchunks x the host tile-build work of the stacked
+        # arm and hides it under the compute/IO window — near-parity
+        # wall on this shared-core CPU host, real headroom on hosts
+        # with idle cores (see the bench docstring / docs/streaming.md)
+        "wall_ratio_fused_vs_stacked": round(fused_wall / stacked_wall, 3),
+        "bit_identical": bit_identical,
+        "gates": {
+            "bit_identical": bit_identical,
+            "fused_eff_above_stacked": bool(
+                fused_eff is not None and stacked_eff is not None
+                and fused_eff > stacked_eff
+            ),
+        },
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    print(json.dumps(rec))
+    if failures:
+        for reason in failures:
+            print(f"stage_graph GATE FAIL: {reason}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -306,6 +306,15 @@ def main(argv=None):
                         "device memory bound = depth x chunk result "
                         "size); 1 runs the synchronous debug loop. "
                         "Results are identical at every depth.")
+    p.add_argument("--fused-stream", action="store_true",
+                   help="run a checkpointed sweep as ONE end-to-end "
+                        "stage graph: each chunk's deterministic "
+                        "(streamed-CW) delays are rebuilt on a "
+                        "static_build stage overlapped with earlier "
+                        "chunks' compute, readback, and checkpoint "
+                        "writes (docs/streaming.md). Byte-identical "
+                        "results; requires --pipeline-depth >= 2 and "
+                        "no mesh")
     p.add_argument("--drain-timeout", type=float, default=900.0,
                    metavar="S",
                    help="fail a pipelined sweep when a single chunk "
@@ -817,6 +826,17 @@ def _run_command(args):
     from . import load_from_directories, make_ideal
     from .obs import names, span
 
+    if getattr(args, "fused_stream", False) and not args.checkpoint:
+        # only the checkpointed sweep runs the fused graph — silently
+        # running the plain realize path would let the user believe
+        # fused streaming happened (same refusal contract as the
+        # in-sweep mesh/depth checks). Checked before ingest: a typo'd
+        # invocation must not load datasets first.
+        raise SystemExit(
+            "--fused-stream needs --checkpoint: the fused stage graph "
+            "is the checkpointed sweep executor (docs/streaming.md)"
+        )
+
     with span(names.SPAN_INGEST, pardir=args.pardir):
         psrs = load_from_directories(args.pardir, args.timdir,
                                      num_psrs=args.num_psrs)
@@ -877,6 +897,7 @@ def _run_command(args):
                                          if args.drain_timeout > 0
                                          else None),
                         chunk_retries=args.chunk_retries,
+                        fused_stream=args.fused_stream,
                         progress=lambda d, t: print(f"chunk {d}/{t}",
                                                     file=sys.stderr))
         elif args.sharded or args.mesh_shape:

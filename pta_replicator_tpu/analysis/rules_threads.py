@@ -23,6 +23,16 @@ recorder work around by careful convention, enforced statically:
   anything else (Tracer._record runs listeners outside it for exactly
   this reason; ``_flush_from_signal`` exists because a suspended main
   thread may hold it).
+* ``parallel-adhoc-stage`` — a raw ``threading.Thread`` +
+  ``queue.Queue`` pipeline in package code outside ``parallel/``: the
+  hand-built staged-executor shape ``parallel/stages.py`` exists to
+  replace. An ad-hoc worker/queue pair re-implements (usually
+  partially) the bounded window, stop/drain handshake, DrainTimeout
+  heartbeats, in-order error propagation, and trace handoff the stage
+  graph provides once — declare a ``StageGraph`` instead, or suppress
+  inline with the reason the shape genuinely doesn't fit (the
+  likelihood server's deadline-coalescing request queue is the one
+  intentional site).
 """
 from __future__ import annotations
 
@@ -184,6 +194,51 @@ class WallTimeDuration(Rule):
                     break
 
 
+#: the package subtree the ad-hoc-stage rule polices, and the
+#: subpackage where staged executors legitimately live
+_PKG_PREFIX = "pta_replicator_tpu/"
+_STAGES_HOME = "pta_replicator_tpu/parallel/"
+
+
+class AdhocStagePipeline(Rule):
+    id = "parallel-adhoc-stage"
+    severity = "error"
+    description = (
+        "raw threading.Thread + queue.Queue pipeline outside parallel/ "
+        "— the shape parallel/stages.py (StageGraph) exists to replace"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not mod.relpath.startswith(_PKG_PREFIX):
+            return
+        if mod.relpath.startswith(_STAGES_HOME):
+            return  # the executors' own home
+        queue_lines = [
+            node.lineno for node in ast.walk(mod.tree)
+            if isinstance(node, ast.Call)
+            and (mod.resolve(node.func) or "") in (
+                "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+                "queue.PriorityQueue",
+            )
+        ]
+        if not queue_lines:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (mod.resolve(node.func) or "") != "threading.Thread":
+                continue
+            yield self.finding(
+                mod, node.lineno,
+                "worker thread + queue.Queue pipeline (queue built at "
+                f"line {queue_lines[0]}) hand-rolls the staged-executor "
+                "pattern — declare a parallel.stages.StageGraph (bounded "
+                "window, stop/drain, DrainTimeout heartbeats, in-order "
+                "errors, busy accounting, and trace handoff for free), "
+                "or suppress with the reason the graph doesn't fit",
+            )
+
+
 class LockOrderInversion(Rule):
     id = "thread-lock-order"
     severity = "error"
@@ -217,4 +272,5 @@ class LockOrderInversion(Rule):
                             "another thread taking them in order "
                             "deadlocks against this one",
                         )
-RULES = [UnlockedGlobalMutation(), WallTimeDuration(), LockOrderInversion()]
+RULES = [UnlockedGlobalMutation(), WallTimeDuration(),
+         LockOrderInversion(), AdhocStagePipeline()]

@@ -337,7 +337,7 @@ class LikelihoodServer:
             raise RuntimeError("server already started")
         self._closing = False
         self._started_at = time.monotonic()
-        self._worker = threading.Thread(
+        self._worker = threading.Thread(  # graftlint: disable=parallel-adhoc-stage — not a staged FIFO pipeline: the request queue coalesces by size/deadline (items are merged, not forwarded 1:1), admission control rejects at the bound instead of back-pressuring, and futures resolve out of the graph
             target=self._run, name="likelihood-serve", daemon=True
         )
         self._worker.start()

@@ -51,6 +51,12 @@ SPAN_IO_WRITE = "io_write"
 #: static precompute, the pipelined chunk loop, and consolidation — the
 #: occupancy window for multi-chip bottleneck attribution
 SPAN_MULTICHIP_SWEEP = "multichip_sweep"
+#: per-chunk CW static-delays stream build in the FUSED sweep graph
+#: (utils/sweep.py fused_stream=True): chunk i+1's tile-build/H2D
+#: stages run under this span concurrently with chunk i's compute,
+#: readback, and checkpoint write — rendered as the ``stage:
+#: static_build`` track in chrome-trace exports (docs/streaming.md)
+SPAN_STATIC_BUILD = "static_build"
 
 # streamed CW-catalog plane pipeline (parallel/prefetch.py,
 # models/batched.py cw_stream_response)
@@ -115,6 +121,7 @@ SPANS = frozenset({
     SPAN_SHARDED_REALIZE, SPAN_SHARDMAP_REALIZE,
     SPAN_SWEEP_CHUNK, SPAN_READBACK_FENCE, SPAN_SWEEP_PIPELINE,
     SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE, SPAN_MULTICHIP_SWEEP,
+    SPAN_STATIC_BUILD,
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_LIKELIHOOD_BATCH, SPAN_LIKELIHOOD_SERVE, SPAN_LIKELIHOOD_PROJECT,
     SPAN_LIKELIHOOD_SUBMIT, SPAN_LIKELIHOOD_QUEUE_WAIT,
@@ -222,6 +229,18 @@ LIKELIHOOD_DEADLINE_EXPIRED = "likelihood.deadline_expired"
 #: labeled site=/kind= — zero in any run that didn't arm a schedule
 FAULTS_INJECTED = "faults.injected"
 
+# stage-graph executor (parallel/stages.py): items queued per graph
+# edge (labeled edge="a->b"), cumulative per-stage busy seconds
+# (labeled stage=, device= for replica stages), and operations that
+# tripped the graph deadline. Every graph — the ported sweep pipeline,
+# both prefetchers, and the fused sweep — reports through these; the
+# ported declarations additionally keep their historical names
+# (sweep.inflight_chunks, pipeline.drain_timeouts, occupancy.busy_s,
+# cw_stream.prefetch_stall_s) via the graph's config hooks.
+STAGES_EDGE_INFLIGHT = "stages.edge_inflight"
+STAGES_BUSY_S = "stages.busy_s"
+STAGES_DRAIN_TIMEOUTS = "stages.drain_timeouts"
+
 # structured-covariance layer (covariance/kernels.py eager helpers):
 # eager CovOp solves priced, and the running fraction of them that
 # took a structured (banded/Kronecker/blocked) path instead of the
@@ -292,6 +311,7 @@ METRICS = frozenset({
     LIKELIHOOD_QUEUE_DEPTH, LIKELIHOOD_REJECTED,
     LIKELIHOOD_DEADLINE_EXPIRED,
     FAULTS_INJECTED,
+    STAGES_EDGE_INFLIGHT, STAGES_BUSY_S, STAGES_DRAIN_TIMEOUTS,
     COV_SOLVES, COV_BLOCKED_FRACTION,
     SCENARIO_COMPILED, SCENARIO_FUZZ_CASES,
     SCENARIO_FUZZ_DISAGREEMENTS, SCENARIO_SHRINK_STEPS,
@@ -328,6 +348,7 @@ SWEEP_PREFIX = "sweep."
 FLIGHTREC_PREFIX = "flightrec."
 PIPELINE_PREFIX = "pipeline."
 CW_STREAM_PREFIX = "cw_stream."
+STAGES_PREFIX = "stages."
 LIKELIHOOD_PREFIX = "likelihood."
 FAULTS_PREFIX = "faults."
 COV_PREFIX = "cov."

@@ -53,6 +53,7 @@ STAGES: Dict[str, str] = {
     names.SPAN_SWEEP_CHUNK: "compute",
     names.SPAN_READBACK_FENCE: "readback",
     names.SPAN_CW_STREAM_STAGE: "host-precompute",
+    names.SPAN_STATIC_BUILD: "host-precompute",
 }
 
 #: dataflow order of the stage tracks in chrome-trace exports: the
@@ -62,6 +63,7 @@ STAGES: Dict[str, str] = {
 #: tuple, so merged timelines render stages in pipeline order instead
 #: of dict/tid order.
 STAGE_SORT_ORDER: Tuple[str, ...] = (
+    names.SPAN_STATIC_BUILD,
     names.SPAN_DISPATCH,
     names.SPAN_DRAIN,
     names.SPAN_IO_WRITE,

@@ -100,6 +100,12 @@ _HIGHER_BETTER_TOKENS = (
     # engine's higher-is-healthier score (burn rates are lower-better
     # overrides below — "rate" must NOT pull them higher-better)
     "stitched", "budget_remaining",
+    # STAGES series (benchmarks/stage_graph.py, PR 15): the fused
+    # sweep's measured end-to-end overlap efficiency over the whole
+    # window (host precompute + H2D + compute + D2H + durable write) —
+    # "efficiency" already matches; spelled out so the gate's contract
+    # for the series is explicit
+    "overlap_efficiency_e2e",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves
@@ -126,7 +132,14 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
                         "disagreement",
                         # SLO breach-episode counts and open-at-exit
                         # trace counts are costs (PR 14)
-                        "breach", "open_traces")
+                        "breach", "open_traces",
+                        # STAGES series (PR 15): consumer-starvation
+                        # stall seconds and dispatcher window waits are
+                        # costs — a rising stall is the pipeline losing
+                        # the overlap the fused graph exists to buy
+                        # ("stall_s"/"_wait_s" also ride the _s suffix;
+                        # spelled out for the explicit-contract reason)
+                        "stall_s", "window_wait")
 #: leaf fragments that must classify lower-better BEFORE the
 #: higher-better token scan: burn_rate_* contains "rate" (a
 #: higher-better token) but a rising SLO burn rate is budget being

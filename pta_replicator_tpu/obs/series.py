@@ -88,6 +88,10 @@ DEFAULT_PREFIXES: Tuple[str, ...] = (
     names.CW_STREAM_PREFIX,
     names.OCCUPANCY_PREFIX,
     names.PIPELINE_PREFIX,
+    # the stage-graph executor's per-edge queue depth and per-stage
+    # busy gauges (PR 15): where a fused sweep's backlog lives over
+    # time is exactly a sparkline question
+    names.STAGES_PREFIX,
     names.FLIGHTREC_PREFIX,
     "jax.compiles",
     "jax.traces",

@@ -1,4 +1,4 @@
-from . import distributed, pipeline, prefetch
+from . import distributed, pipeline, prefetch, stages
 from .mesh import (
     make_mesh,
     shard_batch,
@@ -13,11 +13,15 @@ from .prefetch import (
     prefetch_to_device,
     save_plane_tiles,
 )
+from .stages import Stage, StageGraph
 
 __all__ = [
     "distributed",
     "pipeline",
     "prefetch",
+    "stages",
+    "Stage",
+    "StageGraph",
     "make_mesh",
     "shard_batch",
     "sharded_realize",

@@ -1,93 +1,65 @@
-"""Asynchronous double-buffered chunk executor: overlap device compute
-with host readback and checkpoint I/O.
+"""Pipelined chunk executor: overlap device compute with host readback
+and checkpoint I/O.
 
-The synchronous sweep loop (utils/sweep.py before this module existed)
-serialized three stages per chunk:
+Since PR 15 this module is a thin DECLARATION over the composable
+stage-graph executor (parallel/stages.py — ROADMAP open item 5): the
+dispatch -> drain -> io_write chain, its bounded in-flight window, the
+``DrainTimeout`` deadline, in-order exception re-raise, stop/drain
+semantics, per-stage busy accounting, fault sites, and the per-chunk
+trace handoff are all the generic executor's machinery; what lives here
+is only the sweep pipeline's shape and its pinned public contract:
 
-    dispatch chunk i -> block on host readback -> write .npy + sidecar
-
-so the device idled for the full readback + reduction + disk latency of
-every chunk — on the tunneled TPU backend that latency dominates the
-per-chunk cost (PR 1 telemetry: the ``readback_fence`` span).
-:func:`run_pipelined` splits the stages onto three actors:
-
-* the **caller's thread** dispatches chunks back-to-back. JAX dispatch is
-  asynchronous, so ``dispatch(i)`` returns an *un-fetched* device array
-  and the device starts chunk *i+1* while chunk *i* is still draining;
-* a single **reader thread** fetches results back to host (the readback
-  IS the device-sync fence on the tunneled backend — see bench.py), in
-  dispatch order;
-* a single **writer thread** runs ``write(i, block)`` — the checkpoint
-  chunk file + ``done`` sidecar — strictly in chunk order, preserving
-  the crash-safety contract (chunk file lands before the sidecar that
-  marks it done, and chunk *i*'s files land before chunk *i+1*'s).
+* the **caller's thread** dispatches chunks back-to-back (JAX dispatch
+  is asynchronous, so ``dispatch(i)`` returns an *un-fetched* device
+  array and the device starts chunk *i+1* while chunk *i* drains);
+* a single **reader thread** fetches results to host (the readback IS
+  the device-sync fence on the tunneled backend), in dispatch order;
+* a single **writer thread** runs ``write(i, block)`` strictly in
+  chunk order, preserving the crash-safety contract (chunk file lands
+  before the sidecar that marks it done, chunk *i* before *i+1*).
 
 The in-flight window is bounded by ``depth`` (default 2, classic double
-buffering): at most ``depth`` un-fetched chunk results exist at once, so
-device memory use is bounded by ``depth x chunk_result_nbytes`` no matter
-how far the dispatcher could run ahead.  A hung readback (wedged tunnel)
-fails fast: when no fetch completes within ``drain_timeout_s`` the run
-raises :class:`DrainTimeout` instead of blocking forever (the wedged
-reader thread is a daemon, so process exit is never held hostage).
+buffering); a hung readback or checkpoint write fails fast with
+:class:`DrainTimeout`. Determinism: the executor changes *when* results
+are fetched and written, never *what* is computed — same dispatch
+order, one reader, one writer, FIFO queues — so a pipelined sweep is
+byte-identical to the synchronous loop (tests/test_pipeline.py proves
+it on the checkpoint files themselves).
 
-Determinism: the executor changes *when* results are fetched and
-written, never *what* is computed — same dispatch order, one reader, one
-writer, FIFO queues — so a pipelined sweep is byte-identical to the
-synchronous loop (tests/test_pipeline.py proves it on the checkpoint
-files themselves).
-
-Telemetry: ``dispatch`` / ``drain`` / ``io_write`` spans per chunk (the
-reader and writer adopt the caller's span ancestry, so they nest under
-the sweep span in the report tree) and the ``sweep.inflight_chunks``
-gauge. The executor also accounts each stage's busy seconds itself and
-returns them — with duty cycles, overlap efficiency, and a bottleneck
-verdict (``obs.occupancy.overlap_stats``) — in its stats dict, which
-``utils.sweep`` stamps into the ``sweep_pipeline`` span attrs; the
-``obs.report`` utilization section renders the same numbers for any
-captured run (docs/performance.md).
+Telemetry: ``dispatch`` / ``drain`` / ``io_write`` spans per chunk
+(worker spans nest under the sweep span and adopt the chunk's carried
+trace context), the ``sweep.inflight_chunks`` gauge, and the stats
+dict (``chunks``, ``wall_s``, ``max_inflight``, ``drain_wait_s``,
+``stage_busy_s``, ``occupancy``) that ``utils.sweep`` stamps into the
+``sweep_pipeline`` span attrs — all names pinned unchanged across the
+port to the stage graph.
 """
 from __future__ import annotations
 
 import itertools
-import queue
-import threading
-import time
 from typing import Callable, Iterable, Optional
 
 import numpy as np
 
 from ..faults import inject as faults
-from ..obs import counter, gauge, names, occupancy, span
-from ..obs.trace import TRACER, adopt, chunk_trace_context
+from ..obs import gauge, names
+# Re-exported for the historical import path: DrainTimeout (and the
+# executor types the declarations below use) now live with the generic
+# executor, but every existing `from parallel.pipeline import
+# DrainTimeout` caller, test, and doc reference keeps working. The old
+# private helpers (_stop_aware_put/_stage_overdue) moved to stages.py
+# as stop_aware_put/stage_overdue — their one remaining importer
+# (prefetch.py) imports them there.
+from .stages import (  # noqa: F401 — public re-exports
+    DrainTimeout,
+    Stage,
+    StageGraph,
+)
 
 #: default trace scopes for callers that pass none: a per-call counter,
 #: so two pipelines in one process never share chunk trace ids (the
 #: sweep passes its checkpoint path instead — stable across retries)
 _RUN_COUNT = itertools.count()
-
-
-class DrainTimeout(RuntimeError):
-    """A host readback or checkpoint write stalled past
-    ``drain_timeout_s`` — the backend (tunnel) or the checkpoint
-    filesystem is wedged mid-operation."""
-
-
-_STOP = object()  # queue sentinel: no more chunks
-
-
-def _stop_aware_put(q: queue.Queue, item, stop: threading.Event) -> bool:
-    """Bounded-queue put that stays responsive to ``stop``. Returns
-    False when the pipeline is stopping. The ONE implementation of the
-    back-pressure handshake, shared by this executor's worker threads
-    and the host->device prefetch stage (parallel.prefetch) built on
-    the same bounded-window pattern."""
-    while not stop.is_set():
-        try:
-            q.put(item, timeout=0.1)
-            return True
-        except queue.Full:
-            pass
-    return False
 
 
 def _mark_chunk(exc: BaseException, chunk: int) -> None:
@@ -110,14 +82,59 @@ def failed_chunk(exc: BaseException) -> Optional[int]:
     return None if chunk is None else int(chunk)
 
 
-def _stage_overdue(started_box: list, timeout_s: Optional[float]) -> bool:
-    """True when the single-writer heartbeat ``started_box[0]`` (the
-    monotonic start of the stage operation currently in flight, None
-    between items) has been in flight longer than ``timeout_s``."""
-    if timeout_s is None:
-        return False
-    t0 = started_box[0]
-    return t0 is not None and time.monotonic() - t0 > timeout_s
+# The sweep pipeline's stage vocabulary, shared verbatim by
+# run_pipelined below and the FUSED sweep graph (utils.sweep.
+# _run_fused_stream): one definition of each stage's telemetry and
+# window contract, so the fused and stacked declarations can never
+# silently fork the behavior the byte-identity tests pin as equal.
+
+def _dispatch_on_done(i, _out) -> None:
+    # heartbeat feed: how far ahead of the drained/written chunks the
+    # dispatcher is running (sweep.chunks_done lags this by the
+    # in-flight window)
+    gauge(names.SWEEP_LAST_DISPATCHED_CHUNK).set(i)
+
+
+def drain_stage(fetch: Callable, depth: int) -> Stage:
+    """The host-readback stage: fences the device, frees the window
+    slot, feeds the writer through a depth-bounded edge."""
+    return Stage(
+        "drain",
+        fn=lambda i, dev, sp: fetch(dev),
+        span=names.SPAN_DRAIN,
+        fault_site=faults.SITE_DRAIN,
+        releases_window=True,
+        out_maxsize=depth,
+        heartbeat_label="host readback",
+        thread_name="sweep-drain",
+    )
+
+
+def io_write_stage(write: Callable) -> Stage:
+    """The checkpoint-writer sink: strictly in chunk order."""
+    return Stage(
+        "io_write",
+        fn=lambda i, block, sp: write(i, block),
+        span=names.SPAN_IO_WRITE,
+        span_attrs=lambda i, block: {"nbytes": int(block.nbytes)},
+        fault_site=faults.SITE_IO_WRITE,
+        heartbeat_label="checkpoint write",
+        thread_name="sweep-io",
+    )
+
+
+def pipeline_stats(g: dict) -> dict:
+    """Map the generic graph stats onto the sweep pipeline's pinned
+    contract (utils.sweep stamps these into the sweep_pipeline span
+    attrs; obs.report renders them)."""
+    return {
+        "chunks": g["items"],
+        "max_inflight": g["max_inflight"],
+        "drain_wait_s": g["window_wait_s"],
+        "wall_s": g["wall_s"],
+        "stage_busy_s": g["stage_busy_s"],
+        "occupancy": g["occupancy"],
+    }
 
 
 def run_pipelined(
@@ -145,7 +162,8 @@ def run_pipelined(
 
     Returns a stats dict (``chunks``, ``wall_s``, ``max_inflight``,
     ``drain_wait_s`` — time the dispatcher spent blocked on the full
-    window, i.e. how much *further* ahead it could have run).
+    window, i.e. how much *further* ahead it could have run — plus
+    ``stage_busy_s`` and the measured ``occupancy``).
 
     A failing stage stops the pipeline and its exception re-raises on
     the caller's thread UNCHANGED (exactly what the synchronous loop
@@ -174,238 +192,30 @@ def run_pipelined(
             f"pipeline depth must be >= 2 (got {depth}); depth 1 is the "
             "synchronous loop — run it inline, there is nothing to overlap"
         )
-
-    # the window semaphore is the memory bound: a slot is taken BEFORE a
-    # chunk is dispatched and released when its fetch completes, so at
-    # most ``depth`` un-fetched device results exist at any instant (the
-    # queues themselves then never hold more than depth entries)
-    window = threading.Semaphore(depth)
-    drain_q: queue.Queue = queue.Queue()
-    io_q: queue.Queue = queue.Queue(maxsize=depth)
-    stop = threading.Event()
-    errors: list = []  # [(stage, exc)] — first entry wins
-    stack = TRACER.current_stack()  # nest worker spans under the caller's
     scope = (
         trace_scope if trace_scope is not None
         else f"pipeline:{next(_RUN_COUNT)}"
     )
 
-    # stage heartbeats for the deadline: monotonic start time of the
-    # fetch / write currently in flight, None while that worker is
-    # between items. Both are covered — a checkpoint directory on a
-    # hung mount wedges the WRITER first (io_q then fills and the
-    # reader parks between fetches), and must trip the same deadline
-    # a wedged readback does.
-    fetch_started = [None]
-    write_started = [None]
-    inflight = [0]  # dispatched - drained, under lock
-    lock = threading.Lock()
-    stats = {"chunks": 0, "max_inflight": 0, "drain_wait_s": 0.0}
-    # per-stage busy seconds (each stage is a single actor, so its busy
-    # time is just the sum of its operation durations) — folded into
-    # occupancy.overlap_stats at the end so every pipelined run reports
-    # its own duty cycles, overlap efficiency, and bottleneck verdict
-    busy = {names.SPAN_DISPATCH: 0.0, names.SPAN_DRAIN: 0.0,
-            names.SPAN_IO_WRITE: 0.0}
-
-    def _busy(stage: str, seconds: float) -> None:
-        with lock:
-            busy[stage] += seconds
-
-    def _fail(stage: str, exc: BaseException, chunk=None) -> None:
-        if chunk is not None:
-            _mark_chunk(exc, chunk)
-        with lock:
-            errors.append((stage, exc))
-        stop.set()
-
-    def _bump(delta: int) -> None:
-        with lock:
-            inflight[0] += delta
-            stats["max_inflight"] = max(stats["max_inflight"], inflight[0])
-            gauge(names.SWEEP_INFLIGHT_CHUNKS).set(inflight[0])
-
-    def _put(q: queue.Queue, item) -> bool:
-        return _stop_aware_put(q, item, stop)
-
-    def _check_deadline() -> None:
-        for stage, started, what in (
-            ("drain", fetch_started, "host readback"),
-            ("io_write", write_started, "checkpoint write"),
-        ):
-            if _stage_overdue(started, drain_timeout_s):
-                # distinct from flightrec.stalls: the flight recorder's
-                # watchdog WARNS early on any quiet run; this deadline
-                # hard-fails one provably wedged fetch/write. Both land
-                # in the heartbeat so `watch` shows warning-then-kill.
-                counter(names.PIPELINE_DRAIN_TIMEOUTS).inc()
-                _fail(
-                    stage,
-                    DrainTimeout(
-                        f"{what} exceeded {drain_timeout_s:.0f}s — "
-                        "backend or filesystem wedged"
-                    ),
-                )
-
-    def _reader() -> None:
-        with TRACER.inherit(stack):
-            while True:
-                item = drain_q.get()
-                if item is _STOP or stop.is_set():
-                    break
-                i, dev, ctx = item
-                try:
-                    fetch_started[0] = time.monotonic()
-                    # adopt the chunk's carried trace: the drain span
-                    # (and any fault fired inside it) stitches onto the
-                    # same trace_id the dispatch span opened
-                    with adopt(ctx), span(names.SPAN_DRAIN, chunk=i):
-                        faults.fire(names.SPAN_DRAIN, chunk=i)
-                        block = fetch(dev)
-                    _busy(names.SPAN_DRAIN,
-                          time.monotonic() - fetch_started[0])
-                    fetch_started[0] = None
-                    if stop.is_set():
-                        # abandoned run: a DrainTimeout already raised on
-                        # the caller's thread and a RETRY sweep may be
-                        # live — a late-unwedging fetch must not mutate
-                        # the shared gauge/window under the retry's feet
-                        break
-                    _bump(-1)
-                    window.release()
-                except BaseException as exc:  # noqa: BLE001 — must not die silently
-                    fetch_started[0] = None
-                    _fail("drain", exc, chunk=i)
-                    break
-                if not _put(io_q, (i, block, ctx)):
-                    break
-            _put(io_q, _STOP)
-            # unblock a writer waiting on an empty queue even if the
-            # stop-aware put above bailed out
-            if stop.is_set():
-                try:
-                    io_q.put_nowait(_STOP)
-                except queue.Full:
-                    pass
-
-    def _writer() -> None:
-        with TRACER.inherit(stack):
-            while True:
-                item = io_q.get()
-                if item is _STOP or stop.is_set():
-                    break
-                i, block, ctx = item
-                try:
-                    write_started[0] = time.monotonic()
-                    with adopt(ctx), \
-                            span(names.SPAN_IO_WRITE, chunk=i,
-                                 nbytes=int(block.nbytes)):
-                        faults.fire(names.SPAN_IO_WRITE, chunk=i)
-                        write(i, block)
-                    _busy(names.SPAN_IO_WRITE,
-                          time.monotonic() - write_started[0])
-                    write_started[0] = None
-                    with lock:
-                        stats["chunks"] += 1
-                except BaseException as exc:  # noqa: BLE001
-                    write_started[0] = None
-                    _fail("io_write", exc, chunk=i)
-                    break
-
-    reader = threading.Thread(target=_reader, name="sweep-drain", daemon=True)
-    writer = threading.Thread(target=_writer, name="sweep-io", daemon=True)
-    t_start = time.monotonic()
-    reader.start()
-    writer.start()
-
-    try:
-        for i in indices:
-            # take a window slot BEFORE dispatching: this is where the
-            # dispatcher blocks when the device is ``depth`` chunks
-            # ahead (drain_wait_s), and where a wedged drain surfaces
-            t_wait = time.monotonic()
-            while not window.acquire(timeout=0.1):
-                _check_deadline()
-                if stop.is_set():
-                    break
-            stats["drain_wait_s"] += time.monotonic() - t_wait
-            if stop.is_set():
-                break
-            try:
-                t_disp = time.monotonic()
-                ctx = chunk_trace_context(scope, i)
-                with adopt(ctx), span(names.SPAN_DISPATCH, chunk=i):
-                    faults.fire(names.SPAN_DISPATCH, chunk=i)
-                    dev = dispatch(i)
-                _busy(names.SPAN_DISPATCH, time.monotonic() - t_disp)
-            except BaseException as exc:  # noqa: BLE001
-                _fail("dispatch", exc, chunk=i)
-                break
-            # heartbeat feed: how far ahead of the drained/written
-            # chunks the dispatcher is running (sweep.chunks_done lags
-            # this by the in-flight window)
-            gauge(names.SWEEP_LAST_DISPATCHED_CHUNK).set(i)
-            _bump(+1)
-            if not _put(drain_q, (i, dev, ctx)):
-                break
-    finally:
-        def _emergency_sentinels() -> None:
-            # a wedged reader never forwards the sentinel, so wake a
-            # writer blocked on an empty queue ourselves (a full queue
-            # means the writer has items — it re-checks stop per item),
-            # and unblock a reader parked on an empty drain_q
-            for q in (drain_q, io_q):
-                try:
-                    q.put_nowait(_STOP)
-                except queue.Full:
-                    pass
-
-        # orderly shutdown on success; on error the workers see stop
-        _put(drain_q, _STOP)
-        sentinels_sent = stop.is_set()
-        if sentinels_sent:
-            _emergency_sentinels()
-        # join with a heartbeat so a wedged fetch still hits the deadline
-        quiesce_deadline = None
-        while reader.is_alive() or writer.is_alive():
-            reader.join(timeout=0.2)
-            writer.join(timeout=0.2)
-            _check_deadline()
-            if stop.is_set() and not sentinels_sent:
-                # the deadline fired INSIDE this loop (late wedge, after
-                # all chunks were dispatched): wake the workers now or
-                # the idle writer would sit in io_q.get() for another
-                # full quiesce window before we could raise
-                sentinels_sent = True
-                _emergency_sentinels()
-            if stop.is_set() and errors:
-                # failure path: the reader may be wedged inside a dead
-                # fetch (daemon — abandoned), but the WRITER must
-                # quiesce before we raise: the caller may retry the
-                # sweep immediately, and a still-running writer would
-                # race the retry's checkpoint files. The writer always
-                # exits once its in-flight write returns; bound the
-                # wait only against a wedged write syscall.
-                if not writer.is_alive():
-                    break
-                if quiesce_deadline is None:
-                    quiesce_deadline = time.monotonic() + (
-                        drain_timeout_s if drain_timeout_s is not None
-                        else 900.0
-                    )
-                elif time.monotonic() > quiesce_deadline:
-                    break
-        gauge(names.SWEEP_INFLIGHT_CHUNKS).set(0)
-
-    if errors:
-        _stage, exc = errors[0]
-        raise exc
-    stats["wall_s"] = time.monotonic() - t_start
-    stats["drain_wait_s"] = round(stats["drain_wait_s"], 6)
-    stats["stage_busy_s"] = {k: round(v, 6) for k, v in busy.items()}
-    # measured occupancy of THIS run: duty cycles, overlap efficiency
-    # (how close wall came to the longest single stage), and the
-    # bottleneck verdict — lands in the sweep_pipeline span attrs via
-    # utils.sweep, and in the obs.report utilization section
-    stats["occupancy"] = occupancy.overlap_stats(busy, stats["wall_s"])
-    return stats
+    graph = StageGraph(
+        [
+            Stage(
+                "dispatch",
+                fn=lambda i, _p, sp: dispatch(i),
+                span=names.SPAN_DISPATCH,
+                fault_site=faults.SITE_DISPATCH,
+                on_done=_dispatch_on_done,
+                heartbeat=False,  # runs on the caller — see stages.py
+            ),
+            drain_stage(fetch, depth),
+            io_write_stage(write),
+        ],
+        window=depth,
+        drain_timeout_s=drain_timeout_s,
+        trace_scope=scope,
+        timeout_counter=names.PIPELINE_DRAIN_TIMEOUTS,
+        inflight_gauge=names.SWEEP_INFLIGHT_CHUNKS,
+        mark_item=_mark_chunk,
+        name="sweep",
+    )
+    return pipeline_stats(graph.run(indices))

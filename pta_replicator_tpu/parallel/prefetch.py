@@ -6,9 +6,14 @@ never holds the full catalog anywhere: the f64 host precompute emits
 cw_catalog_plane_tiles), and this module's :func:`prefetch_to_device`
 stages tile ``k+1``'s ``jax.device_put`` on a background thread while
 the jitted per-tile accumulator consumes tile ``k`` — the classic
-input-pipeline shape, built on the same bounded-window dispatcher
-pattern as the pipelined sweep executor (parallel.pipeline, whose
-stop-aware put / stage-heartbeat helpers it reuses).
+input-pipeline shape. Since PR 15 both prefetchers here are thin
+DECLARATIONS over the composable stage-graph executor
+(parallel/stages.py): the bounded window, stop/drain handshake,
+``DrainTimeout`` heartbeats, in-order exception re-raise, busy
+accounting, and the carry()/adopt() trace handoff are the generic
+executor's machinery; this module owns only the staging stage bodies
+(device_put + the transient-retry wrapper), their pinned telemetry
+names, and the tile cache.
 
 Window semantics (``depth``): a slot is taken *before* a tile is built
 and staged, and released when the consumer comes back for the next
@@ -44,9 +49,6 @@ back lazily, member-by-member, straight into the prefetcher.
 from __future__ import annotations
 
 import json
-import queue
-import threading
-import time
 import zipfile
 from typing import Callable, Iterable, Iterator, Optional
 
@@ -54,12 +56,9 @@ import numpy as np
 
 from ..faults import inject as faults
 from ..faults.retry import is_transient
-from ..obs import counter, event, gauge, names, span, tree_nbytes
-from ..obs.trace import TRACER, adopt, carry
+from ..obs import counter, event, names, tree_nbytes
 from ..utils.sweep import durable_replace, npy_bytes
-from .pipeline import DrainTimeout, _stage_overdue, _stop_aware_put
-
-_STOP = object()  # queue sentinel: no more tiles
+from .stages import DrainTimeout, Stage, StageGraph  # noqa: F401 — re-export
 
 
 def _default_place(tile):
@@ -111,107 +110,50 @@ def prefetch_to_device(
     if place is None:
         place = _default_place
 
-    window = threading.Semaphore(depth)
-    out_q: queue.Queue = queue.Queue()
-    stop = threading.Event()
-    errors: list = []  # [exc] — first entry wins
-    stage_started = [None]  # single-writer heartbeat (worker writes)
-    stall_s = [0.0]
-    # cumulative staging busy seconds (single-writer: the worker), fed
-    # to the occupancy.busy_s gauge so a capture records how much of
-    # the stream's wall the host-precompute+H2D stage was actually
-    # working — the post-hoc duty/bottleneck math runs on the
-    # cw_stream_stage spans (obs.occupancy)
-    busy_s = [0.0]
-    stack = TRACER.current_stack()  # nest worker spans under the caller's
-    tctx = carry()  # trace handoff: stage spans stitch onto the
-    #                 consumer's live trace (None = untraced, a no-op)
+    nbytes_box = [0]  # single staging worker: set in fn, read in on_done
 
-    def _worker() -> None:
-        with TRACER.inherit(stack), adopt(tctx):
-            it = iter(tiles)
-            i = 0
-            while not stop.is_set():
-                while not window.acquire(timeout=0.1):
-                    if stop.is_set():
-                        break
-                if stop.is_set():
-                    break
-                try:
-                    stage_started[0] = time.monotonic()
-                    with span(names.SPAN_CW_STREAM_STAGE, tile=i) as sp:
-                        try:
-                            tile = next(it)
-                        except StopIteration:
-                            sp["eos"] = True
-                            stage_started[0] = None
-                            break
-                        nbytes = tree_nbytes(tile)
+    def stage_fn(i, tile, sp):
+        nbytes = tree_nbytes(tile)
 
-                        def _stage_once(tile=tile, i=i):
-                            faults.fire(faults.SITE_PREFETCH_STAGE,
-                                        tile=i)
-                            return place(tile)
+        def _stage_once(tile=tile, i=i):
+            faults.fire(faults.SITE_PREFETCH_STAGE, tile=i)
+            return place(tile)
 
-                        staged = _stage_with_retry(_stage_once, tile=i)
-                        sp["nbytes"] = nbytes
-                    busy_s[0] += time.monotonic() - stage_started[0]
-                    stage_started[0] = None
-                    counter(names.CW_STREAM_BYTES_STAGED).inc(nbytes)
-                    gauge(names.OCCUPANCY_BUSY_S,
-                          stage=names.SPAN_CW_STREAM_STAGE).set(
-                        round(busy_s[0], 6))
-                except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
-                    stage_started[0] = None
-                    errors.append(exc)
-                    stop.set()
-                    break
-                if not _stop_aware_put(out_q, (i, staged), stop):
-                    break
-                i += 1
-            # always deliver the sentinel, even when stopping: the
-            # consumer may be parked on an empty queue
-            try:
-                out_q.put_nowait(_STOP)
-            except queue.Full:  # pragma: no cover — out_q is unbounded
-                pass
-
-    worker = threading.Thread(
-        target=_worker, name="cw-stream-prefetch", daemon=True
-    )
-    worker.start()
+        staged = _stage_with_retry(_stage_once, tile=i)
+        sp["nbytes"] = nbytes
+        nbytes_box[0] = nbytes
+        return staged
 
     # NOTE: the cw_stream.tiles_done gauge is deliberately NOT set here:
     # this stage's unit is "staged items", which consumers may group
     # (cw_stream_response stages macros of tiles_per_step tiles) — the
     # consumer owns the gauge so it always reads in TILE units.
-    try:
-        while True:
-            t_wait = time.monotonic()
-            while True:
-                try:
-                    item = out_q.get(timeout=0.1)
-                    break
-                except queue.Empty:
-                    if _stage_overdue(stage_started, stall_timeout_s):
-                        raise DrainTimeout(
-                            "host->device tile staging exceeded "
-                            f"{stall_timeout_s:.0f}s — backend wedged"
-                        )
-            stall_s[0] += time.monotonic() - t_wait
-            gauge(names.CW_STREAM_PREFETCH_STALL_S).set(
-                round(stall_s[0], 6)
-            )
-            if item is _STOP:
-                break
-            _i, staged = item
-            yield staged
-            window.release()
-    finally:
-        stop.set()
-        worker.join(timeout=5.0)
-    if errors:
-        raise errors[0]
+    graph = StageGraph(
+        [
+            Stage(
+                "cw_stream_stage",
+                fn=stage_fn,
+                span=names.SPAN_CW_STREAM_STAGE,
+                index_attr="tile",
+                # cumulative staging busy seconds feed the
+                # occupancy.busy_s gauge so a capture records how much
+                # of the stream's wall the host-precompute+H2D stage
+                # was actually working
+                busy_gauge=True,
+                on_done=lambda i, _staged: counter(
+                    names.CW_STREAM_BYTES_STAGED
+                ).inc(nbytes_box[0]),
+                heartbeat_label="host->device tile staging",
+                thread_name="cw-stream-prefetch",
+            ),
+        ],
+        window=depth,
+        drain_timeout_s=stall_timeout_s,
+        stall_gauge=names.CW_STREAM_PREFETCH_STALL_S,
+        stall_what="host->device tile staging",
+        name="cw-stream",
+    )
+    return graph.iterate(tiles)
 
 
 def prefetch_to_mesh(
@@ -275,167 +217,79 @@ def prefetch_to_mesh(
     if not devs:
         raise ValueError("mesh has no addressable devices in this process")
 
-    window = threading.Semaphore(depth)
-    in_qs = {d: queue.Queue() for d in devs}
-    out_qs = {d: queue.Queue() for d in devs}
-    stop = threading.Event()
-    errors: list = []  # first entry wins (workers append under the GIL)
-    produce_started = [None]  # single-writer heartbeats (owner writes)
-    stage_started = {d: [None] for d in devs}
-    busy = {d: [0.0] for d in devs}
     treedef_box = [None]
-    stack = TRACER.current_stack()  # nest worker spans under the caller's
-    tctx = carry()  # trace handoff for producer + per-device stagers
 
-    def _producer() -> None:
-        with TRACER.inherit(stack), adopt(tctx):
-            it = iter(tiles)
-            while not stop.is_set():
-                while not window.acquire(timeout=0.1):
-                    if stop.is_set():
-                        break
-                if stop.is_set():
-                    break
-                try:
-                    produce_started[0] = time.monotonic()
-                    try:
-                        tile = next(it)
-                    except StopIteration:
-                        produce_started[0] = None
-                        break
-                    leaves, treedef = jax.tree_util.tree_flatten(tile)
-                    leaves = [np.asarray(x) for x in leaves]
-                    if len(leaves) != len(shardings):
-                        raise ValueError(
-                            f"tile has {len(leaves)} leaves but specs "
-                            f"has {len(shardings)}"
-                        )
-                    treedef_box[0] = treedef
-                    produce_started[0] = None
-                except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
-                    produce_started[0] = None
-                    errors.append(exc)
-                    stop.set()
-                    break
-                delivered = True
-                for d in devs:
-                    if not _stop_aware_put(in_qs[d], leaves, stop):
-                        delivered = False
-                        break
-                if not delivered:
-                    break
-            for d in devs:
-                try:
-                    in_qs[d].put_nowait(_STOP)
-                except queue.Full:  # pragma: no cover — in_qs unbounded
-                    pass
+    def produce(i, tile, sp):
+        """Host tile build + flatten (the source worker's f64 math)."""
+        leaves, treedef = jax.tree_util.tree_flatten(tile)
+        leaves = [np.asarray(x) for x in leaves]
+        if len(leaves) != len(shardings):
+            raise ValueError(
+                f"tile has {len(leaves)} leaves but specs "
+                f"has {len(shardings)}"
+            )
+        treedef_box[0] = treedef
+        return leaves
 
-    def _stager(d) -> None:
-        with TRACER.inherit(stack), adopt(tctx):
-            beat = stage_started[d]
-            label = str(getattr(d, "id", d))
-            k = 0
-            while True:
-                item = in_qs[d].get()
-                # break on the sentinel ONLY (not on a bare stop): a
-                # producer error must not make one device abandon tiles
-                # its peers already staged — earlier tiles are yielded
-                # in order before the error re-raises, and the residual
-                # work is bounded by the window (<= depth tiles)
-                if item is _STOP:
-                    break
-                leaves = item
-                try:
-                    beat[0] = time.monotonic()
-                    with span(names.SPAN_CW_STREAM_STAGE, tile=k,
-                              device=label) as sp:
+    def stage_on_device(d, k, leaves, sp):
+        """One device's own device_put of its slice of tile ``k``."""
+        label = str(getattr(d, "id", d))
 
-                        def _stage_once(leaves=leaves, k=k):
-                            faults.fire(faults.SITE_PREFETCH_STAGE,
-                                        tile=k, device=label)
-                            pieces = []
-                            nbytes = 0
-                            for leaf, sharding in zip(leaves, shardings):
-                                idx = (
-                                    sharding
-                                    .addressable_devices_indices_map(
-                                        leaf.shape
-                                    )[d]
-                                )
-                                piece = jax.device_put(leaf[idx], d)
-                                nbytes += int(piece.nbytes)
-                                pieces.append((leaf.shape, piece))
-                            return pieces, nbytes
+        def _stage_once(leaves=leaves, k=k):
+            faults.fire(faults.SITE_PREFETCH_STAGE, tile=k, device=label)
+            pieces = []
+            nbytes = 0
+            for leaf, sharding in zip(leaves, shardings):
+                idx = (
+                    sharding.addressable_devices_indices_map(leaf.shape)[d]
+                )
+                piece = jax.device_put(leaf[idx], d)
+                nbytes += int(piece.nbytes)
+                pieces.append((leaf.shape, piece))
+            return pieces, nbytes
 
-                        # transient per-device staging failures retry
-                        # once in place (device_put is idempotent);
-                        # peers stay untouched and the in-order yield
-                        # contract holds
-                        pieces, nbytes = _stage_with_retry(
-                            _stage_once, tile=k, device=label
-                        )
-                        sp["nbytes"] = nbytes
-                    busy[d][0] += time.monotonic() - beat[0]
-                    beat[0] = None
-                    counter(names.CW_STREAM_BYTES_STAGED,
-                            device=label).inc(nbytes)
-                    gauge(names.OCCUPANCY_BUSY_S,
-                          stage=names.SPAN_CW_STREAM_STAGE,
-                          device=label).set(round(busy[d][0], 6))
-                except BaseException as exc:  # noqa: BLE001
-                    beat[0] = None
-                    errors.append(exc)
-                    stop.set()
-                    break
-                out_qs[d].put((k, pieces))  # unbounded: never blocks
-                k += 1
-            try:
-                out_qs[d].put_nowait(_STOP)
-            except queue.Full:  # pragma: no cover — out_qs unbounded
-                pass
+        # transient per-device staging failures retry once in place
+        # (device_put is idempotent); peers stay untouched and the
+        # in-order yield contract holds
+        pieces, nbytes = _stage_with_retry(_stage_once, tile=k,
+                                           device=label)
+        sp["nbytes"] = nbytes
+        counter(names.CW_STREAM_BYTES_STAGED, device=label).inc(nbytes)
+        return pieces
 
-    workers = [
-        threading.Thread(target=_producer, name="mesh-prefetch-producer",
-                         daemon=True)
-    ] + [
-        threading.Thread(target=_stager, args=(d,),
-                         name=f"mesh-prefetch-stage-{i}", daemon=True)
-        for i, d in enumerate(devs)
-    ]
-    for w in workers:
-        w.start()
+    graph = StageGraph(
+        [
+            Stage(
+                "tile_build",
+                fn=produce,
+                span=None,  # the staging span carries the telemetry
+                index_attr="tile",
+                heartbeat_label="host tile build",
+                thread_name="mesh-prefetch-producer",
+            ),
+            # fan-out: one staging thread + queue PER DEVICE, inputs
+            # broadcast, outputs gathered per tile in device order —
+            # the H2D copies of different chips drain concurrently
+            Stage(
+                "cw_stream_stage",
+                fn=stage_on_device,
+                span=names.SPAN_CW_STREAM_STAGE,
+                index_attr="tile",
+                busy_gauge=True,
+                replicas=[(d, str(getattr(d, "id", d))) for d in devs],
+                heartbeat_label="per-device tile staging",
+                thread_name="mesh-prefetch-stage",
+            ),
+        ],
+        window=depth,
+        drain_timeout_s=stall_timeout_s,
+        stall_what="per-device tile staging",
+        name="mesh-prefetch",
+    )
 
-    def _beats():
-        return [produce_started] + [stage_started[d] for d in devs]
-
+    staged = graph.iterate(tiles)
     try:
-        k = 0
-        while True:
-            gathered = []
-            eos = False
-            for d in devs:
-                while True:
-                    try:
-                        item = out_qs[d].get(timeout=0.1)
-                        break
-                    except queue.Empty:
-                        if any(_stage_overdue(b, stall_timeout_s)
-                               for b in _beats()):
-                            raise DrainTimeout(
-                                "per-device tile staging exceeded "
-                                f"{stall_timeout_s:.0f}s — backend wedged"
-                            )
-                if item is _STOP:
-                    eos = True
-                    break
-                kk, pieces = item
-                if kk != k:  # pragma: no cover — FIFO per device
-                    raise RuntimeError(
-                        f"device {d} staged tile {kk}, expected {k}"
-                    )
-                gathered.append(pieces)
-            if eos:
-                break
+        for gathered in staged:
             leaves_out = []
             for j, sharding in enumerate(shardings):
                 shape = gathered[0][j][0]
@@ -445,14 +299,8 @@ def prefetch_to_mesh(
                     )
                 )
             yield jax.tree_util.tree_unflatten(treedef_box[0], leaves_out)
-            window.release()
-            k += 1
     finally:
-        stop.set()
-        for w in workers:
-            w.join(timeout=5.0)
-    if errors:
-        raise errors[0]
+        staged.close()  # abandon: stop + join the workers promptly
 
 
 # ------------------------------------------------------------ tile cache

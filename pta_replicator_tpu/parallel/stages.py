@@ -1,0 +1,908 @@
+"""Composable bounded-window stage-graph executor.
+
+The repo grew two hand-built instances of the same staged-executor
+pattern: the pipelined sweep (parallel/pipeline.py: dispatch ->
+readback -> checkpoint write) and the CW tile prefetch
+(parallel/prefetch.py: host tile build -> H2D staging -> consumer) —
+each with its own copy of the bounded in-flight window, the stop/drain
+handshake, the stage heartbeats feeding a :class:`DrainTimeout`
+deadline, exception re-raise in order on the consumer thread, per-stage
+busy accounting, fault-injection sites, and the carry()/adopt() trace
+handoff across every thread boundary. Because they were two separate
+executors, they could not compose: a sweep whose chunk compute itself
+streams CW tiles ran the two windows back to back instead of
+overlapping them (ROADMAP open item 5).
+
+This module is the ONE implementation. Declare a graph of named
+:class:`Stage` s — a callable per item, thread-or-inline placement,
+bounded FIFO edges, an optional window credit (acquired at one stage,
+released at another, bounding items in flight between them) — and the
+executor provides, exactly once:
+
+* **bounded in-flight windows** — a semaphore slot taken before the
+  acquiring stage processes an item and released when the releasing
+  stage (or the consumer, in generator mode) finishes it, so memory is
+  bounded by ``window x item_nbytes`` no matter how far any stage could
+  run ahead;
+* **FIFO ordering per edge** — one thread per stage and FIFO queues,
+  so a writer stage runs strictly in item order (the checkpoint
+  crash-safety contract) and a consumer receives items strictly in
+  input order;
+* **DrainTimeout on wedged stages** — every worker stage keeps a
+  single-writer heartbeat (the monotonic start of the operation in
+  flight); any blocked waiter (the driver on the window, a windowed
+  stage, the consumer on the out queue) polls the heartbeats and fails
+  fast instead of hanging forever (all workers are daemons, so process
+  exit is never held hostage);
+* **exception re-raise in order** — a failing stage stops the graph and
+  its exception re-raises UNCHANGED on the caller/consumer thread,
+  after every earlier item has been delivered (generator mode) and with
+  the failing item index attached (driver mode, via ``mark_item`` —
+  the sweep's supervised-recovery loop reads it back);
+* **stop/drain semantics that never strand items** — sentinel
+  forwarding plus emergency wakeups on error, and a bounded quiesce of
+  the sink stage before re-raising (a retry must not race a
+  still-running writer);
+* **per-stage busy seconds and occupancy** — each stage accumulates its
+  operation durations; :meth:`StageGraph.run` folds them through
+  ``obs.occupancy.overlap_stats`` into duty cycles, overlap efficiency,
+  and a bottleneck verdict;
+* **fault-injection sites** — a stage declaring ``fault_site`` fires
+  ``faults.fire(site, <index_attr>=i)`` inside its span, so a chaos
+  schedule means the same thing for every graph built here;
+* **trace handoff across every thread boundary** — worker threads
+  inherit the caller's span ancestry (``TRACER.inherit``) and either
+  adopt a per-item deterministic trace context (``trace_scope``:
+  ``chunk_trace_context(scope, i)``, the sweep's multi-attempt-trace
+  contract) or the caller's carried context (generator mode, the
+  prefetch contract) — the obs-orphan-thread-span invariant holds by
+  construction for every graph declared here.
+
+Telemetry: the executor sets ``stages.edge_inflight{edge=}`` (items
+queued per edge) and ``stages.busy_s{stage=}`` gauges and bumps the
+``stages.drain_timeouts`` counter; stage spans and graph-specific
+gauges/counters stay with the declarations (parallel/pipeline.py,
+parallel/prefetch.py, utils/sweep.py keep their pinned names).
+
+Two consumption modes:
+
+* :meth:`StageGraph.run` — driver mode: the caller's thread runs the
+  first (source) stage over ``items`` and the chain ends in a sink
+  stage (the pipelined sweep shape); returns a stats dict. With every
+  stage ``placement="inline"`` the whole graph runs synchronously on
+  the caller's thread — the depth-1 sweep loop is this graph, not a
+  second code path.
+* :meth:`StageGraph.iterate` — generator mode: the source group runs on
+  a worker thread (the items iterator is pulled there, so host
+  precompute overlaps the consumer) and the caller consumes results in
+  order (the prefetch shape). A final stage may declare ``replicas``
+  (one thread + queue per replica, inputs broadcast, outputs gathered
+  per item in replica order) — the per-device mesh staging shape.
+
+docs/streaming.md is the guide: graph model, buffer/bound semantics,
+how to declare a new stage, and the fused-sweep case study.
+"""
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import (
+    Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple,
+)
+
+from ..faults import inject as faults
+from ..obs import counter, gauge, names, occupancy, span
+from ..obs.trace import TRACER, adopt, carry, chunk_trace_context
+
+_STOP = object()  # queue sentinel: no more items
+
+
+class DrainTimeout(RuntimeError):
+    """A stage operation stalled past the graph's deadline — the
+    backend (tunnel) or the filesystem is wedged mid-operation.
+    (Canonical home; parallel.pipeline re-exports it, so existing
+    ``from parallel.pipeline import DrainTimeout`` callers keep
+    working.)"""
+
+
+def stop_aware_put(q: queue.Queue, item, stop: threading.Event) -> bool:
+    """Bounded-queue put that stays responsive to ``stop``. Returns
+    False when the graph is stopping. The ONE implementation of the
+    back-pressure handshake (parallel.pipeline re-exports it under its
+    historical private name)."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            pass
+    return False
+
+
+def stage_overdue(started_box: list, timeout_s: Optional[float]) -> bool:
+    """True when the single-writer heartbeat ``started_box[0]`` (the
+    monotonic start of the stage operation currently in flight, None
+    between items) has been in flight longer than ``timeout_s``."""
+    if timeout_s is None:
+        return False
+    t0 = started_box[0]
+    return t0 is not None and time.monotonic() - t0 > timeout_s
+
+
+@dataclass
+class Stage:
+    """One named stage of a :class:`StageGraph`.
+
+    ``fn(i, payload, sp)`` processes item ``i`` (``payload`` is the
+    previous stage's return value — for a source stage, the item pulled
+    from the input iterable); ``sp`` is the stage span's attr dict (a
+    plain dict when ``span`` is None), so a stage can stamp
+    measurements (``sp["nbytes"] = ...``) without owning the span. A
+    ``replicas`` stage is called ``fn(replica, i, payload, sp)``.
+    """
+
+    name: str
+    fn: Callable
+    #: span opened around each operation (None: the fn manages its own
+    #: spans — the depth-1 sweep's nested sweep_chunk/readback_fence)
+    span: Optional[str] = None
+    #: extra span attrs from the item: ``(i, payload) -> dict``
+    span_attrs: Optional[Callable] = None
+    #: span/fault attr key carrying the item index (``chunk``/``tile``)
+    index_attr: str = "chunk"
+    #: faults.fire site fired inside the span, before ``fn``
+    fault_site: Optional[str] = None
+    #: "thread" (own worker thread + input queue) or "inline" (runs on
+    #: the previous stage's thread, fused into its loop step)
+    placement: str = "thread"
+    #: bound of the OUTGOING edge queue (0 = unbounded)
+    out_maxsize: int = 0
+    #: this stage takes the window slot before processing an item
+    #: (driver mode; default: the source stage)
+    acquires_window: bool = False
+    #: completing an item here frees its window slot (driver mode)
+    releases_window: bool = False
+    #: participates in the DrainTimeout deadline scan
+    heartbeat: bool = True
+    #: human label in the DrainTimeout message ("host readback")
+    heartbeat_label: Optional[str] = None
+    #: mirror cumulative busy seconds to ``occupancy.busy_s{stage=}``
+    #: (the prefetch contract; run() stats carry busy either way)
+    busy_gauge: bool = False
+    #: post-item hook ``(i, payload) -> None``, after the span closed
+    #: and busy was accounted (counters, progress gauges)
+    on_done: Optional[Callable] = None
+    #: fan-out: ``[(replica, label), ...]`` — one thread + queue per
+    #: replica, every input broadcast, outputs gathered in this order.
+    #: Generator mode only, and only as the final stage.
+    replicas: Optional[Sequence[Tuple[Any, str]]] = None
+    #: worker thread name (defaults to "<graph name>-<stage name>")
+    thread_name: Optional[str] = None
+
+    @property
+    def busy_key(self) -> str:
+        return self.span if self.span is not None else self.name
+
+    @property
+    def what(self) -> str:
+        return self.heartbeat_label or f"stage {self.name!r}"
+
+
+class _Abandoned:
+    """Internal marker: the item was dropped because the graph is
+    stopping (never an error, never forwarded)."""
+
+
+_ABANDONED = _Abandoned()
+
+
+class StageGraph:
+    """A declared chain of stages over bounded FIFO edges. One-shot:
+    build a graph per run (declarations are cheap; the runtime state —
+    queues, window, heartbeats — is per-execution by construction).
+
+    ``window`` bounds items in flight between the acquiring stage
+    (default: the source) and the releasing stage (driver mode) or the
+    consumer (generator mode). ``trace_scope`` derives a deterministic
+    per-item :func:`~..obs.trace.chunk_trace_context` carried through
+    every edge and adopted by every stage of that item (driver mode);
+    generator mode instead carries the consumer's live context onto
+    every worker (the two handoff modes of docs/tracing.md).
+    ``timeout_counter``/``inflight_gauge``/``stall_gauge`` let a
+    declaration keep its historical metric names — the executor always
+    maintains the generic ``stages.*`` telemetry as well.
+    """
+
+    def __init__(
+        self,
+        stages: Sequence[Stage],
+        *,
+        window: Optional[int] = None,
+        drain_timeout_s: Optional[float] = 900.0,
+        trace_scope: Optional[str] = None,
+        timeout_counter: Optional[str] = None,
+        inflight_gauge: Optional[str] = None,
+        stall_gauge: Optional[str] = None,
+        stall_what: str = "staging",
+        mark_item: Optional[Callable] = None,
+        name: str = "stage_graph",
+    ):
+        if not stages:
+            raise ValueError("a stage graph needs at least one stage")
+        if window is not None and window < 1:
+            raise ValueError(f"window must be >= 1 (got {window})")
+        for st in stages[:-1]:
+            if st.replicas is not None:
+                raise ValueError(
+                    f"stage {st.name!r}: replicas are only supported on "
+                    "the final stage (fan-out feeds the consumer)"
+                )
+        acquirers = [s for s in stages if s.acquires_window]
+        if len(acquirers) > 1:
+            raise ValueError("at most one stage may acquire the window")
+        self._stages = list(stages)
+        self._acquirer = acquirers[0] if acquirers else stages[0]
+        self.window = window
+        self.drain_timeout_s = drain_timeout_s
+        self.trace_scope = trace_scope
+        self.timeout_counter = timeout_counter
+        self.inflight_gauge = inflight_gauge
+        self.stall_gauge = stall_gauge
+        self.stall_what = stall_what
+        self.mark_item = mark_item
+        self.name = name
+        self.stats: dict = {}
+        # runtime state (one-shot)
+        self._stop = threading.Event()
+        self._errors: list = []  # [(stage name, exc)] — first entry wins
+        self._lock = threading.Lock()
+        self._window = (
+            threading.Semaphore(window) if window is not None else None
+        )
+        self._inflight = [0]
+        self._timeout_fired = False  # once-per-graph counter guard
+        self._busy = {s.busy_key: 0.0 for s in self._stages}
+        self._rbusy: dict = {}  # (busy_key, label) -> per-replica busy
+        self._beats: List[Tuple[Stage, list]] = []
+        self._stats = {"items": 0, "max_inflight": 0,
+                       "window_wait_s": 0.0, "stall_s": 0.0}
+
+    # ------------------------------------------------------- internals
+
+    def _groups(self) -> List[List[Stage]]:
+        """Execution groups: a group is one thread's worth of stages —
+        a head (source or thread-placed) plus its trailing inline
+        stages."""
+        groups: List[List[Stage]] = [[self._stages[0]]]
+        for st in self._stages[1:]:
+            if st.placement == "inline":
+                groups[-1].append(st)
+            elif st.placement == "thread":
+                groups.append([st])
+            else:
+                raise ValueError(
+                    f"stage {st.name!r}: unknown placement "
+                    f"{st.placement!r} (thread | inline)"
+                )
+        return groups
+
+    def _fail(self, stage_name: str, exc: BaseException, item=None) -> None:
+        if item is not None and self.mark_item is not None:
+            self.mark_item(exc, item)
+        with self._lock:
+            self._errors.append((stage_name, exc))
+        self._stop.set()
+
+    def _bump(self, delta: int) -> None:
+        with self._lock:
+            self._inflight[0] += delta
+            self._stats["max_inflight"] = max(
+                self._stats["max_inflight"], self._inflight[0]
+            )
+            if self.inflight_gauge:
+                gauge(self.inflight_gauge).set(self._inflight[0])
+
+    def _new_beat(self, stage: Stage) -> list:
+        box = [None]
+        if stage.heartbeat:
+            with self._lock:
+                self._beats.append((stage, box))
+        return box
+
+    def _bump_timeout_counters(self) -> bool:
+        """Once-per-graph deadline accounting: True for the ONE caller
+        that claims the episode (several blocked waiters poll the
+        heartbeats concurrently — the counters must not double-count a
+        single wedge)."""
+        with self._lock:
+            if self._timeout_fired:
+                return False
+            self._timeout_fired = True
+        counter(names.STAGES_DRAIN_TIMEOUTS).inc()
+        if self.timeout_counter:
+            counter(self.timeout_counter).inc()
+        return True
+
+    def _check_deadline(self) -> None:
+        """Driver-mode deadline: fail the graph on the first overdue
+        heartbeat (once — later calls are no-ops while stopping)."""
+        if self._stop.is_set():
+            return
+        with self._lock:
+            beats = list(self._beats)
+        for stage, box in beats:
+            if stage_overdue(box, self.drain_timeout_s):
+                if not self._bump_timeout_counters():
+                    return  # a concurrent waiter already claimed it
+                self._fail(
+                    stage.name,
+                    DrainTimeout(
+                        f"{stage.what} exceeded "
+                        f"{self.drain_timeout_s:.0f}s — backend or "
+                        "filesystem wedged"
+                    ),
+                )
+                return
+
+    def _overdue_any(self) -> bool:
+        with self._lock:
+            beats = list(self._beats)
+        return any(
+            stage_overdue(box, self.drain_timeout_s) for _s, box in beats
+        )
+
+    def _edge_gauge(self, label: str, q: queue.Queue) -> None:
+        gauge(names.STAGES_EDGE_INFLIGHT, edge=label).set(q.qsize())
+
+    def _forward(self, q: queue.Queue, item) -> bool:
+        """Driver-mode stop-aware put that also POLLS THE DEADLINE
+        while blocked on a full edge: when the downstream consumer of
+        this edge is wedged inside an operation (its heartbeat set),
+        the producer blocked here is often the only live observer — a
+        window-acquiring thread stage (the fused sweep's dispatch) has
+        no other waiter to trip the graph's DrainTimeout for it."""
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                self._check_deadline()
+        return False
+
+    def _account(self, stage: Stage, dt: float, label: str = "") -> None:
+        with self._lock:
+            self._busy[stage.busy_key] += dt
+            rkey = (stage.busy_key, label)
+            self._rbusy[rkey] = self._rbusy.get(rkey, 0.0) + dt
+            rbusy = self._rbusy[rkey]
+        blabels = {"stage": stage.busy_key}
+        if label:
+            blabels["device"] = label
+        gauge(names.STAGES_BUSY_S, **blabels).set(round(rbusy, 6))
+        if stage.busy_gauge:
+            gauge(names.OCCUPANCY_BUSY_S, **blabels).set(round(rbusy, 6))
+
+    def _execute(self, stage: Stage, i, payload, ctx, box,
+                 replica=None, label: str = "") -> Any:
+        """One stage operation: heartbeat, trace adoption, span, fault
+        site, fn, busy accounting, gauges, on_done. Exceptions clear
+        the heartbeat and re-raise unchanged (the caller records)."""
+        box[0] = time.monotonic()
+        try:
+            attrs = {stage.index_attr: i}
+            if label:
+                attrs["device"] = label
+            if stage.span_attrs is not None:
+                attrs.update(stage.span_attrs(i, payload))
+            fctx = {stage.index_attr: i}
+            if label:
+                fctx["device"] = label
+            trace_cm = (
+                adopt(ctx) if ctx is not None else contextlib.nullcontext()
+            )
+            with trace_cm:
+                if stage.span is not None:
+                    with span(stage.span, **attrs) as sp:
+                        if stage.fault_site:
+                            faults.fire(stage.fault_site, **fctx)
+                        out = (stage.fn(replica, i, payload, sp)
+                               if replica is not None
+                               else stage.fn(i, payload, sp))
+                else:
+                    sp: dict = dict(attrs)
+                    if stage.fault_site:
+                        faults.fire(stage.fault_site, **fctx)
+                    out = (stage.fn(replica, i, payload, sp)
+                           if replica is not None
+                           else stage.fn(i, payload, sp))
+            dt = time.monotonic() - box[0]
+            box[0] = None
+        except BaseException:
+            box[0] = None
+            raise
+        self._account(stage, dt, label)
+        if stage.on_done is not None:
+            stage.on_done(i, out)
+        return out
+
+    def _run_windowed(self, stage: Stage, i, payload, ctx, box) -> Any:
+        """Execute one stage with its window ceremony: acquire before
+        (acquiring stage, polling the deadline while blocked), bump
+        after, stop-check + release after (releasing stage). Returns
+        ``_ABANDONED`` when the graph stopped under the operation."""
+        if self._window is not None and stage is self._acquirer:
+            t_wait = time.monotonic()
+            while not self._window.acquire(timeout=0.1):
+                self._check_deadline()
+                if self._stop.is_set():
+                    break
+            with self._lock:
+                self._stats["window_wait_s"] += time.monotonic() - t_wait
+            if self._stop.is_set():
+                return _ABANDONED
+        out = self._execute(stage, i, payload, ctx, box)
+        if self._window is not None and stage is self._acquirer:
+            self._bump(+1)
+        if stage.releases_window and self._window is not None:
+            if self._stop.is_set():
+                # abandoned run: a DrainTimeout already raised on the
+                # caller's thread and a retry may be live — a late-
+                # unwedging operation must not mutate the shared
+                # gauge/window under the retry's feet
+                return _ABANDONED
+            self._bump(-1)
+            self._window.release()
+        return out
+
+    # ------------------------------------------------------ driver mode
+
+    def run(self, items: Iterable) -> dict:
+        """Driver mode: the caller's thread runs the source group over
+        ``items``; each thread-placed stage drains its input queue on
+        its own daemon thread; the last stage is the sink. Returns the
+        stats dict (also stored on ``self.stats``): ``items``,
+        ``wall_s``, ``max_inflight``, ``window_wait_s``, ``stall_s``,
+        ``stage_busy_s``, ``occupancy``.
+
+        A failing stage stops the graph and its exception re-raises
+        UNCHANGED here; an operation wedged past ``drain_timeout_s``
+        raises :class:`DrainTimeout`. On error the sink is quiesced
+        (bounded) before the raise, so an immediate retry never races a
+        still-running writer — wedged non-sink stages are abandoned as
+        daemons."""
+        for st in self._stages:
+            if st.replicas is not None:
+                raise ValueError(
+                    "replicas stages are generator-mode only (iterate)"
+                )
+        groups = self._groups()
+        queues: List[queue.Queue] = [
+            queue.Queue(maxsize=groups[g][-1].out_maxsize)
+            for g in range(len(groups) - 1)
+        ]
+        edge_labels = [
+            f"{groups[g][-1].name}->{groups[g + 1][0].name}"
+            for g in range(len(groups) - 1)
+        ]
+        stop = self._stop
+        stack = TRACER.current_stack()  # nest worker spans under caller's
+        boxes = {id(st): self._new_beat(st) for grp in groups
+                 for st in grp}
+        # the source group's heartbeats never gate the deadline: the
+        # driver itself runs those stages, so a "wedged" source is a
+        # wedged caller — nothing downstream can observe it anyway
+        with self._lock:
+            self._beats = [
+                (st, box) for st, box in self._beats
+                if not any(st is s for s in groups[0])
+            ]
+
+        def thread_main(g: int) -> None:
+            in_q = queues[g - 1]
+            sink = g == len(groups) - 1
+            with TRACER.inherit(stack):
+                while True:
+                    item = in_q.get()
+                    if item is _STOP or stop.is_set():
+                        break
+                    i, payload, ctx = item
+                    self._edge_gauge(edge_labels[g - 1], in_q)
+                    failed = False
+                    for st in groups[g]:
+                        try:
+                            payload = self._run_windowed(
+                                st, i, payload, ctx, boxes[id(st)]
+                            )
+                        except BaseException as exc:  # noqa: BLE001 — re-raised on the driver
+                            self._fail(st.name, exc, item=i)
+                            failed = True
+                            break
+                        if payload is _ABANDONED:
+                            failed = True
+                            break
+                    if failed:
+                        break
+                    if sink:
+                        with self._lock:
+                            self._stats["items"] += 1
+                    else:
+                        if not self._forward(queues[g], (i, payload, ctx)):
+                            break
+                        self._edge_gauge(edge_labels[g], queues[g])
+                if not sink:
+                    stop_aware_put(queues[g], _STOP, stop)
+                    # unblock a downstream stage waiting on an empty
+                    # queue even if the stop-aware put bailed out
+                    if stop.is_set():
+                        try:
+                            queues[g].put_nowait(_STOP)
+                        except queue.Full:
+                            pass
+
+        threads = [
+            threading.Thread(
+                target=thread_main, args=(g,),
+                name=(groups[g][0].thread_name
+                      or f"{self.name}-{groups[g][0].name}"),
+                daemon=True,
+            )
+            for g in range(1, len(groups))
+        ]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+
+        try:
+            for i in items:
+                if stop.is_set():
+                    break
+                ctx = (
+                    chunk_trace_context(self.trace_scope, i)
+                    if self.trace_scope is not None else None
+                )
+                payload: Any = i
+                failed = False
+                for st in groups[0]:
+                    try:
+                        payload = self._run_windowed(
+                            st, i, payload, ctx, boxes[id(st)]
+                        )
+                    except BaseException as exc:  # noqa: BLE001 — re-raised below
+                        self._fail(st.name, exc, item=i)
+                        failed = True
+                        break
+                    if payload is _ABANDONED:
+                        failed = True
+                        break
+                if failed or stop.is_set():
+                    break
+                if queues:
+                    if not self._forward(queues[0], (i, payload, ctx)):
+                        break
+                    self._edge_gauge(edge_labels[0], queues[0])
+                else:
+                    with self._lock:
+                        self._stats["items"] += 1
+        finally:
+            def emergency_sentinels() -> None:
+                # a wedged stage never forwards its sentinel, so wake
+                # every downstream queue ourselves (a full queue means
+                # that stage has items — it re-checks stop per item)
+                for q in queues:
+                    try:
+                        q.put_nowait(_STOP)
+                    except queue.Full:
+                        pass
+
+            # orderly shutdown on success; on error the workers see stop
+            if queues:
+                stop_aware_put(queues[0], _STOP, stop)
+            sentinels_sent = stop.is_set()
+            if sentinels_sent:
+                emergency_sentinels()
+            # join with a heartbeat so a wedged stage still hits the
+            # deadline; the SINK must quiesce before an error re-raises
+            # (an immediate retry would race its in-flight write), but
+            # only bounded against a wedged syscall
+            quiesce_deadline = None
+            sink_thread = threads[-1] if threads else None
+            while any(t.is_alive() for t in threads):
+                for t in threads:
+                    t.join(timeout=0.2)
+                self._check_deadline()
+                if stop.is_set() and not sentinels_sent:
+                    # the deadline fired inside this loop (late wedge):
+                    # wake the workers now or an idle sink would sit in
+                    # its get() for another full quiesce window
+                    sentinels_sent = True
+                    emergency_sentinels()
+                if stop.is_set() and self._errors:
+                    if sink_thread is None or not sink_thread.is_alive():
+                        break
+                    if quiesce_deadline is None:
+                        quiesce_deadline = time.monotonic() + (
+                            self.drain_timeout_s
+                            if self.drain_timeout_s is not None else 900.0
+                        )
+                    elif time.monotonic() > quiesce_deadline:
+                        break
+            if self.inflight_gauge:
+                gauge(self.inflight_gauge).set(0)
+
+        if self._errors:
+            _stage, exc = self._errors[0]
+            raise exc
+        return self._finish_stats(time.monotonic() - t_start)
+
+    # --------------------------------------------------- generator mode
+
+    def iterate(self, items: Iterable) -> Iterator:
+        """Generator mode: the source group runs on a worker thread
+        (the ``items`` iterator is pulled there, inside the source
+        stage's span — host precompute overlaps the consumer); results
+        are yielded strictly in input order. The window slot is taken
+        by the source before an item is built and released when the
+        consumer comes back after the yield, so at most ``window``
+        items exist past the input iterator (plus the one being
+        consumed).
+
+        A stage exception re-raises UNCHANGED here after every earlier
+        item was yielded in order; a stage wedged past
+        ``drain_timeout_s`` raises :class:`DrainTimeout`. Abandoning
+        the iterator stops and joins all workers promptly.
+
+        An optional final ``replicas`` stage fans out: each input is
+        broadcast to every replica's queue and the consumer gathers one
+        output per replica per item, yielding the gathered list in
+        replica order. Replica workers break only on the sentinel, so
+        an upstream error never makes one replica abandon items its
+        peers already processed (the residual work is bounded by the
+        window). The caller's live trace context is carried onto every
+        worker (carry()/adopt())."""
+        groups = self._groups()
+        fan_out = groups[-1][0].replicas is not None
+        if fan_out and (len(groups) != 2 or len(groups[-1]) != 1):
+            raise ValueError(
+                "generator mode supports one source group plus an "
+                "optional final replicas stage"
+            )
+        if not fan_out and len(groups) != 1:
+            raise ValueError(
+                "generator mode runs all non-replica stages on the "
+                "source worker — declare them placement='inline'"
+            )
+        stop = self._stop
+        stack = TRACER.current_stack()  # nest worker spans under caller's
+        tctx = carry()  # trace handoff (None = untraced, a no-op shield)
+        src_group = groups[0]
+        head = src_group[0]
+        boxes = {id(st): self._new_beat(st) for st in src_group}
+        rep_stage = groups[-1][0] if fan_out else None
+        replicas = list(rep_stage.replicas) if fan_out else []
+        if fan_out and not replicas:
+            raise ValueError(f"stage {rep_stage.name!r}: empty replica set")
+        rep_boxes = [self._new_beat(rep_stage) for _ in replicas]
+        rep_in: List[queue.Queue] = [queue.Queue() for _ in replicas]
+        rep_out: List[queue.Queue] = [queue.Queue() for _ in replicas]
+        # the consumer edge is deliberately unbounded: the window
+        # already bounds it, and an unbounded queue means the end-of-
+        # stream sentinel can always be delivered even while stopping
+        out_q: queue.Queue = queue.Queue()
+        out_edge = f"{src_group[-1].name}->consumer"
+
+        def source_main() -> None:
+            box = boxes[id(head)]
+            with TRACER.inherit(stack), adopt(tctx):
+                it = iter(items)
+                i = 0
+                while not stop.is_set():
+                    if self._window is not None:
+                        while not self._window.acquire(timeout=0.1):
+                            if stop.is_set():
+                                break
+                        if stop.is_set():
+                            break
+                    try:
+                        # the iterator pull happens INSIDE the stage
+                        # span: the item build IS the stage's work
+                        # (plane-tile f64 math on this worker). The
+                        # stage's declared span_attrs/fault_site apply
+                        # once the pulled item exists — attrs land on
+                        # the open span, the site fires before the fn
+                        # (the same contract _execute gives every
+                        # non-source stage)
+                        box[0] = time.monotonic()
+                        eos = False
+
+                        def _pull_and_run(sp):
+                            nonlocal eos
+                            try:
+                                raw = next(it)
+                            except StopIteration:
+                                if head.span is not None:
+                                    sp["eos"] = True
+                                eos = True
+                                return None
+                            if head.span_attrs is not None:
+                                for k, v in head.span_attrs(i, raw).items():
+                                    sp[k] = v
+                            if head.fault_site:
+                                faults.fire(head.fault_site,
+                                            **{head.index_attr: i})
+                            return head.fn(i, raw, sp)
+
+                        if head.span is not None:
+                            with span(head.span,
+                                      **{head.index_attr: i}) as sp:
+                                out = _pull_and_run(sp)
+                        else:
+                            out = _pull_and_run({head.index_attr: i})
+                        if eos:
+                            box[0] = None
+                            break
+                        dt = time.monotonic() - box[0]
+                        box[0] = None
+                        self._account(head, dt)
+                        if head.on_done is not None:
+                            head.on_done(i, out)
+                        payload = out
+                        for st in src_group[1:]:
+                            payload = self._execute(
+                                st, i, payload, None, boxes[id(st)]
+                            )
+                    except BaseException as exc:  # noqa: BLE001 — re-raised on consumer
+                        box[0] = None
+                        self._fail(head.name, exc, item=i)
+                        break
+                    if fan_out:
+                        delivered = True
+                        for r in range(len(replicas)):
+                            if not stop_aware_put(
+                                rep_in[r], (i, payload), stop
+                            ):
+                                delivered = False
+                                break
+                        if not delivered:
+                            break
+                    else:
+                        if not stop_aware_put(out_q, (i, payload), stop):
+                            break
+                        self._edge_gauge(out_edge, out_q)
+                    i += 1
+                # always deliver the sentinel, even when stopping: the
+                # consumer may be parked on an empty queue
+                if fan_out:
+                    for r in range(len(replicas)):
+                        try:
+                            rep_in[r].put_nowait(_STOP)
+                        except queue.Full:  # pragma: no cover — unbounded
+                            pass
+                else:
+                    try:
+                        out_q.put_nowait(_STOP)
+                    except queue.Full:  # pragma: no cover — unbounded
+                        pass
+
+        def replica_main(r: int) -> None:
+            replica, label = replicas[r]
+            box = rep_boxes[r]
+            with TRACER.inherit(stack), adopt(tctx):
+                while True:
+                    item = rep_in[r].get()
+                    # break on the sentinel ONLY (not on a bare stop):
+                    # an upstream error must not make one replica
+                    # abandon items its peers already processed
+                    if item is _STOP:
+                        break
+                    i, payload = item
+                    try:
+                        out = self._execute(
+                            rep_stage, i, payload, None, box,
+                            replica=replica, label=label,
+                        )
+                    except BaseException as exc:  # noqa: BLE001
+                        self._fail(rep_stage.name, exc, item=i)
+                        break
+                    rep_out[r].put((i, out))  # unbounded: never blocks
+                try:
+                    rep_out[r].put_nowait(_STOP)
+                except queue.Full:  # pragma: no cover — unbounded
+                    pass
+
+        workers = [
+            threading.Thread(
+                target=source_main,
+                name=head.thread_name or f"{self.name}-{head.name}",
+                daemon=True,
+            )
+        ] + [
+            threading.Thread(
+                target=replica_main, args=(r,),
+                name=((rep_stage.thread_name
+                       or f"{self.name}-{rep_stage.name}") + f"-{r}"),
+                daemon=True,
+            )
+            for r in range(len(replicas))
+        ]
+        t_start = time.monotonic()
+        for w in workers:
+            w.start()
+
+        def poll_get(q: queue.Queue):
+            while True:
+                try:
+                    return q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._overdue_any():
+                        self._bump_timeout_counters()
+                        raise DrainTimeout(
+                            f"{self.stall_what} exceeded "
+                            f"{self.drain_timeout_s:.0f}s — backend "
+                            "wedged"
+                        )
+
+        try:
+            k = 0
+            while True:
+                t_wait = time.monotonic()
+                if fan_out:
+                    gathered = []
+                    eos = False
+                    for r in range(len(replicas)):
+                        item = poll_get(rep_out[r])
+                        if item is _STOP:
+                            eos = True
+                            break
+                        kk, out = item
+                        if kk != k:  # pragma: no cover — FIFO per replica
+                            raise RuntimeError(
+                                f"replica {replicas[r][1]} returned "
+                                f"item {kk}, expected {k}"
+                            )
+                        gathered.append(out)
+                    if eos:
+                        break
+                    payload = gathered
+                else:
+                    item = poll_get(out_q)
+                    if item is _STOP:
+                        break
+                    _i, payload = item
+                with self._lock:
+                    self._stats["stall_s"] += time.monotonic() - t_wait
+                    stall = self._stats["stall_s"]
+                if self.stall_gauge:
+                    gauge(self.stall_gauge).set(round(stall, 6))
+                yield payload
+                if self._window is not None:
+                    self._window.release()
+                with self._lock:
+                    self._stats["items"] += 1
+                k += 1
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=5.0)
+            self._finish_stats(time.monotonic() - t_start)
+        if self._errors:
+            raise self._errors[0][1]
+
+    # ------------------------------------------------------------ stats
+
+    def _finish_stats(self, wall_s: float) -> dict:
+        stats = dict(self._stats)
+        stats["wall_s"] = wall_s
+        stats["window_wait_s"] = round(stats["window_wait_s"], 6)
+        stats["stall_s"] = round(stats["stall_s"], 6)
+        with self._lock:
+            busy = dict(self._busy)
+        stats["stage_busy_s"] = {k: round(v, 6) for k, v in busy.items()}
+        # measured occupancy of THIS run: duty cycles, overlap
+        # efficiency, and the bottleneck verdict (obs.occupancy) — the
+        # sweep stamps these into the sweep_pipeline span attrs
+        stats["occupancy"] = occupancy.overlap_stats(busy, stats["wall_s"])
+        self.stats = stats
+        return stats

@@ -544,6 +544,7 @@ def sweep(
     chunk_retries: int = 2,
     retry_policy=None,
     provenance: Optional[dict] = None,
+    fused_stream: bool = False,
 ) -> np.ndarray:
     """Run ``nreal`` realizations in resumable chunks.
 
@@ -609,11 +610,41 @@ def sweep(
     make them visible in ``watch``). ``chunk_retries=0`` restores the
     old fail-fast behavior; fatal errors (shape/fingerprint/OOM/user
     aborts) always re-raise immediately, on the first occurrence.
+
+    **Fused streaming** (``fused_stream=True``, docs/streaming.md): run
+    the sweep as ONE end-to-end stage graph — a per-chunk
+    ``static_build`` stage re-derives the deterministic (streamed-CW)
+    delays for every chunk on the caller's thread while a dispatch
+    thread, the reader, and the writer process earlier chunks, so chunk
+    ``i+1``'s CW tile-build/H2D stages run concurrently with chunk
+    ``i``'s compute, readback, and checkpoint write. The per-chunk
+    static is a deterministic function of (batch, recipe), so results
+    and checkpoints stay byte-identical to the stacked path at every
+    depth; what changes is utilization — the host-precompute window and
+    the compute/IO windows overlap instead of running back to back
+    (benchmarks/stage_graph.py measures exactly this). The fused graph
+    is the substrate for sweeps whose per-chunk deterministic content
+    genuinely varies; on a fixed recipe it trades redundant (hidden)
+    host tile work for end-to-end overlap. Requires ``mesh=None`` and
+    ``pipeline_depth >= 2``.
     """
     import contextlib
     import time as _time
 
     from ..faults.retry import DEFAULT_POLICY, backoff_delay, is_transient
+
+    if fused_stream:
+        if mesh is not None:
+            raise ValueError(
+                "fused_stream=True runs the single-device fused graph — "
+                "the mesh sweep keeps its own static precompute path"
+            )
+        if pipeline_depth < 2:
+            raise ValueError(
+                "fused_stream=True needs pipeline_depth >= 2 — at depth "
+                "1 there is no concurrency for the static build to "
+                "overlap with"
+            )
 
     phase = contextlib.nullcontext()
     if mesh is not None and int(mesh.devices.size) > 1:
@@ -637,7 +668,7 @@ def sweep(
                     progress=progress, pipeline_depth=pipeline_depth,
                     drain_timeout_s=drain_timeout_s, durable=durable,
                     shard_checkpoint=shard_checkpoint,
-                    provenance=provenance,
+                    provenance=provenance, fused_stream=fused_stream,
                 )
             except BaseException as exc:  # noqa: BLE001 — classified, then re-raised
                 if chunk_retries <= 0 or not is_transient(exc):
@@ -690,6 +721,7 @@ def _sweep_impl(
     durable: bool,
     shard_checkpoint: Optional[bool],
     provenance: Optional[dict] = None,
+    fused_stream: bool = False,
 ) -> np.ndarray:
     import jax
 
@@ -759,9 +791,13 @@ def _sweep_impl(
     blocks = [_load_chunk(checkpoint_path, i) for i in range(done)]
 
     # the deterministic (CW-catalog/burst/memory) delays depend only on
-    # (batch, recipe): compute once for the whole sweep, not per chunk
+    # (batch, recipe): compute once for the whole sweep, not per chunk.
+    # The FUSED graph instead re-derives them per chunk on its
+    # static_build stage, overlapped with earlier chunks' compute and
+    # I/O (bitwise the same values — deterministic function of the same
+    # inputs — so checkpoints stay byte-identical)
     static = None
-    if done < nchunks:
+    if done < nchunks and not fused_stream:
         from ..parallel.mesh import static_delays
 
         static = static_delays(batch, recipe, mesh=mesh)
@@ -830,50 +866,59 @@ def _sweep_impl(
             progress(i + 1, nchunks)
 
     if pipeline_depth <= 1:
-        from ..obs.trace import adopt, chunk_trace_context
-
         # the synchronous reference loop: dispatch, fence, write — the
         # behavior every pipelined run must reproduce byte-for-byte.
-        # Each chunk adopts the SAME deterministic trace context the
-        # pipelined executor derives (scope = checkpoint path), so a
-        # chunk's trace means the same thing at every depth
+        # Since PR 15 it is the SAME stage graph as the pipelined path,
+        # run inline on the caller's thread (single-thread placement)
+        # instead of a second hand-maintained code path: the executor
+        # derives each chunk's deterministic trace context (scope =
+        # checkpoint path), annotates a failing chunk for the
+        # supervised-recovery loop (mark_item), and re-raises stage
+        # exceptions unchanged — while the span nesting and injection
+        # sites below stay exactly the historical synchronous shape, so
+        # a chaos schedule and a chunk trace mean the same thing at
+        # every depth.
         from ..parallel.pipeline import _mark_chunk
+        from ..parallel.stages import Stage, StageGraph
 
-        for i in range(done, nchunks):
-            try:
-                with adopt(chunk_trace_context(checkpoint_path, i)):
-                    with span(names.SPAN_SWEEP_CHUNK, chunk=i,
-                              nreal=chunk):
-                        # same injection sites the pipelined executor
-                        # fires, so a chaos schedule means the same
-                        # thing at every depth
-                        faults.fire(faults.SITE_DISPATCH, chunk=i)
-                        out = dispatch_chunk(i)
-                        # the host readback is the device-sync fence:
-                        # this span is where queued device work (incl.
-                        # collectives) drains
-                        with span(names.SPAN_READBACK_FENCE):
-                            faults.fire(faults.SITE_DRAIN, chunk=i)
-                            block = fetch_fn(out)
-                    host = (block.assemble()
-                            if isinstance(block, ShardedBlock)
-                            else block)
-                    # same stage span the pipelined writer thread
-                    # emits, so the occupancy report attributes the
-                    # synchronous loop's disk time too (without it an
-                    # fsync-bound depth-1 run reads as compute-bound)
-                    with span(names.SPAN_IO_WRITE, chunk=i,
-                              nbytes=int(block.nbytes)):
-                        faults.fire(faults.SITE_IO_WRITE, chunk=i)
-                        write_chunk(i,
-                                    block if shard_checkpoint else host)
-            except BaseException as exc:  # noqa: BLE001 — annotated, re-raised
-                # name the failing chunk for the supervised-recovery
-                # loop's trace-stamped retry event (same contract as
-                # the pipelined executor's stage failures)
-                _mark_chunk(exc, i)
-                raise
+        def compute_sync(i, _payload, _sp):
+            with span(names.SPAN_SWEEP_CHUNK, chunk=i, nreal=chunk):
+                # same injection sites the pipelined executor fires
+                faults.fire(faults.SITE_DISPATCH, chunk=i)
+                out = dispatch_chunk(i)
+                # the host readback is the device-sync fence: this
+                # span is where queued device work (incl. collectives)
+                # drains
+                with span(names.SPAN_READBACK_FENCE):
+                    faults.fire(faults.SITE_DRAIN, chunk=i)
+                    block = fetch_fn(out)
+            host = (block.assemble() if isinstance(block, ShardedBlock)
+                    else block)
+            return block, host
+
+        def write_sync(i, payload, _sp):
+            block, host = payload
+            write_chunk(i, block if shard_checkpoint else host)
             blocks.append(host)
+
+        StageGraph(
+            [
+                Stage("sweep_chunk", fn=compute_sync, placement="inline",
+                      heartbeat=False),
+                # same stage span the pipelined writer thread emits, so
+                # the occupancy report attributes the synchronous
+                # loop's disk time too (without it an fsync-bound
+                # depth-1 run reads as compute-bound)
+                Stage("io_write", fn=write_sync,
+                      span=names.SPAN_IO_WRITE,
+                      span_attrs=lambda i, p: {"nbytes": int(p[0].nbytes)},
+                      fault_site=faults.SITE_IO_WRITE,
+                      placement="inline", heartbeat=False),
+            ],
+            trace_scope=checkpoint_path,
+            mark_item=_mark_chunk,
+            name="sweep-sync",
+        ).run(range(done, nchunks))
     elif done < nchunks:
         from ..parallel.pipeline import run_pipelined
 
@@ -935,20 +980,31 @@ def _sweep_impl(
 
         try:
             with span(names.SPAN_SWEEP_PIPELINE, depth=pipeline_depth,
-                      chunks=nchunks - done) as sp:
-                stats = run_pipelined(
-                    range(done, nchunks),
-                    dispatch_chunk,
-                    write_and_consolidate,
-                    depth=pipeline_depth,
-                    fetch=fetch_fn,
-                    drain_timeout_s=drain_timeout_s,
-                    # chunk traces scoped to the sweep's identity: a
-                    # supervised retry (and a cross-process resume)
-                    # re-derives the SAME per-chunk trace ids, so a
-                    # retried chunk's attempts land in one trace
-                    trace_scope=checkpoint_path,
-                )
+                      chunks=nchunks - done, fused=fused_stream) as sp:
+                if fused_stream:
+                    stats = _run_fused_stream(
+                        range(done, nchunks),
+                        batch, recipe, key, chunk, fit, reduce_fn,
+                        write_and_consolidate,
+                        depth=pipeline_depth,
+                        drain_timeout_s=drain_timeout_s,
+                        trace_scope=checkpoint_path,
+                    )
+                else:
+                    stats = run_pipelined(
+                        range(done, nchunks),
+                        dispatch_chunk,
+                        write_and_consolidate,
+                        depth=pipeline_depth,
+                        fetch=fetch_fn,
+                        drain_timeout_s=drain_timeout_s,
+                        # chunk traces scoped to the sweep's identity:
+                        # a supervised retry (and a cross-process
+                        # resume) re-derives the SAME per-chunk trace
+                        # ids, so a retried chunk's attempts land in
+                        # one trace
+                        trace_scope=checkpoint_path,
+                    )
                 sp.update(stats)
         except BaseException:
             inc.abort()  # chunk files + sidecar carry the resume state
@@ -970,3 +1026,102 @@ def _sweep_impl(
     )
     _cleanup_chunks(checkpoint_path, nchunks)
     return np.concatenate(blocks, axis=0)
+
+
+def _run_fused_stream(
+    indices,
+    batch,
+    recipe,
+    key,
+    chunk: int,
+    fit: bool,
+    reduce_fn: Optional[Callable],
+    write: Callable,
+    *,
+    depth: int,
+    drain_timeout_s: Optional[float],
+    trace_scope: str,
+) -> dict:
+    """The FUSED sweep graph (docs/streaming.md): one end-to-end stage
+    graph ``static_build -> dispatch -> drain -> io_write`` where the
+    caller's thread streams chunk ``i+1``'s deterministic delays (the
+    CW tile-build/H2D pipeline nests INSIDE the static_build stage,
+    adopting its per-chunk trace) while a dispatch thread launches
+    chunk ``i``'s realizations over the staged static, the reader
+    drains chunk ``i-1`` and the writer persists chunk ``i-2`` — host
+    precompute, H2D staging, device compute, D2H readback, and durable
+    writes all concurrently in ONE bounded window.
+
+    Each chunk's static is ``deterministic_delays(batch, recipe)`` —
+    bitwise identical across chunks and to the stacked path's one-time
+    precompute — so checkpoints, traces, fault-site meaning, and the
+    returned array are unchanged; only the schedule (and therefore the
+    measured end-to-end overlap, benchmarks/stage_graph.py) differs.
+    Returns the same stats-dict shape as ``run_pipelined``, plus the
+    ``static_build`` entry in ``stage_busy_s``.
+    """
+    import jax
+
+    from ..models.batched import realize
+    from ..obs import names
+    from ..parallel.mesh import static_delays
+    # the sweep pipeline's shared stage vocabulary: drain/io_write and
+    # the stats contract are THE SAME objects run_pipelined declares,
+    # so the fused and stacked graphs cannot silently fork the behavior
+    # the byte-identity tests pin as equal
+    from ..parallel.pipeline import (
+        _dispatch_on_done,
+        _mark_chunk,
+        drain_stage,
+        io_write_stage,
+        pipeline_stats,
+    )
+    from ..parallel.stages import Stage, StageGraph
+
+    def build_static(i, _payload, _sp):
+        # the streamed-CW tile build + prefetch runs inside this span
+        # (cw_stream_response nests its own stage graph here and its
+        # workers adopt this chunk's trace context)
+        return static_delays(batch, recipe, mesh=None)
+
+    def dispatch_fused(i, static_i, _sp):
+        k = jax.random.fold_in(key, i)
+        res = realize(k, batch, recipe, nreal=chunk, fit=fit,
+                      static=static_i)
+        return reduce_fn(res, batch) if reduce_fn is not None else res
+
+    graph = StageGraph(
+        [
+            Stage(
+                "static_build",
+                fn=build_static,
+                span=names.SPAN_STATIC_BUILD,
+                # at most one built-ahead static beyond the one the
+                # dispatch stage holds (each is a small (Np, Nt) block;
+                # the bound keeps the lookahead from racing arbitrarily
+                # far ahead of the device)
+                out_maxsize=1,
+                heartbeat=False,  # runs on the driver — see stages.py
+            ),
+            Stage(
+                "dispatch",
+                fn=dispatch_fused,
+                span=names.SPAN_DISPATCH,
+                fault_site=faults.SITE_DISPATCH,
+                acquires_window=True,
+                on_done=_dispatch_on_done,
+                heartbeat_label="chunk dispatch",
+                thread_name="sweep-dispatch",
+            ),
+            drain_stage(np.asarray, depth),
+            io_write_stage(write),
+        ],
+        window=depth,
+        drain_timeout_s=drain_timeout_s,
+        trace_scope=trace_scope,
+        timeout_counter=names.PIPELINE_DRAIN_TIMEOUTS,
+        inflight_gauge=names.SWEEP_INFLIGHT_CHUNKS,
+        mark_item=_mark_chunk,
+        name="sweep-fused",
+    )
+    return pipeline_stats(graph.run(indices))

@@ -28,7 +28,7 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_obs.py tests/test_flightrec.py tests/test_occupancy.py \
     tests/test_series.py tests/test_timeline_serve.py \
     tests/test_analysis.py tests/test_pipeline.py tests/test_faults.py \
-    tests/test_trace_slo.py
+    tests/test_trace_slo.py tests/test_stages.py
 
 echo "== scenario fuzz (fast arm: batched vs oracle differential) =="
 # 8 generated scenarios at a fixed seed through the batched-vs-oracle
@@ -56,6 +56,15 @@ echo "== chaos smoke (seeded faults, byte-identity gate) =="
 # to fault-free, server saturation shedding verified (exit 1 on any
 # gate miss). Seconds-scale, fixture-free, CPU-only.
 JAX_PLATFORMS=cpu python benchmarks/chaos_sweep.py --fast > /dev/null
+
+echo "== stage-graph overlap gate (fast arm) =="
+# the fast arm of benchmarks/stage_graph.py: the FUSED streamed-CW
+# sweep (one end-to-end stage graph, parallel/stages.py) must measure
+# a strictly higher end-to-end overlap efficiency than the stacked
+# two-pipeline baseline, with byte-identical checkpoints (exit 1,
+# reasons to stderr). Seconds-scale, fixture-free, CPU-only
+# (docs/streaming.md).
+JAX_PLATFORMS=cpu python benchmarks/stage_graph.py --fast > /dev/null
 
 echo "== request-trace + SLO gate (fast arm) =="
 # the fast arm of benchmarks/request_trace.py: a chaos-loaded server

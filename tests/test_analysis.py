@@ -1136,3 +1136,111 @@ def test_cov_f32_cholesky_clean_on_real_tree():
     active, _ = engine.run_rules(mods, rules_cov.RULES)
     assert problems == []
     assert [f for f in active] == []
+
+
+# ------------------------------------------------ parallel-adhoc-stage
+
+def test_adhoc_stage_fires_on_thread_queue_pipeline(tmp_path):
+    """parallel-adhoc-stage: a raw threading.Thread + queue.Queue
+    pipeline in package code OUTSIDE parallel/ fires at the spawn site
+    (the shape parallel/stages.py exists to replace)."""
+    from pta_replicator_tpu.analysis import rules_threads
+
+    src = """
+        import queue
+        import threading
+
+        def start():
+            q = queue.Queue(maxsize=2)
+
+            def worker():
+                while True:
+                    item = q.get()
+                    if item is None:
+                        break
+
+            threading.Thread(target=worker, daemon=True).start()
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"pta_replicator_tpu/obs/adhoc.py": src},
+        [rules_threads.AdhocStagePipeline()],
+    )
+    assert rule_ids(findings) == ["parallel-adhoc-stage"]
+    assert "StageGraph" in findings[0].message
+
+
+def test_adhoc_stage_non_firing_shapes(tmp_path):
+    """Non-firing: a Thread without any queue (heartbeat worker), a
+    queue without threads, the parallel/ home of the executors
+    themselves, non-package code — plus the suppression escape hatch."""
+    from pta_replicator_tpu.analysis import rules_threads
+
+    thread_only = """
+        import threading
+
+        def beat():
+            pass
+
+        threading.Thread(target=beat, daemon=True).start()
+    """
+    queue_only = """
+        import queue
+
+        def make():
+            return queue.Queue()
+    """
+    in_parallel = """
+        import queue
+        import threading
+
+        def start():
+            q = queue.Queue()
+            threading.Thread(target=q.get, daemon=True).start()
+    """
+    outside_pkg = """
+        import queue
+        import threading
+
+        q = queue.Queue()
+        threading.Thread(target=q.get, daemon=True).start()
+    """
+    suppressed_src = """
+        import queue
+        import threading
+
+        def start():
+            q = queue.Queue()
+            threading.Thread(target=q.get, daemon=True).start()  # graftlint: disable=parallel-adhoc-stage — coalescing request queue, not a staged FIFO pipeline
+    """
+    findings, suppressed = lint_tree(
+        tmp_path,
+        {
+            "pta_replicator_tpu/obs/beat.py": thread_only,
+            "pta_replicator_tpu/io/qonly.py": queue_only,
+            "pta_replicator_tpu/parallel/home.py": in_parallel,
+            "benchmarks/outside.py": outside_pkg,
+            "pta_replicator_tpu/io/supq.py": suppressed_src,
+        },
+        [rules_threads.AdhocStagePipeline()],
+    )
+    assert findings == []
+    assert rule_ids(suppressed) == ["parallel-adhoc-stage"]
+
+
+def test_adhoc_stage_clean_on_real_tree():
+    """The shipped package lints clean: every staged Thread+Queue
+    pipeline lives in parallel/ (the stage-graph executor and its
+    declarations), and the one intentional outside site (the
+    likelihood server's coalescing request queue) carries its inline
+    reason — empty baseline delta."""
+    from pta_replicator_tpu.analysis import rules_threads
+
+    pkg = os.path.join(REPO, "pta_replicator_tpu")
+    files = engine.iter_python_files([pkg], str(REPO))
+    mods, problems = engine.parse_modules(files, str(REPO))
+    active, suppressed = engine.run_rules(
+        mods, [rules_threads.AdhocStagePipeline()]
+    )
+    assert problems == []
+    assert active == [], [f.format() for f in active]
+    assert rule_ids(suppressed) == ["parallel-adhoc-stage"]

@@ -1,0 +1,667 @@
+"""Stage-graph executor property suite (parallel/stages.py) + the
+fused-sweep byte-identity ladder.
+
+The generic executor's contracts — FIFO ordering, bounded windows,
+DrainTimeout, exception-in-order, stop/drain, fault sites, trace
+adoption across every thread hop, stats/occupancy — are pinned here
+directly on declared graphs; the ported executors' own pinned behavior
+stays in tests/test_pipeline.py / test_cw_stream.py / test_multichip.py
+/ test_faults.py (all of which now run through this machinery). The
+fused sweep (utils/sweep.py fused_stream=True) is pinned byte-identical
+to the stacked path at depths 1/2/4 including crash-resume and
+supervised fault recovery."""
+import glob
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pta_replicator_tpu import obs
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.faults import inject
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.obs import names
+from pta_replicator_tpu.obs.trace import TRACER, chunk_trace_context
+from pta_replicator_tpu.parallel.stages import (
+    DrainTimeout,
+    Stage,
+    StageGraph,
+)
+from pta_replicator_tpu.utils.sweep import sweep
+
+
+def _passthrough(i, payload, sp):
+    return payload
+
+
+# ---------------------------------------------------------- driver mode
+
+def test_run_orders_bounds_and_stats():
+    """FIFO end to end, window never exceeded, stats account every
+    item with the full key set."""
+    written = []
+    inflight = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def produce(i, _p, sp):
+        with lock:
+            inflight[0] += 1
+            peak[0] = max(peak[0], inflight[0])
+        return i
+
+    def transform(i, v, sp):
+        time.sleep(0.005)  # let the source run ahead into the window
+        with lock:
+            inflight[0] -= 1
+        return v * 10
+
+    g = StageGraph(
+        [
+            Stage("produce", fn=produce),
+            Stage("transform", fn=transform, releases_window=True,
+                  out_maxsize=3),
+            Stage("sink", fn=lambda i, v, sp: written.append((i, v))),
+        ],
+        window=3,
+        drain_timeout_s=30.0,
+    )
+    stats = g.run(range(10))
+    assert written == [(i, i * 10) for i in range(10)]
+    assert peak[0] <= 3
+    assert stats["items"] == 10
+    assert stats["max_inflight"] <= 3
+    assert set(stats) >= {
+        "items", "wall_s", "max_inflight", "window_wait_s", "stall_s",
+        "stage_busy_s", "occupancy",
+    }
+    assert set(stats["stage_busy_s"]) == {"produce", "transform", "sink"}
+    assert stats["occupancy"].get("bottleneck")
+
+
+def test_run_inline_is_synchronous():
+    """Single-thread placement: every stage runs on the caller's
+    thread, strictly interleaved per item — the depth-1 sweep shape."""
+    events = []
+    main = threading.get_ident()
+
+    def a(i, _p, sp):
+        events.append(("a", i, threading.get_ident()))
+        return i
+
+    def b(i, v, sp):
+        events.append(("b", i, threading.get_ident()))
+
+    StageGraph(
+        [
+            Stage("a", fn=a, placement="inline"),
+            Stage("b", fn=b, placement="inline"),
+        ],
+    ).run(range(3))
+    assert [(s, i) for s, i, _t in events] == [
+        ("a", 0), ("b", 0), ("a", 1), ("b", 1), ("a", 2), ("b", 2)
+    ]
+    assert all(t == main for _s, _i, t in events)
+
+
+def test_run_exception_unchanged_and_marked():
+    """A stage exception re-raises UNCHANGED on the driver, with the
+    failing item index attached via mark_item (the sweep's
+    supervised-recovery contract)."""
+
+    class Boom(Exception):
+        pass
+
+    marks = []
+
+    def mark(exc, i):
+        marks.append(i)
+
+    def bad(i, v, sp):
+        if i == 2:
+            raise Boom("stage failed")
+        return v
+
+    with pytest.raises(Boom, match="stage failed"):
+        StageGraph(
+            [
+                Stage("src", fn=_passthrough),
+                Stage("bad", fn=bad, releases_window=True),
+                Stage("sink", fn=lambda i, v, sp: None),
+            ],
+            window=2,
+            mark_item=mark,
+        ).run(range(6))
+    assert 2 in marks
+
+
+def test_run_drain_timeout_on_wedged_stage():
+    """A wedged mid-graph stage trips the deadline fast instead of
+    hanging the driver forever, and bumps stages.drain_timeouts."""
+    hang = threading.Event()
+    c0 = obs.counter(names.STAGES_DRAIN_TIMEOUTS).value
+
+    def wedge(i, v, sp):
+        hang.wait(20.0)  # never set
+        return v
+
+    t0 = time.monotonic()
+    with pytest.raises(DrainTimeout):
+        StageGraph(
+            [
+                Stage("src", fn=_passthrough),
+                Stage("wedge", fn=wedge, releases_window=True,
+                      heartbeat_label="wedged stage"),
+                Stage("sink", fn=lambda i, v, sp: None),
+            ],
+            window=2,
+            drain_timeout_s=0.4,
+        ).run(range(4))
+    assert time.monotonic() - t0 < 10.0
+    assert obs.counter(names.STAGES_DRAIN_TIMEOUTS).value == c0 + 1
+    hang.set()
+
+
+def test_run_window_acquired_at_declared_stage():
+    """acquires_window on a downstream thread stage bounds items
+    between THAT stage and the releaser — the source may run further
+    ahead, bounded by its edge queue (the fused sweep's shape)."""
+    inflight = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def dispatch(i, v, sp):
+        with lock:
+            inflight[0] += 1
+            peak[0] = max(peak[0], inflight[0])
+        return v
+
+    def drain(i, v, sp):
+        time.sleep(0.005)
+        with lock:
+            inflight[0] -= 1
+        return v
+
+    stats = StageGraph(
+        [
+            Stage("build", fn=_passthrough, out_maxsize=1),
+            Stage("dispatch", fn=dispatch, acquires_window=True),
+            Stage("drain", fn=drain, releases_window=True,
+                  out_maxsize=2),
+            Stage("sink", fn=lambda i, v, sp: None),
+        ],
+        window=2,
+        drain_timeout_s=30.0,
+    ).run(range(10))
+    assert peak[0] <= 2
+    assert stats["items"] == 10
+
+
+def test_run_wedged_windowed_thread_stage_trips_deadline():
+    """A wedged operation inside a window-acquiring THREAD stage (the
+    fused sweep's dispatch shape) still trips DrainTimeout: the driver
+    blocked forwarding onto the full edge polls the deadline (post-
+    review fix — nothing else can observe this wedge)."""
+    hang = threading.Event()
+
+    def wedge(i, v, sp):
+        hang.wait(30.0)  # never set
+        return v
+
+    t0 = time.monotonic()
+    with pytest.raises(DrainTimeout):
+        StageGraph(
+            [
+                Stage("build", fn=_passthrough, out_maxsize=1),
+                Stage("dispatch", fn=wedge, acquires_window=True,
+                      heartbeat_label="wedged dispatch"),
+                Stage("drain", fn=_passthrough, releases_window=True,
+                      out_maxsize=2),
+                Stage("sink", fn=lambda i, v, sp: None),
+            ],
+            window=2,
+            drain_timeout_s=0.4,
+        ).run(range(8))
+    assert time.monotonic() - t0 < 10.0
+    hang.set()
+
+
+def test_iterate_source_fault_site_and_span_attrs_honored():
+    """Generator mode applies a SOURCE stage's declared fault_site and
+    span_attrs (post-review fix): a chaos schedule against the declared
+    site fires, and computed attrs land on the stage span."""
+    obs.reset_all()
+    with inject.armed("cw_stream_stage:raise@chunk=1"):
+        got = []
+        with pytest.raises(inject.InjectedFault):
+            for v in StageGraph(
+                [Stage("src", fn=_passthrough,
+                       span=names.SPAN_CW_STREAM_STAGE,
+                       fault_site=inject.SITE_PREFETCH_STAGE,
+                       span_attrs=lambda i, raw: {"nbytes": raw * 10})],
+                window=2,
+            ).iterate(iter(range(4))):
+                got.append(v)
+        assert [r["site"] for r in inject.fired()] == ["cw_stream_stage"]
+    assert got == [0]
+    spans = [e for e in TRACER.events() if e.get("type") == "span"
+             and e["name"] == "cw_stream_stage"]
+    assert spans[0]["attrs"]["nbytes"] == 0
+    assert spans[0]["attrs"]["chunk"] == 0
+
+
+def test_run_fault_site_fires_with_index():
+    """A stage's declared fault site fires inside its span with the
+    item index in the schedule's trigger ctx."""
+    written = []
+    with inject.armed("io_write:raise@chunk=1"):
+        with pytest.raises(inject.InjectedFault):
+            StageGraph(
+                [
+                    Stage("src", fn=_passthrough),
+                    Stage("w", span=names.SPAN_IO_WRITE,
+                          fault_site=inject.SITE_IO_WRITE,
+                          fn=lambda i, v, sp: written.append(i),
+                          releases_window=True),
+                ],
+                window=2,
+            ).run(range(4))
+        rec = inject.fired()
+        assert len(rec) == 1
+        assert rec[0]["site"] == "io_write"
+        assert rec[0]["chunk"] == 1
+    assert 0 in written and 1 not in written
+
+
+def test_run_per_item_trace_adopted_across_threads():
+    """trace_scope mode: every stage span of item i — across every
+    thread hop — carries the SAME deterministic chunk trace id."""
+    obs.reset_all()
+    StageGraph(
+        [
+            Stage("src", fn=_passthrough, span=names.SPAN_DISPATCH),
+            Stage("mid", fn=_passthrough, span=names.SPAN_DRAIN,
+                  releases_window=True),
+            Stage("sink", fn=lambda i, v, sp: None,
+                  span=names.SPAN_IO_WRITE),
+        ],
+        window=2,
+        trace_scope="scope-x",
+    ).run(range(3))
+    spans = [e for e in TRACER.events() if e.get("type") == "span"
+             and e["name"] in ("dispatch", "drain", "io_write")]
+    assert len(spans) == 9
+    for e in spans:
+        i = e["attrs"]["chunk"]
+        assert e["trace_id"] == chunk_trace_context("scope-x", i).trace_id
+
+
+# ------------------------------------------------------- generator mode
+
+def test_iterate_orders_and_window():
+    built = [0]
+    peak = [0]
+    lock = threading.Lock()
+
+    def items():
+        for i in range(10):
+            with lock:
+                built[0] += 1
+                peak[0] = max(peak[0], built[0])
+            yield i
+
+    got = []
+    g = StageGraph(
+        [Stage("stagex", fn=lambda i, v, sp: v * 2, index_attr="tile")],
+        window=2,
+    )
+    for v in g.iterate(items()):
+        time.sleep(0.004)
+        got.append(v)
+        with lock:
+            built[0] -= 1
+    assert got == [2 * i for i in range(10)]
+    assert peak[0] <= 3  # window + the one being consumed
+    assert g.stats["items"] == 10
+
+
+def test_iterate_error_after_in_order_prefix():
+    class Boom(Exception):
+        pass
+
+    def items():
+        yield 0
+        yield 1
+        raise Boom("build failed")
+
+    got = []
+    with pytest.raises(Boom, match="build failed"):
+        for v in StageGraph(
+            [Stage("s", fn=_passthrough, index_attr="tile")],
+            window=2,
+        ).iterate(items()):
+            got.append(v)
+    assert got == [0, 1]
+
+
+def test_iterate_drain_timeout_and_abandon():
+    hang = threading.Event()
+
+    def wedge(i, v, sp):
+        hang.wait(20.0)
+        return v
+
+    t0 = time.monotonic()
+    with pytest.raises(DrainTimeout):
+        for _ in StageGraph(
+            [Stage("s", fn=wedge, index_attr="tile")],
+            window=2, drain_timeout_s=0.4, stall_what="test staging",
+        ).iterate(iter(range(3))):
+            pass
+    assert time.monotonic() - t0 < 10.0
+    hang.set()
+
+    # abandon: breaking out must stop + join the worker promptly
+    built = [0]
+
+    def items():
+        for i in range(100):
+            built[0] += 1
+            yield i
+
+    gen = StageGraph(
+        [Stage("s", fn=_passthrough, index_attr="tile")], window=2,
+    ).iterate(items())
+    next(gen)
+    gen.close()
+    time.sleep(0.3)
+    assert built[0] <= 5
+
+
+def test_iterate_carries_consumer_trace():
+    """Worker stage spans stitch onto the trace live on the CONSUMER
+    thread when the generator starts (carry()/adopt())."""
+    from pta_replicator_tpu.obs.trace import adopt
+
+    obs.reset_all()
+    ctx = chunk_trace_context("consumer-scope", 7)
+    with adopt(ctx):
+        got = list(StageGraph(
+            [Stage("s", fn=_passthrough, span=names.SPAN_CW_STREAM_STAGE,
+                   index_attr="tile")],
+            window=2,
+        ).iterate(iter(range(3))))
+    assert got == [0, 1, 2]
+    spans = [e for e in TRACER.events() if e.get("type") == "span"
+             and e["name"] == "cw_stream_stage"]
+    # 3 staged tiles + the end-of-stream probe span (eos=True)
+    assert len(spans) == 4
+    assert spans[-1]["attrs"].get("eos") is True
+    assert all(e["trace_id"] == ctx.trace_id for e in spans)
+
+
+def test_iterate_fanout_broadcast_and_gather():
+    """Replica fan-out: every input reaches every replica, outputs
+    gather per item in replica order; a replica error re-raises after
+    the in-order prefix and all workers join."""
+
+    def stage(r, i, v, sp):
+        return (r, v)
+
+    g = StageGraph(
+        [
+            Stage("build", fn=_passthrough, index_attr="tile"),
+            Stage("rep", fn=stage, index_attr="tile",
+                  replicas=[("A", "a"), ("B", "b")]),
+        ],
+        window=2,
+    )
+    got = list(g.iterate(iter(range(5))))
+    assert got == [[("A", i), ("B", i)] for i in range(5)]
+
+    class Boom(Exception):
+        pass
+
+    def flaky(r, i, v, sp):
+        if r == "B" and i == 2:
+            raise Boom("replica failed")
+        return (r, v)
+
+    got = []
+    with pytest.raises(Boom):
+        for item in StageGraph(
+            [
+                Stage("build", fn=_passthrough, index_attr="tile"),
+                Stage("rep", fn=flaky, index_attr="tile",
+                      replicas=[("A", "a"), ("B", "b")]),
+            ],
+            window=2,
+        ).iterate(iter(range(6))):
+            got.append(item)
+    assert got == [[("A", i), ("B", i)] for i in range(len(got))]
+    assert len(got) < 6
+
+
+# -------------------------------------------------- telemetry + config
+
+def test_stages_gauges_updated():
+    obs.reset_all()
+    StageGraph(
+        [
+            Stage("srcstage", fn=_passthrough),
+            Stage("sinkstage", fn=lambda i, v, sp: None,
+                  releases_window=True),
+        ],
+        window=2,
+    ).run(range(4))
+    busy = obs.gauge(names.STAGES_BUSY_S, stage="sinkstage").value
+    assert busy >= 0.0
+    edge = obs.gauge(names.STAGES_EDGE_INFLIGHT,
+                     edge="srcstage->sinkstage").value
+    assert edge >= 0
+
+
+def test_graph_validation_errors():
+    ok = Stage("s", fn=_passthrough)
+    with pytest.raises(ValueError, match="at least one stage"):
+        StageGraph([])
+    with pytest.raises(ValueError, match="window"):
+        StageGraph([ok], window=0)
+    with pytest.raises(ValueError, match="final stage"):
+        StageGraph([
+            Stage("r", fn=_passthrough, replicas=[("A", "a")]),
+            Stage("t", fn=_passthrough),
+        ])
+    with pytest.raises(ValueError, match="acquire"):
+        StageGraph([
+            Stage("a", fn=_passthrough, acquires_window=True),
+            Stage("b", fn=_passthrough, acquires_window=True),
+        ])
+    with pytest.raises(ValueError, match="generator-mode"):
+        StageGraph([
+            Stage("src", fn=_passthrough),
+            Stage("r", fn=_passthrough, replicas=[("A", "a")]),
+        ]).run(range(2))
+
+
+def test_regress_directions_for_stages_series():
+    from pta_replicator_tpu.obs.regress import metric_direction
+
+    assert metric_direction("fused.overlap_efficiency_e2e") is True
+    assert metric_direction("stacked.overlap_efficiency_e2e") is True
+    assert metric_direction("fused.stall_s") is False
+    assert metric_direction("fused.window_wait_s") is False
+
+
+# ------------------------------------------- fused sweep identity ladder
+
+@pytest.fixture()
+def streamed_cw_sweep():
+    """A small streamed-CW recipe: the shape whose per-chunk static
+    build the fused graph overlaps with compute/readback/write."""
+    b = synthetic_batch(npsr=4, ntoa=64, seed=2)
+    rng = np.random.default_rng(1)
+    ncw = 32
+    params = np.stack([
+        np.arccos(rng.uniform(-1, 1, ncw)),
+        rng.uniform(0, 2 * np.pi, ncw),
+        10 ** rng.uniform(8, 9.5, ncw),
+        rng.uniform(50, 1000, ncw),
+        10 ** rng.uniform(-8.8, -7.6, ncw),
+        rng.uniform(0, 2 * np.pi, ncw),
+        rng.uniform(0, np.pi, ncw),
+        np.arccos(rng.uniform(-1, 1, ncw)),
+    ])
+    recipe = Recipe(
+        efac=jnp.ones(4),
+        rn_log10_amplitude=jnp.full(4, -14.0),
+        rn_gamma=jnp.full(4, 4.0),
+        cgw_params=jnp.asarray(params),
+        cgw_stream_chunk=8,
+    )
+    return b, recipe, jax.random.PRNGKey(5)
+
+
+def test_fused_sweep_byte_identical_across_depths(
+    tmp_path, streamed_cw_sweep
+):
+    """The fused graph's checkpoints, sidecars, and returned array are
+    byte-for-byte the stacked path's, at depths 1/2/4 — the per-chunk
+    static rebuild is bitwise the one-time precompute."""
+    b, recipe, key = streamed_cw_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ref_ck,
+                pipeline_depth=1)
+    ref_npz = open(ref_ck, "rb").read()
+    ref_meta = open(ref_ck + ".meta.json", "rb").read()
+    for depth in (2, 4):
+        ck = str(tmp_path / f"fused{depth}.npz")
+        out = sweep(key, b, recipe, nreal=16, chunk=4,
+                    checkpoint_path=ck, pipeline_depth=depth,
+                    fused_stream=True)
+        assert open(ck, "rb").read() == ref_npz
+        assert open(ck + ".meta.json", "rb").read() == ref_meta
+        np.testing.assert_array_equal(out, ref)
+        assert glob.glob(ck + ".chunk*") == []
+
+
+def test_fused_sweep_emits_static_build_spans(tmp_path, streamed_cw_sweep):
+    """One static_build span per chunk, on the fused path only, and the
+    sweep_pipeline span carries the fused stats (static_build in
+    stage_busy_s)."""
+    b, recipe, key = streamed_cw_sweep
+    obs.reset_all()
+    sweep(key, b, recipe, nreal=8, chunk=4,
+          checkpoint_path=str(tmp_path / "f.npz"),
+          pipeline_depth=2, fused_stream=True)
+    spans = [e for e in TRACER.events() if e.get("type") == "span"]
+    builds = [e for e in spans if e["name"] == names.SPAN_STATIC_BUILD]
+    assert [e["attrs"]["chunk"] for e in builds] == [0, 1]
+    pipeline = [e for e in spans if e["name"] == "sweep_pipeline"]
+    assert len(pipeline) == 1
+    assert pipeline[0]["attrs"]["fused"] is True
+    assert "static_build" in pipeline[0]["attrs"]["stage_busy_s"]
+    # chunk traces mean the same thing fused or not: the dispatch span
+    # of chunk i carries the deterministic (checkpoint_path, i) trace
+    disp = [e for e in spans if e["name"] == "dispatch"]
+    ck = str(tmp_path / "f.npz")
+    for e in disp:
+        assert e["trace_id"] == chunk_trace_context(
+            ck, e["attrs"]["chunk"]
+        ).trace_id
+
+
+def test_fused_sweep_crash_resume_byte_identical(
+    tmp_path, streamed_cw_sweep, monkeypatch
+):
+    """Kill a fused sweep between chunk file and sidecar; a fused
+    resume recomputes only the unrecorded chunks and matches the
+    uninterrupted run bitwise (the crash-resume contract holds through
+    the fused graph)."""
+    import importlib
+
+    sweep_mod = importlib.import_module("pta_replicator_tpu.utils.sweep")
+    b, recipe, key = streamed_cw_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ref_ck)
+
+    class _KillSim(BaseException):
+        pass
+
+    orig = sweep_mod._atomic_write
+    seen = {"json": 0}
+
+    def bombed(write_fn, final_path, suffix, durable=False):
+        if suffix == ".json":
+            seen["json"] += 1
+            if seen["json"] == 2:
+                raise _KillSim()
+        return orig(write_fn, final_path, suffix, durable=durable)
+
+    monkeypatch.setattr(sweep_mod, "_atomic_write", bombed)
+    ck = str(tmp_path / "crash.npz")
+    with pytest.raises(_KillSim):
+        sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck,
+              pipeline_depth=2, fused_stream=True, chunk_retries=0)
+    monkeypatch.undo()
+
+    calls = []
+    out = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ck,
+                pipeline_depth=2, fused_stream=True,
+                progress=lambda d, t: calls.append(d))
+    assert calls == [2, 3, 4]  # chunk 0 survived; 1..3 recomputed
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+def test_fused_sweep_absorbs_transient_fault_byte_identical(
+    tmp_path, streamed_cw_sweep
+):
+    """A transient injected chunk failure on the fused path is absorbed
+    by the supervised-recovery loop (same sites, same schedule meaning)
+    and the recovered checkpoint stays byte-identical."""
+    b, recipe, key = streamed_cw_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=16, chunk=4, checkpoint_path=ref_ck)
+    ck = str(tmp_path / "chaos.npz")
+    from pta_replicator_tpu.faults.retry import RetryPolicy
+
+    with inject.armed("dispatch:raise@chunk=1"):
+        out = sweep(key, b, recipe, nreal=16, chunk=4,
+                    checkpoint_path=ck, pipeline_depth=2,
+                    fused_stream=True, chunk_retries=2,
+                    retry_policy=RetryPolicy(base_delay_s=0.01,
+                                             max_delay_s=0.05))
+        assert [r["site"] for r in inject.fired()] == ["dispatch"]
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+def test_fused_sweep_rejects_mesh_and_depth1(tmp_path, streamed_cw_sweep):
+    b, recipe, key = streamed_cw_sweep
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        sweep(key, b, recipe, nreal=8, chunk=4,
+              checkpoint_path=str(tmp_path / "x.npz"),
+              pipeline_depth=1, fused_stream=True)
+    from pta_replicator_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="mesh"):
+        sweep(key, b, recipe, nreal=8, chunk=4,
+              checkpoint_path=str(tmp_path / "y.npz"),
+              mesh=make_mesh(2, 1), fused_stream=True)
+
+
+def test_cli_fused_stream_requires_checkpoint():
+    """--fused-stream without --checkpoint refuses (before ingest)
+    instead of silently running the unfused realize path."""
+    from pta_replicator_tpu.__main__ import main
+
+    with pytest.raises(SystemExit, match="fused-stream needs"):
+        main(["realize", "--pardir", "/nonexistent", "--timdir",
+              "/nonexistent", "--recipe", "/nonexistent.json",
+              "--nreal", "4", "--out", "/tmp/never.npz",
+              "--fused-stream"])
