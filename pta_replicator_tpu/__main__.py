@@ -265,6 +265,49 @@ def main(argv=None):
     p.add_argument("--threshold", type=float, default=0.10,
                    help="relative regression gate (default 0.10 = 10%%; "
                         "half of it is the warn band)")
+    p = sub.add_parser(
+        "critpath", help="critical-path attribution over a finished "
+                         "capture: per-chunk span-DAG reconstruction, "
+                         "busy/blocked/queue-wait decomposition, mesh "
+                         "straggler spread, and a ranked bottleneck "
+                         "verdict with estimated savings — written as "
+                         "DIR/critpath.json (served at /critpath, "
+                         "rendered in `report`, annotated in "
+                         "`timeline`)")
+    p.add_argument("dir", help="the run's --telemetry directory")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="output path (default DIR/critpath.json)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full critpath.json document instead "
+                        "of the rendered verdict")
+    p = sub.add_parser(
+        "perf", help="cross-round performance ledger over the committed "
+                     "bench artifacts: ingest (write PERF_LEDGER.json), "
+                     "trend (per-metric sparkline trajectories), gate "
+                     "(fail on any metric monotonically regressing over "
+                     "the last --window rounds — the slow-leak class "
+                     "the pairwise bench-diff cannot see)")
+    p.add_argument("action", choices=("ingest", "trend", "gate"),
+                   help="ingest: rebuild + write ROOT/PERF_LEDGER.json; "
+                        "trend: render trajectories; gate: exit 1 on a "
+                        "windowed monotone regression (reasons to "
+                        "stderr)")
+    p.add_argument("pattern", nargs="?", default=None,
+                   help="trend: only metrics containing this substring")
+    p.add_argument("--root", default=".", metavar="DIR",
+                   help="directory holding the round-stamped artifacts "
+                        "(default: current directory)")
+    p.add_argument("--out", default=None, metavar="PATH",
+                   help="ingest: ledger output path "
+                        "(default ROOT/PERF_LEDGER.json)")
+    p.add_argument("--window", type=int, default=3, metavar="K",
+                   help="gate: rounds a metric must worsen across "
+                        "monotonically to fail (default 3)")
+    p.add_argument("--min-total", type=float, default=None,
+                   metavar="REL",
+                   help="gate: cumulative relative decline across the "
+                        "window below which a monotone drift is not "
+                        "flagged (default 0.05)")
     p = sub.choices["realize"]
     p.add_argument("--device-trace", action="store_true",
                    help="also capture an XLA device trace (jax.profiler) "
@@ -424,6 +467,55 @@ def main(argv=None):
         print(table)
         if rc:
             raise SystemExit(rc)
+        return
+    if args.cmd == "critpath":
+        from .obs import critpath as _critpath
+
+        doc = _critpath.analyze_capture(args.dir)
+        if doc is None:
+            # exit 2 (unusable input), matching bench-diff's convention:
+            # rc 1 would read as "a gate failed" to CI
+            print(
+                f"critpath: {args.dir}: no stage spans to attribute "
+                "(missing events.jsonl, or the run never touched a "
+                "staged executor)",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        out = _critpath.write_critpath(args.dir, out=args.out, doc=doc)
+        if args.json:
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            print(_critpath.render_critpath(doc))
+        print(f"critpath: wrote {out}", file=sys.stderr)
+        return
+    if args.cmd == "perf":
+        from .obs import ledger as _ledger
+
+        led = _ledger.build_ledger(args.root)
+        if args.action == "ingest":
+            out = _ledger.write_ledger(args.root, out=args.out,
+                                       ledger=led)
+            print(
+                f"perf ingest: {led['rounds']} round(s), "
+                f"{len(led['metrics'])} metric trajectories, "
+                f"{len(led['refused'])} refused -> {out}"
+            )
+            for base, reason in sorted(led["refused"].items()):
+                print(f"  refused {base}: {reason}", file=sys.stderr)
+        elif args.action == "trend":
+            print(_ledger.render_trend(led, pattern=args.pattern))
+        else:
+            kwargs = {}
+            if args.min_total is not None:
+                kwargs["min_total"] = args.min_total
+            summary, _flagged, rc = _ledger.gate(
+                led, window=args.window, **kwargs
+            )
+            # reasons to stderr on failure, the bench gates' convention
+            print(summary, file=sys.stderr if rc else sys.stdout)
+            if rc:
+                raise SystemExit(rc)
         return
 
     if args.platform:

@@ -185,6 +185,7 @@ class Rule:
 def default_rules() -> List[Rule]:
     """The shipped rule packs (imported lazily to avoid cycles)."""
     from . import (
+        rules_bench,
         rules_cov,
         rules_jax,
         rules_obs,
@@ -202,6 +203,7 @@ def default_rules() -> List[Rule]:
         *rules_robust.RULES,
         *rules_scenarios.RULES,
         *rules_cov.RULES,
+        *rules_bench.RULES,
     ]
 
 

@@ -1,0 +1,228 @@
+"""graftlint rule pack: benchmark gate discipline.
+
+Every benchmark under ``benchmarks/`` is a CI gate: it measures, it
+checks, and on failure it exits nonzero so ``scripts/check.sh`` goes
+red. The repo-wide idiom (docs/performance.md) is that the red exit is
+always paired with a *reason* on stderr::
+
+    print(f"stage_graph GATE FAIL: {reason}", file=sys.stderr)
+    return 1
+
+The anti-pattern this pack polices is the silent gate::
+
+    if not ok:
+        return 1        # CI goes red; the log says nothing
+
+A silent nonzero exit is the worst failure mode a gate can have: the
+round is blocked, the artifact is missing, and the only diagnostic is
+an exit status — the investigating human re-runs the whole benchmark
+under a debugger just to learn which assertion tripped. Hence:
+
+* ``bench-silent-gate`` — inside ``benchmarks/*.py`` (and nowhere
+  else: package modules return status codes for all sorts of reasons),
+  flag a gate-failure exit — ``sys.exit(<nonzero int>)``,
+  ``raise SystemExit(<nonzero int>)``, or ``return <nonzero int>``
+  from a ``main``/``run*`` function (the repo's gate-arm naming) —
+  that is not preceded, on the same control-flow path, by a write to
+  stderr (``print(..., file=sys.stderr)`` or ``sys.stderr.write``).
+
+What does NOT fire, by design:
+
+- ``sys.exit(main())`` / ``sys.exit(rc)`` — non-constant exit codes
+  are dispatch, not a gate verdict; the verdict site is where the
+  constant is.
+- ``sys.exit("message")`` / ``raise SystemExit("message")`` — the
+  interpreter prints a string argument to stderr itself; the reason
+  is built in.
+- ``return 1`` in helpers not named ``main``/``run*`` — a literal
+  int return value is only an exit code in the entrypoint/arm
+  functions; elsewhere it is just a value.
+
+Path sensitivity is block-chain scoped: a stderr write anywhere in a
+statement *preceding* the exit within the same (or an enclosing)
+block covers it — so the common ``for f in failures: print(...,
+file=sys.stderr)`` loop before ``return 1`` counts, while a reason
+printed only in the *other* arm of the ``if`` does not. A call to a
+module-local helper whose own body writes stderr (the ``def
+log(msg): print(..., file=sys.stderr)`` idiom) counts too — one
+level of indirection, resolved within the file. Exits whose reason
+goes through a helper imported from elsewhere carry an inline
+``# graftlint: disable=bench-silent-gate`` with the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .engine import Finding, Module, Rule
+
+#: the subtree this pack polices (posix relpath prefix) — note the
+#: INVERTED scope relative to the other packs: benchmarks only
+BENCH_PREFIX = "benchmarks/"
+
+#: function-name shapes whose ``return <int>`` is an exit code by repo
+#: convention (``sys.exit(main())`` entrypoints and the run_arm/run_*
+#: gate arms) rather than an ordinary value
+_EXIT_CODE_FUNCS = ("main", "run")
+
+
+def _nonzero_int(node: Optional[ast.AST]) -> bool:
+    return (
+        isinstance(node, ast.Constant)
+        and type(node.value) is int
+        and node.value != 0
+    )
+
+
+def _exit_code_func(name: str) -> bool:
+    return name == _EXIT_CODE_FUNCS[0] or name.startswith(
+        _EXIT_CODE_FUNCS[1]
+    )
+
+
+def _is_silent_exit(mod: Module, stmt: ast.stmt,
+                    in_exit_func: bool) -> bool:
+    """True when ``stmt`` terminates the process (or the gate arm)
+    with a literal nonzero status and no intrinsic stderr output."""
+    if isinstance(stmt, ast.Return):
+        return in_exit_func and _nonzero_int(stmt.value)
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        return (
+            mod.resolve(call.func) == "sys.exit"
+            and len(call.args) == 1
+            and _nonzero_int(call.args[0])
+        )
+    if isinstance(stmt, ast.Raise) and isinstance(stmt.exc, ast.Call):
+        call = stmt.exc
+        return (
+            (mod.resolve(call.func) or "").endswith("SystemExit")
+            and len(call.args) == 1
+            and _nonzero_int(call.args[0])
+        )
+    return False
+
+
+def _writes_stderr_direct(mod: Module, stmt: ast.AST) -> bool:
+    """True when any call inside ``stmt`` puts text on stderr
+    directly: ``print(..., file=sys.stderr)`` or
+    ``sys.stderr.write(...)``."""
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "write":
+            if mod.resolve(fn.value) == "sys.stderr":
+                return True
+        if mod.resolve(fn) == "print":
+            for kw in node.keywords:
+                if kw.arg == "file" and (
+                    mod.resolve(kw.value) == "sys.stderr"
+                ):
+                    return True
+    return False
+
+
+def _stderr_helpers(mod: Module) -> frozenset:
+    """Names of module-level functions whose own body writes stderr —
+    the local ``log``/``fail`` helper idiom. One level only: a helper
+    calling another helper does not transitively qualify."""
+    names = set()
+    for stmt in mod.tree.body:
+        if isinstance(
+            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) and any(
+            _writes_stderr_direct(mod, sub) for sub in stmt.body
+        ):
+            names.add(stmt.name)
+    return frozenset(names)
+
+
+def _writes_stderr(mod: Module, stmt: ast.stmt,
+                   helpers: frozenset) -> bool:
+    if _writes_stderr_direct(mod, stmt):
+        return True
+    for node in ast.walk(stmt):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in helpers
+        ):
+            return True
+    return False
+
+
+def _child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    """The statement lists nested one level under ``stmt`` (if/else
+    arms, loop bodies, with bodies, try arms) — NOT function bodies,
+    which open a fresh scan scope."""
+    blocks: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        sub = getattr(stmt, field, None)
+        if isinstance(sub, list) and sub and isinstance(
+            sub[0], ast.stmt
+        ):
+            blocks.append(sub)
+    for handler in getattr(stmt, "handlers", []) or []:
+        blocks.append(handler.body)
+    return blocks
+
+
+class SilentGate(Rule):
+    id = "bench-silent-gate"
+    severity = "error"
+    description = (
+        "benchmark gate failure exits nonzero without printing the "
+        "reason to stderr — CI goes red with an empty log"
+    )
+
+    def _scan(
+        self,
+        mod: Module,
+        body: List[ast.stmt],
+        seen_stderr: bool,
+        in_exit_func: bool,
+        helpers: frozenset,
+        out: List[Tuple[int, str]],
+    ) -> None:
+        seen = seen_stderr
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # fresh path: a gate arm must print its own reason,
+                # not inherit one from module import time
+                self._scan(
+                    mod, stmt.body, False,
+                    _exit_code_func(stmt.name), helpers, out,
+                )
+                continue
+            if _is_silent_exit(mod, stmt, in_exit_func) and not seen:
+                kind = (
+                    "returns" if isinstance(stmt, ast.Return)
+                    else "exits"
+                )
+                out.append((
+                    stmt.lineno,
+                    f"gate-failure branch {kind} nonzero with no "
+                    "stderr reason on the path: add a "
+                    "'<bench> GATE FAIL: <why>' print(..., "
+                    "file=sys.stderr) before it — or suppress "
+                    "inline with the reason",
+                ))
+            for sub in _child_blocks(stmt):
+                self._scan(mod, sub, seen, in_exit_func, helpers, out)
+            if _writes_stderr(mod, stmt, helpers):
+                seen = True
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not mod.relpath.startswith(BENCH_PREFIX):
+            return
+        helpers = _stderr_helpers(mod)
+        hits: List[Tuple[int, str]] = []
+        self._scan(mod, mod.tree.body, False, False, helpers, hits)
+        for lineno, msg in hits:
+            yield self.finding(mod, lineno, msg)
+
+
+RULES = [SilentGate()]

@@ -352,6 +352,15 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/scenarios/fuzz.py", "metric",
          n.SCENARIO_FUZZ_DISAGREEMENTS),
         (f"{pkg}/scenarios/fuzz.py", "metric", n.SCENARIO_SHRINK_STEPS),
+        # critical-path attribution + performance ledger (PR 16): the
+        # offline analyzers' own telemetry — the analyze span that
+        # bounds the overhead claim, the chunk/straggler gauges, and
+        # the ledger's round/regression gauges
+        (f"{pkg}/obs/critpath.py", "span", n.SPAN_CRITPATH_ANALYZE),
+        (f"{pkg}/obs/critpath.py", "metric", n.CRITPATH_CHUNKS),
+        (f"{pkg}/obs/critpath.py", "metric", n.CRITPATH_STRAGGLERS),
+        (f"{pkg}/obs/ledger.py", "metric", n.LEDGER_ROUNDS),
+        (f"{pkg}/obs/ledger.py", "metric", n.LEDGER_REGRESSIONS),
         (f"{pkg}/__main__.py", "span", n.SPAN_COMPUTE),
         (f"{pkg}/__main__.py", "span", n.SPAN_INGEST),
         ("bench.py", "span", n.SPAN_BENCH_MEASURE),

@@ -27,9 +27,11 @@ import sys
 import time
 
 from . import (
+    critpath,
     devprof,
     flightrec,
     jaxhooks,
+    ledger,
     metrics,
     names,
     occupancy,
@@ -73,7 +75,8 @@ __all__ = [
     "telemetry_summary", "reset_all", "metrics", "trace", "report",
     "jaxhooks", "flightrec", "regress", "FlightRecorder", "StallWarning",
     "names", "devprof", "occupancy", "series", "timeline", "serve",
-    "slo", "TraceContext", "adopt", "carry", "current_trace",
+    "slo", "critpath", "ledger",
+    "TraceContext", "adopt", "carry", "current_trace",
 ]
 
 
@@ -126,7 +129,8 @@ def start_capture(
 
     for stale_artifact in ("progress.json", "postmortem.json",
                            "series.json", "series.jsonl",
-                           "timeline.json", "metrics.prom", "slo.json"):
+                           "timeline.json", "metrics.prom", "slo.json",
+                           "critpath.json"):
         try:
             _os.remove(_os.path.join(directory, stale_artifact))
         except OSError:

@@ -1,0 +1,276 @@
+"""Cross-round performance ledger over the committed bench artifacts.
+
+Every round commits one or more evidence JSONs at the repo root
+(``BENCH_r03.json``, ``MULTICHIP_r06_cpu.json``, ``STAGES_r15_cpu.json``,
+...), each carrying the shared provenance stamp
+(utils/provenance.py: schema_version + git_rev + platform). Until now
+nothing held them together: ``bench-diff`` (obs/regress.py) is strictly
+pairwise, so a metric decaying 3% per round for five rounds never trips
+the 10% gate — each step looks like noise, the trajectory is a cliff.
+
+This module is the trajectory store:
+
+* :func:`build_ledger` ingests every artifact matching the round-
+  stamped naming convention (``<FAMILY>_r<NN>[_variant].json``) into
+  one schema-versioned document keyed by dotted metric name
+  (``<family>.<flattened.leaf>``), each with its
+  :func:`~.regress.metric_direction` class (``higher`` / ``lower`` /
+  ``info``) and its per-round point series. Unreadable or
+  newer-schema artifacts are refused BY NAME with the reason — a
+  malformed round degrades to a ledger note, never a traceback.
+* ``perf ingest`` writes the result as ``PERF_LEDGER.json`` (validated
+  by scripts/check_telemetry_schema.py).
+* ``perf trend`` (:func:`render_trend`) renders per-metric
+  trajectories with sparklines — the whole-history view bench-diff
+  never had.
+* ``perf gate`` (:func:`gate`) generalizes the pairwise gate to a
+  window: any direction-classified metric that worsens MONOTONICALLY
+  across the last K points, with a cumulative decline past
+  ``min_total``, fails the gate (exit 1, reasons to stderr) even when
+  every individual step is under the pairwise threshold.
+
+jax-free, stdlib-only: runs in CI and anywhere the report CLI does.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import names, regress
+from .metrics import gauge
+
+#: bump when a field keeps its spelling but changes meaning/units —
+#: readers (schema check, trend renderer) refuse newer files
+LEDGER_SCHEMA_VERSION = 1
+
+#: the three direction classes a ledger metric may carry — the string
+#: spellings of regress.metric_direction's True / False / None
+DIRECTION_CLASSES = ("higher", "lower", "info")
+
+#: round-stamped artifact naming convention at the repo root:
+#: <FAMILY>_r<NN>[_variant...].json (BENCH_r03.json,
+#: CW_SCALING_FULLSHAPE_r05_cpu.json, ...)
+ARTIFACT_RE = re.compile(
+    r"^(?P<family>[A-Z][A-Za-z0-9_]*?)_r(?P<round>\d+)"
+    r"(?P<variant>(?:_[A-Za-z0-9]+)*)\.json$"
+)
+
+#: windowed-gate defaults: a step must worsen by more than ``MIN_STEP``
+#: (relative) to count as monotone movement rather than float noise,
+#: and the window's cumulative decline must exceed ``MIN_TOTAL`` to
+#: fail the gate — half the pairwise default threshold, so a slow leak
+#: trips here rounds before it would ever trip bench-diff
+MIN_STEP = 0.001
+MIN_TOTAL = 0.05
+
+
+def direction_class(name: str) -> str:
+    """The ledger's string spelling of regress.metric_direction."""
+    d = regress.metric_direction(name)
+    return "info" if d is None else ("higher" if d else "lower")
+
+
+def discover_artifacts(root: str) -> List[Tuple[str, str, int]]:
+    """Round-stamped artifacts under ``root`` (non-recursive), as
+    sorted (path, family, round) triples."""
+    out = []
+    for fname in sorted(os.listdir(root)):
+        m = ARTIFACT_RE.match(fname)
+        if m:
+            out.append(
+                (os.path.join(root, fname), m.group("family"),
+                 int(m.group("round")))
+            )
+    return out
+
+
+def build_ledger(root: str) -> dict:
+    """Ingest every round-stamped artifact under ``root`` into one
+    ledger document. Never raises on a bad artifact: each refusal is
+    recorded by file name with a one-line reason."""
+    metrics: Dict[str, dict] = {}
+    sources: Dict[str, dict] = {}
+    refused: Dict[str, str] = {}
+    rounds = set()
+    for path, family, rnd in discover_artifacts(root):
+        base = os.path.basename(path)
+        try:
+            doc = regress.load_bench(path)
+        except regress.SchemaMismatch:
+            refused[base] = (
+                "schema_version newer than this reader "
+                f"(knows <= {regress.KNOWN_SCHEMA_VERSION}) — upgrade "
+                "before ingesting, metric meanings may have changed"
+            )
+            continue
+        except (json.JSONDecodeError, OSError) as exc:
+            refused[base] = f"unreadable ({exc})"
+            continue
+        flat = regress.flatten_metrics(doc)
+        if not flat:
+            refused[base] = (
+                "no measurements (parsed JSON empty — the round never "
+                "produced output)"
+            )
+            continue
+        rounds.add((family, rnd))
+        sources[base] = {
+            "family": family,
+            "round": rnd,
+            "schema_version": doc.get("schema_version", 0),
+            "git_rev": doc.get("git_rev"),
+            "timestamp": doc.get("timestamp", doc.get("written_at")),
+        }
+        for leaf, value in flat.items():
+            key = f"{family.lower()}.{leaf}"
+            m = metrics.setdefault(
+                key, {"direction": direction_class(leaf), "points": []}
+            )
+            m["points"].append(
+                {"round": rnd, "file": base, "value": value}
+            )
+    for m in metrics.values():
+        m["points"].sort(key=lambda p: (p["round"], p["file"]))
+    gauge(names.LEDGER_ROUNDS).set(len(rounds))
+    return {
+        "schema_version": LEDGER_SCHEMA_VERSION,
+        "rounds": len(rounds),
+        "sources": sources,
+        "refused": refused,
+        "metrics": metrics,
+    }
+
+
+def write_ledger(root: str, out: Optional[str] = None,
+                 ledger: Optional[dict] = None) -> str:
+    """Build (or take) a ledger and write it as ``PERF_LEDGER.json``
+    under ``root`` (atomic tmp+replace)."""
+    if ledger is None:
+        ledger = build_ledger(root)
+    out = out or os.path.join(root, "PERF_LEDGER.json")
+    tmp = out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(ledger, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, out)
+    return out
+
+
+def load_ledger(path: str) -> dict:
+    """Read a written PERF_LEDGER.json, refusing newer schemas the
+    same way regress.load_bench refuses newer bench files."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    version = doc.get("schema_version", 0)
+    if isinstance(version, int) and version > LEDGER_SCHEMA_VERSION:
+        raise regress.SchemaMismatch(
+            f"{path}: ledger schema_version {version} is newer than "
+            f"this reader (knows <= {LEDGER_SCHEMA_VERSION})"
+        )
+    return doc
+
+
+def render_trend(
+    ledger: dict, pattern: Optional[str] = None, width: int = 24,
+    min_points: int = 2,
+) -> str:
+    """Per-metric trajectory table with sparklines: every ledger metric
+    with at least ``min_points`` points (optionally filtered by a
+    substring ``pattern``), its direction class, round range, and
+    latest value."""
+    from .report import _fmt_value, sparkline
+
+    rows = []
+    for name in sorted(ledger.get("metrics") or {}):
+        if pattern and pattern not in name:
+            continue
+        m = ledger["metrics"][name]
+        points = m.get("points") or []
+        if len(points) < min_points:
+            continue
+        values = [p["value"] for p in points]
+        rows.append(
+            f"  {name[:56]:<56} {sparkline(values, width):<{width}}  "
+            f"r{points[0]['round']:02d}->r{points[-1]['round']:02d}  "
+            f"latest {_fmt_value(values[-1])}  ({m['direction']})"
+        )
+    if not rows:
+        return (
+            "perf trend: no ledger metric matches"
+            + (f" {pattern!r}" if pattern else "")
+        )
+    head = f"perf trend: {len(rows)} metric trajectories"
+    if pattern:
+        head += f" matching {pattern!r}"
+    refused = ledger.get("refused") or {}
+    notes = [
+        f"  note: {base}: refused ({reason})"
+        for base, reason in sorted(refused.items())
+    ]
+    return "\n".join([head] + notes + rows)
+
+
+def _monotone_regression(
+    values: List[float], higher_better: bool,
+    min_step: float, min_total: float,
+) -> Optional[float]:
+    """Cumulative relative decline when every step in ``values`` moves
+    strictly in the bad direction past the noise floor and the total
+    decline exceeds ``min_total`` — else None."""
+    if len(values) < 2 or values[0] == 0.0:
+        return None
+    for prev, cur in zip(values, values[1:]):
+        if prev == 0.0:
+            return None
+        rel = (cur - prev) / abs(prev)
+        worse = rel < -min_step if higher_better else rel > min_step
+        if not worse:
+            return None
+    total = (values[-1] - values[0]) / abs(values[0])
+    magnitude = -total if higher_better else total
+    return magnitude if magnitude > min_total else None
+
+
+def gate(
+    ledger: dict, window: int = 3,
+    min_step: float = MIN_STEP, min_total: float = MIN_TOTAL,
+) -> Tuple[str, Dict[str, float], int]:
+    """The windowed regression gate: flag every direction-classified
+    metric whose last ``window`` points worsen monotonically with a
+    cumulative decline past ``min_total``. Returns (rendered summary,
+    {metric: cumulative decline}, exit code 0/1) — the CLI prints the
+    summary to stderr on failure, matching the bench gates' reasons-
+    to-stderr convention."""
+    flagged: Dict[str, float] = {}
+    gated = 0
+    for name in sorted(ledger.get("metrics") or {}):
+        m = ledger["metrics"][name]
+        if m.get("direction") not in ("higher", "lower"):
+            continue
+        points = m.get("points") or []
+        if len(points) < window:
+            continue
+        gated += 1
+        values = [p["value"] for p in points[-window:]]
+        decline = _monotone_regression(
+            values, m["direction"] == "higher", min_step, min_total
+        )
+        if decline is not None:
+            flagged[name] = round(decline, 4)
+    gauge(names.LEDGER_REGRESSIONS).set(len(flagged))
+    lines = [
+        f"perf gate: window {window}, {gated} gated metric(s) with "
+        f"enough history, {len(flagged)} regressing"
+    ]
+    for name, decline in sorted(flagged.items()):
+        points = ledger["metrics"][name]["points"][-window:]
+        trail = " -> ".join(f"{p['value']:g}" for p in points)
+        lines.append(
+            f"  REGRESSING {name}: {decline:+.1%} cumulative over "
+            f"{window} rounds ({trail}; "
+            f"{ledger['metrics'][name]['direction']}-is-better) — "
+            "monotone decline the pairwise diff cannot see"
+        )
+    return "\n".join(lines), flagged, (1 if flagged else 0)

@@ -113,6 +113,12 @@ SPAN_BENCH_SWEEP_AB = "sweep_ab"
 # managed jax.profiler device-trace capture (obs/devprof.py)
 SPAN_DEVICE_TRACE = "device_trace"
 
+#: one post-hoc critical-path attribution pass over a finished capture
+#: (obs/critpath.py analyze_capture) — offline-only by construction:
+#: the span exists so the analyzer's own cost is measured, proving the
+#: attribution layer adds zero hot-path time
+SPAN_CRITPATH_ANALYZE = "critpath_analyze"
+
 SPANS = frozenset({
     SPAN_FREEZE, SPAN_MAKE_IDEAL, SPAN_LOAD_PULSARS, SPAN_ORACLE_FIT,
     SPAN_READ_PAR, SPAN_READ_TIM, SPAN_DESIGN_TENSOR,
@@ -135,6 +141,7 @@ SPANS = frozenset({
     SPAN_BENCH_INGEST_B1855, SPAN_BENCH_AOT_COMPILE, SPAN_BENCH_WARMUP,
     SPAN_BENCH_MEASURE, SPAN_BENCH_SWEEP_AB,
     SPAN_DEVICE_TRACE,
+    SPAN_CRITPATH_ANALYZE,
 })
 
 # -------------------------------------------------------------- events
@@ -287,6 +294,19 @@ PROC_RSS_BYTES = "proc.rss_bytes"
 OCCUPANCY_DUTY_CYCLE = "occupancy.duty_cycle"
 OCCUPANCY_BUSY_S = "occupancy.busy_s"
 
+# critical-path attribution (obs/critpath.py): chunks the analyzer
+# attributed on the last pass, and how many mesh devices it flagged as
+# stragglers (busy time above the straggler threshold vs the median) —
+# gauges stamped by the offline analyze pass, never by a hot path
+CRITPATH_CHUNKS = "critpath.chunks"
+CRITPATH_STRAGGLERS = "critpath.stragglers"
+
+# cross-round performance ledger (obs/ledger.py): bench-artifact rounds
+# ingested into PERF_LEDGER.json, and gated metrics flagged by the
+# windowed monotone-regression gate on the last `perf gate` pass
+LEDGER_ROUNDS = "ledger.rounds"
+LEDGER_REGRESSIONS = "ledger.regressions"
+
 # jax accounting (obs/jaxhooks.py)
 JAX_COMPILES = "jax.compiles"
 JAX_COMPILE_S = "jax.compile_s"
@@ -321,6 +341,8 @@ METRICS = frozenset({
     FLIGHTREC_STALLS,
     OBS_OVERHEAD_S, PROC_RSS_BYTES,
     OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
+    CRITPATH_CHUNKS, CRITPATH_STRAGGLERS,
+    LEDGER_ROUNDS, LEDGER_REGRESSIONS,
     JAX_COMPILES, JAX_COMPILE_S, JAX_TRACES, JAX_TRACE_S, JAX_LOWERING_S,
     JAX_TRACE_COUNT,
 })
@@ -356,6 +378,8 @@ SCENARIO_PREFIX = "scenario."
 SLO_PREFIX = "slo."
 TRACE_PREFIX = "trace."
 OCCUPANCY_PREFIX = "occupancy."
+CRITPATH_PREFIX = "critpath."
+LEDGER_PREFIX = "ledger."
 OBS_PREFIX = "obs."
 PROC_PREFIX = "proc."
 

@@ -106,6 +106,11 @@ _HIGHER_BETTER_TOKENS = (
     # "efficiency" already matches; spelled out so the gate's contract
     # for the series is explicit
     "overlap_efficiency_e2e",
+    # CRITPATH series (benchmarks/critpath_attribution.py, PR 16): the
+    # share of the phase window the attribution engine could pin to a
+    # stage — falling coverage means the capture (or the analyzer) is
+    # losing sight of where wall time goes
+    "attributed_fraction",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves
@@ -139,7 +144,20 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
                         # the overlap the fused graph exists to buy
                         # ("stall_s"/"_wait_s" also ride the _s suffix;
                         # spelled out for the explicit-contract reason)
-                        "stall_s", "window_wait")
+                        "stall_s", "window_wait",
+                        # CRITPATH series (PR 16): the aggregate
+                        # critical-path length, the unattributed
+                        # blocked window time, and the mesh device-busy
+                        # spread are all costs. critical_path_s /
+                        # blocked_s also ride the _s suffix — spelled
+                        # out for the explicit-contract reason. The
+                        # straggler token is the FULL "straggler_ratio"
+                        # leaf, never bare "ratio": the stage-graph
+                        # series' wall_ratio_fused_vs_stacked must stay
+                        # an info row (its direction is the overlap
+                        # efficiency's job to score)
+                        "critical_path_s", "blocked_s",
+                        "straggler_ratio")
 #: leaf fragments that must classify lower-better BEFORE the
 #: higher-better token scan: burn_rate_* contains "rate" (a
 #: higher-better token) but a rising SLO burn rate is budget being

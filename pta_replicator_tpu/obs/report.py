@@ -55,7 +55,7 @@ def load_telemetry(directory: str) -> dict:
     out = {
         "directory": directory, "events": [], "metrics": None,
         "meta": None, "progress": None, "postmortem": None,
-        "series": None, "slo": None, "problems": [],
+        "series": None, "slo": None, "critpath": None, "problems": [],
     }
     if not os.path.isdir(directory):
         out["problems"].append(f"{directory}: not a directory")
@@ -77,6 +77,7 @@ def load_telemetry(directory: str) -> dict:
         ("progress", "progress.json"),
         ("postmortem", "postmortem.json"),
         ("slo", "slo.json"),
+        ("critpath", "critpath.json"),
     ):
         p = os.path.join(directory, fname)
         if not os.path.exists(p):
@@ -201,6 +202,16 @@ def render_report(
     agg = aggregate_spans(data["events"])
     metrics = data["metrics"] or {}
 
+    # a written critpath.json wins (it carries the analyzer's own
+    # overhead stamp); otherwise attribute from the events in hand so
+    # the report works on captures never run through `critpath DIR`
+    from . import critpath as _critpath
+
+    cp = data["critpath"]
+    if cp and cp.get("schema_version", 0) > _critpath.CRITPATH_SCHEMA_VERSION:
+        cp = None  # newer writer — re-derive from the events instead
+    cp = cp or _critpath.analyze(data["events"])
+
     if as_json:
         return json.dumps(
             {"spans": agg, "metrics": metrics, "meta": data["meta"],
@@ -208,6 +219,7 @@ def render_report(
              "postmortem": data["postmortem"],
              "series": data["series"],
              "slo": data["slo"],
+             "critpath": cp,
              "utilization": occupancy.analyze(data["events"]),
              "problems": data["problems"]},
             indent=1, sort_keys=True,
@@ -241,6 +253,10 @@ def render_report(
     if util:
         parts.append("")
         parts.append(render_utilization(util))
+
+    if cp:
+        parts.append("")
+        parts.append(_critpath.render_critpath(cp))
 
     if data["slo"]:
         section = render_slo(data["slo"])

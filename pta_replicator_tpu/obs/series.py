@@ -103,6 +103,13 @@ DEFAULT_PREFIXES: Tuple[str, ...] = (
     # evolution the series layer exists to sparkline
     names.SLO_PREFIX,
     names.TRACE_PREFIX,
+    # the attribution layer's own gauges (PR 16): chunks attributed /
+    # stragglers flagged per analyze pass and ledger rounds ingested /
+    # metrics regressing per gate pass — zero-cost in a run that never
+    # invokes the offline analyzers, a one-line health trail when a
+    # recovery loop reruns them
+    names.CRITPATH_PREFIX,
+    names.LEDGER_PREFIX,
 )
 
 

@@ -23,6 +23,9 @@ access to the box:
   a live-but-burning server should shed traffic, docs/tracing.md)
 * ``/slo``      — the SLO engine's full status (``slo.json``: per-
   objective error budget remaining + fast/slow burn rates)
+* ``/critpath`` — the critical-path attribution verdict
+  (``critpath.json``, written by ``critpath DIR`` / obs.critpath —
+  absent until an attribution pass has run over the capture)
 * ``/``         — a JSON index of the above
 
 Read-only by construction: GET/HEAD only, no path component of the URL
@@ -57,6 +60,8 @@ ROUTES = {
     "/postmortem.json": ("postmortem.json", "application/json"),
     "/slo": ("slo.json", "application/json"),
     "/slo.json": ("slo.json", "application/json"),
+    "/critpath": ("critpath.json", "application/json"),
+    "/critpath.json": ("critpath.json", "application/json"),
 }
 
 

@@ -29,6 +29,12 @@ clock offset. This module merges everything into ONE
   ``likelihood_batch`` span joins every trace named in its ``links``
   fan-in field — so one request's life renders as one arrow chain
   through the shared batch (docs/tracing.md).
+* **critical-path track** — one annotated ``critical path`` lane at
+  the top of the host process: a ``crit:<stage>`` slice for every
+  instant the attribution engine (obs/critpath.py) charges to that
+  stage (its *exclusive* critical intervals), plus the ranked verdict
+  as an instant marker at the window start — the timeline answer to
+  "what was the run actually waiting on, right here?".
 * **device trace events** — every trace dir registered in meta.json's
   ``device_traces`` is scanned for TensorBoard-format
   ``*.trace.json(.gz)`` files; their events are shifted onto the wall
@@ -59,6 +65,9 @@ from .report import load_telemetry
 _STAGE_TID_BASE = 1 << 22
 #: pid offset for merged device-trace processes: far above any real pid
 _DEVICE_PID_BASE = 1 << 21
+#: synthetic tid of the annotated critical-path track (one below the
+#: stage-track base so it can never collide with a real or stage tid)
+_CRITPATH_TID = _STAGE_TID_BASE - 1
 
 
 def _stage_order() -> List[str]:
@@ -201,6 +210,43 @@ def _host_events(events: List[dict], pid: int) -> Tuple[list, list]:
     return meta + out, flows
 
 
+def _critpath_track(
+    events: List[dict], critpath_doc: Optional[dict], pid: int
+) -> List[dict]:
+    """The annotated ``critical path`` track: one slice per exclusive
+    critical interval (``crit:<stage>`` — the instants the attribution
+    engine charges to that stage), plus the ranked verdict as a global
+    instant marker at the window start. Scrubbing the merged view, the
+    track reads as 'what the run was actually waiting on, instant by
+    instant'. Empty when no stage spans exist."""
+    from . import critpath
+
+    window, exclusive = critpath.critical_intervals(events)
+    if window is None or not any(exclusive.values()):
+        return []
+    out: List[dict] = [
+        {"name": "thread_name", "ph": "M", "pid": pid,
+         "tid": _CRITPATH_TID, "args": {"name": "critical path"}},
+        {"name": "thread_sort_index", "ph": "M", "pid": pid,
+         "tid": _CRITPATH_TID, "args": {"sort_index": -1}},
+    ]
+    for stage, intervals in sorted(exclusive.items()):
+        for t0, t1 in intervals:
+            out.append({
+                "name": f"crit:{stage}", "cat": "critpath", "ph": "X",
+                "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                "pid": pid, "tid": _CRITPATH_TID,
+                "args": {"stage": stage},
+            })
+    summary = ((critpath_doc or {}).get("verdict") or {}).get("summary")
+    if summary:
+        out.append({
+            "name": summary, "cat": "critpath", "ph": "i", "s": "t",
+            "ts": window[0] * 1e6, "pid": pid, "tid": _CRITPATH_TID,
+        })
+    return out
+
+
 def _correlation_markers(events: List[dict]) -> Dict[str, float]:
     """logdir -> wall-clock open instant, from the ``device_trace``
     span attrs (falling back to the span's own t0 for captures from
@@ -314,6 +360,9 @@ def build_timeline(directory: str) -> dict:
     host, flows = _host_events(events, pid)
     merged = host + flows
 
+    crit = _critpath_track(events, data.get("critpath"), pid)
+    merged.extend(crit)
+
     markers = _correlation_markers(events)
     n_device = 0
     trace_dirs = meta.get("device_traces") or []
@@ -351,6 +400,9 @@ def build_timeline(directory: str) -> dict:
             ),
             "device_events": n_device,
             "device_traces": len(trace_dirs),
+            "critpath_slices": sum(
+                1 for e in crit if e.get("ph") == "X"
+            ),
             "problems": problems,
         },
     }

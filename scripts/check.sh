@@ -28,7 +28,7 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_obs.py tests/test_flightrec.py tests/test_occupancy.py \
     tests/test_series.py tests/test_timeline_serve.py \
     tests/test_analysis.py tests/test_pipeline.py tests/test_faults.py \
-    tests/test_trace_slo.py tests/test_stages.py
+    tests/test_trace_slo.py tests/test_stages.py tests/test_critpath.py
 
 echo "== scenario fuzz (fast arm: batched vs oracle differential) =="
 # 8 generated scenarios at a fixed seed through the batched-vs-oracle
@@ -75,5 +75,24 @@ echo "== request-trace + SLO gate (fast arm) =="
 # of the step (exit 1 with reasons on stderr). Seconds-scale,
 # fixture-free, CPU-only (docs/tracing.md).
 JAX_PLATFORMS=cpu python benchmarks/request_trace.py --fast > /dev/null
+
+echo "== critical-path attribution gate (fast arm) =="
+# the fast arm of benchmarks/critpath_attribution.py: the offline
+# attribution pass over both stage-graph arms must name the same
+# bottleneck as the occupancy busy table, attribute >= 95% of the
+# phase window, reconstruct trace-coherent per-chunk chains, and leak
+# zero analyzer spans into the captures (exit 1, reasons to stderr).
+# Seconds-scale, fixture-free, CPU-only (docs/observability.md
+# "Attributing a run").
+JAX_PLATFORMS=cpu python benchmarks/critpath_attribution.py --fast \
+    > /dev/null
+
+echo "== performance ledger gate (windowed regression) =="
+# obs/ledger.py over the committed round artifacts: any direction-
+# classified metric worsening MONOTONICALLY across the last 3 rounds
+# past the cumulative threshold fails (exit 1, reasons to stderr) —
+# the slow leak the pairwise bench-diff cannot see
+# (docs/observability.md "The performance ledger").
+JAX_PLATFORMS=cpu python -m pta_replicator_tpu perf gate --window 3
 
 echo "check.sh: all gates green"

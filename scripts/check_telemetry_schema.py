@@ -390,6 +390,139 @@ def validate_slo_file(path: str) -> list:
     return problems
 
 
+def validate_critpath_file(path: str) -> list:
+    """Validate a ``critpath.json`` attribution artifact (obs/critpath
+    ``analyze`` shape): a schema stamp no newer than this tree's
+    analyzer, the window/decomposition numbers, per-stage entries with
+    busy/critical seconds, and a ranked verdict naming stages that
+    exist in the stages table."""
+    from pta_replicator_tpu.obs.critpath import CRITPATH_SCHEMA_VERSION
+
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except json.JSONDecodeError as exc:
+        return [f"{path}: unparseable JSON ({exc})"]
+    if not isinstance(doc, dict):
+        return [f"{path}: not a JSON object"]
+    problems = []
+    version = doc.get("schema_version")
+    if not isinstance(version, int) or isinstance(version, bool):
+        return [f"{path}: schema_version missing or not an int"]
+    if version > CRITPATH_SCHEMA_VERSION:
+        return [
+            f"{path}: schema_version {version} newer than this tree's "
+            f"analyzer ({CRITPATH_SCHEMA_VERSION}) — refusing to "
+            "misread a future artifact"
+        ]
+    for field in ("critical_path_s", "blocked_s", "attributed_fraction"):
+        val = doc.get(field)
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            problems.append(f"{path}: {field} not numeric")
+    stages = doc.get("stages")
+    if not isinstance(stages, dict):
+        return problems + [f"{path}: stages is not an object"]
+    for name, st in stages.items():
+        if not isinstance(st, dict):
+            problems.append(f"{path}: stage {name!r} not an object")
+            continue
+        for field in ("busy_s", "critical_s", "critical_share"):
+            val = st.get(field)
+            if not isinstance(val, (int, float)) or isinstance(
+                val, bool
+            ):
+                problems.append(
+                    f"{path}: stage {name!r}.{field} not numeric"
+                )
+    verdict = doc.get("verdict")
+    if not isinstance(verdict, dict) or not isinstance(
+        verdict.get("ranked"), list
+    ) or not isinstance(verdict.get("summary"), str):
+        problems.append(
+            f"{path}: verdict must carry a ranked list and a summary "
+            "string"
+        )
+    else:
+        for i, entry in enumerate(verdict["ranked"]):
+            if not isinstance(entry, dict) or entry.get(
+                "stage"
+            ) not in stages:
+                problems.append(
+                    f"{path}: verdict.ranked[{i}] does not name a "
+                    "stage from the stages table"
+                )
+                break
+    return problems
+
+
+def validate_ledger_file(path: str) -> list:
+    """Validate a ``PERF_LEDGER.json`` artifact (obs/ledger
+    ``build_ledger`` shape): schema stamp no newer than this tree's,
+    every metric carries a direction class the regression engine
+    knows, and every point cites its source round/file."""
+    from pta_replicator_tpu.obs.ledger import (
+        DIRECTION_CLASSES,
+        LEDGER_SCHEMA_VERSION,
+    )
+
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except json.JSONDecodeError as exc:
+        return [f"{path}: unparseable JSON ({exc})"]
+    if not isinstance(doc, dict):
+        return [f"{path}: not a JSON object"]
+    version = doc.get("schema_version")
+    if not isinstance(version, int) or isinstance(version, bool):
+        return [f"{path}: schema_version missing or not an int"]
+    if version > LEDGER_SCHEMA_VERSION:
+        return [
+            f"{path}: schema_version {version} newer than this tree's "
+            f"ledger ({LEDGER_SCHEMA_VERSION}) — refusing to misread "
+            "a future artifact"
+        ]
+    problems = []
+    metrics = doc.get("metrics")
+    if not isinstance(metrics, dict):
+        return [f"{path}: metrics is not an object"]
+    for name, entry in metrics.items():
+        if not isinstance(entry, dict):
+            problems.append(f"{path}: metric {name!r} not an object")
+            continue
+        if entry.get("direction") not in DIRECTION_CLASSES:
+            problems.append(
+                f"{path}: metric {name!r} direction "
+                f"{entry.get('direction')!r} not one of "
+                f"{DIRECTION_CLASSES} (regress.py's classes)"
+            )
+        points = entry.get("points")
+        if not isinstance(points, list) or not points:
+            problems.append(
+                f"{path}: metric {name!r} has no points list"
+            )
+            continue
+        for pt in points:
+            if (
+                not isinstance(pt, dict)
+                or not isinstance(pt.get("round"), int)
+                or isinstance(pt.get("round"), bool)
+                or not isinstance(pt.get("file"), str)
+                or not isinstance(pt.get("value"), (int, float))
+                or isinstance(pt.get("value"), bool)
+            ):
+                problems.append(
+                    f"{path}: metric {name!r} point {pt!r} must "
+                    "carry round/file/value provenance"
+                )
+                break
+    if not isinstance(doc.get("refused"), dict):
+        problems.append(
+            f"{path}: refused must be an object (named refusals, even "
+            "when empty)"
+        )
+    return problems
+
+
 def validate_device_traces(directory: str) -> list:
     """A capture's meta.json may register managed jax.profiler trace
     dirs (obs.devprof.device_trace). Each registered path — relative
@@ -458,6 +591,12 @@ def main(argv=None) -> int:
             slo_path = os.path.join(target, "slo.json")
             if os.path.exists(slo_path):
                 problems += validate_slo_file(slo_path)
+            critpath_path = os.path.join(target, "critpath.json")
+            if os.path.exists(critpath_path):
+                problems += validate_critpath_file(critpath_path)
+            ledger_path = os.path.join(target, "PERF_LEDGER.json")
+            if os.path.exists(ledger_path):
+                problems += validate_ledger_file(ledger_path)
             problems += validate_device_traces(target)
             target = os.path.join(target, "events.jsonl")
         problems += validate_events(target)
@@ -471,6 +610,11 @@ def main(argv=None) -> int:
             series_path = os.path.join(d, "series.jsonl")
             if os.path.exists(series_path):
                 problems += validate_series_file(series_path)
+        # the committed cross-round ledger, when present, must keep
+        # validating against the live tree's schema + direction classes
+        repo_ledger = os.path.join(REPO, "PERF_LEDGER.json")
+        if os.path.exists(repo_ledger):
+            problems += validate_ledger_file(repo_ledger)
 
     if problems:
         for p in problems:

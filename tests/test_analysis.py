@@ -1244,3 +1244,156 @@ def test_adhoc_stage_clean_on_real_tree():
     assert problems == []
     assert active == [], [f.format() for f in active]
     assert rule_ids(suppressed) == ["parallel-adhoc-stage"]
+
+
+# -------------------------------------------------- bench-silent-gate
+
+def test_bench_silent_gate_fires_on_reasonless_exits(tmp_path):
+    """bench-silent-gate: every gate-failure exit shape — sys.exit of
+    a nonzero constant, raise SystemExit(nonzero), and return <int>
+    from a main/run* arm — fires when no stderr reason precedes it on
+    the path (CI goes red with an empty log)."""
+    from pta_replicator_tpu.analysis import rules_bench
+
+    src = """
+        import sys
+
+        def main():
+            ok = compute()
+            if not ok:
+                return 1
+            if sys.argv[1] == "hard":
+                sys.exit(3)
+            raise SystemExit(2)
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"benchmarks/silent.py": src},
+        [rules_bench.SilentGate()],
+    )
+    assert rule_ids(findings) == ["bench-silent-gate"] * 3
+    assert "stderr" in findings[0].message
+
+
+def test_bench_silent_gate_non_firing_shapes(tmp_path):
+    """Non-firing: the repo's GATE FAIL idiom (direct print, the
+    loop-of-reasons, the local log helper), intrinsic-reason exits
+    (sys.exit("msg") prints itself), success exits, non-constant
+    dispatch codes, int returns outside main/run*, and — the inverted
+    scope — package modules, where nonzero returns are ordinary."""
+    from pta_replicator_tpu.analysis import rules_bench
+
+    idiom = """
+        import sys
+
+        def run_arm(x):
+            if x < 0:
+                print(f"arm GATE FAIL: negative {x}", file=sys.stderr)
+                return 1
+            return 0
+
+        def main():
+            failures = check()
+            if failures:
+                for f in failures:
+                    print(f"b GATE FAIL: {f}", file=sys.stderr)
+                return 1
+            return 0
+
+        sys.exit(main())
+    """
+    helper = """
+        import sys
+
+        def log(msg):
+            print(msg, file=sys.stderr, flush=True)
+
+        def main():
+            if bad():
+                log("bench GATE FAIL: drift")
+                sys.exit(6)
+    """
+    intrinsic = """
+        import sys
+
+        def main():
+            if bad():
+                sys.exit("bench GATE FAIL: the interpreter prints me")
+            sys.exit(0)
+    """
+    not_exit_code = """
+        def weight():
+            return 1
+
+        def depth_of(tree):
+            if tree is None:
+                return 1
+            return 2
+    """
+    in_package = """
+        import sys
+
+        def main():
+            return 1
+    """
+    findings, _ = lint_tree(
+        tmp_path,
+        {
+            "benchmarks/idiom.py": idiom,
+            "benchmarks/helper.py": helper,
+            "benchmarks/intrinsic.py": intrinsic,
+            "benchmarks/values.py": not_exit_code,
+            "pta_replicator_tpu/obs/rc.py": in_package,
+        },
+        [rules_bench.SilentGate()],
+    )
+    assert findings == []
+
+
+def test_bench_silent_gate_suppression_and_path_sensitivity(tmp_path):
+    """The escape hatch (imported logging helper the AST cannot see)
+    suppresses with an inline reason; a reason printed only in the
+    OTHER arm of the branch does not cover the silent one."""
+    from pta_replicator_tpu.analysis import rules_bench
+
+    suppressed_src = """
+        import sys
+        from shared_bench_util import announce_failure
+
+        def main():
+            if bad():
+                announce_failure("drift")
+                sys.exit(5)  # graftlint: disable=bench-silent-gate — announce_failure writes the reason to stderr from shared_bench_util
+    """
+    wrong_arm = """
+        import sys
+
+        def main():
+            if ok():
+                print("all good", file=sys.stderr)
+            else:
+                return 1
+    """
+    findings, suppressed = lint_tree(
+        tmp_path,
+        {
+            "benchmarks/supp.py": suppressed_src,
+            "benchmarks/wrongarm.py": wrong_arm,
+        },
+        [rules_bench.SilentGate()],
+    )
+    assert rule_ids(findings) == ["bench-silent-gate"]
+    assert findings[0].path.endswith("wrongarm.py")
+    assert rule_ids(suppressed) == ["bench-silent-gate"]
+
+
+def test_bench_silent_gate_clean_on_real_tree():
+    """Every shipped benchmark prints its gate reasons to stderr
+    before exiting nonzero — empty baseline delta."""
+    from pta_replicator_tpu.analysis import rules_bench
+
+    bench = os.path.join(REPO, "benchmarks")
+    files = engine.iter_python_files([bench], str(REPO))
+    mods, problems = engine.parse_modules(files, str(REPO))
+    active, _ = engine.run_rules(mods, [rules_bench.SilentGate()])
+    assert problems == []
+    assert active == [], [f.format() for f in active]

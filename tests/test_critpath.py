@@ -1,0 +1,350 @@
+"""Critical-path attribution (obs/critpath.py) + cross-round
+performance ledger (obs/ledger.py).
+
+The acceptance path is a REAL pipelined mesh sweep (depth 2, the
+conftest-forced 8-virtual-device CPU mesh): the analyzer reconstructs
+the per-chunk span DAG from the capture, the decomposition closes
+(critical + blocked == wall), and the verdict agrees with the
+occupancy duty table. Everything synthetic (stragglers, stability,
+ledger refusals, the windowed gate) is deterministic by construction.
+"""
+import importlib.util
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pta_replicator_tpu import obs
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.obs import critpath, ledger, names, occupancy, regress
+from pta_replicator_tpu.obs.serve import serve_directory, serve_url
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset_all()
+    yield
+    obs.reset_all()
+
+
+def _schema_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_telemetry_schema",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "check_telemetry_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    return checker
+
+
+def _span(name, t0, wall, **attrs):
+    rec = {"type": "span", "name": name, "path": name, "t0": t0,
+           "wall_s": wall, "cpu_s": wall, "tid": 1, "seq": 0}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+def _chunked_schedule():
+    """A hand-built two-chunk pipeline schedule with known answers:
+    chunk 0 dispatch [0,1) drain [1,3) io [3,4); chunk 1 admitted at
+    t=5 (1s blocked-on-window after chunk 0's dispatch ended at 1?
+    no — after its own predecessors: admissions end 1, start 5 -> 4s),
+    with a 0.5s queue-wait before its drain. drain is the aggregate
+    bottleneck."""
+    phase = _span(names.SPAN_SWEEP_PIPELINE, 0.0, 10.0)
+    return [
+        phase,
+        _span(names.SPAN_DISPATCH, 0.0, 1.0, chunk=0),
+        _span(names.SPAN_DRAIN, 1.0, 2.0, chunk=0),
+        _span(names.SPAN_IO_WRITE, 3.0, 1.0, chunk=0),
+        _span(names.SPAN_DISPATCH, 5.0, 1.0, chunk=1),
+        _span(names.SPAN_DRAIN, 6.5, 2.5, chunk=1),
+        _span(names.SPAN_IO_WRITE, 9.0, 1.0, chunk=1),
+    ]
+
+
+# ------------------------------------------------- real-capture DAG
+
+
+def _mesh_sweep_capture(tmp_path) -> str:
+    """A small but REAL pipelined mesh sweep (depth 2, 4x2 mesh over
+    the conftest-forced 8 virtual CPU devices), captured."""
+    from pta_replicator_tpu.parallel import make_mesh
+    from pta_replicator_tpu.utils.sweep import sweep
+
+    assert jax.device_count() >= 8, "conftest must force 8 host devices"
+    d = str(tmp_path / "cap")
+    b = synthetic_batch(npsr=4, ntoa=64, nbackend=2, seed=2)
+    recipe = Recipe(efac=jnp.full((4, 2), 1.1))
+    obs.start_capture(d, heartbeat_interval_s=0.1, stall_timeout_s=None)
+    try:
+        sweep(jax.random.PRNGKey(5), b, recipe, nreal=16, chunk=8,
+              checkpoint_path=str(tmp_path / "ck.npz"),
+              mesh=make_mesh(4, 2), pipeline_depth=2)
+    finally:
+        obs.finish_capture()
+    return d
+
+
+def test_dag_reconstruction_from_real_mesh_capture(tmp_path):
+    """ISSUE 16 acceptance: the analyzer reconstructs the per-chunk
+    DAG from a real depth-2 mesh capture — every chunk's chain is
+    trace-coherent, the decomposition closes, and the verdict names
+    the same bottleneck as the occupancy busy table (the >=95%
+    attribution bound is asserted on the bench's bigger workload; a
+    tiny sweep still must attribute most of the window)."""
+    d = _mesh_sweep_capture(tmp_path)
+    doc = critpath.analyze_capture(d)
+    assert doc is not None
+    assert doc["schema_version"] == critpath.CRITPATH_SCHEMA_VERSION
+
+    stages = doc["stages"]
+    assert {names.SPAN_DISPATCH, names.SPAN_DRAIN,
+            names.SPAN_IO_WRITE} <= set(stages)
+
+    # per-chunk DAG: 16 realizations / chunk 8 = 2 chains, each
+    # stamped with ONE deterministic chunk trace id end to end
+    chunks = doc["chunks"]
+    assert chunks["count"] == 2
+    assert chunks["trace_coherent_fraction"] == 1.0
+
+    # the decomposition closes: exclusive contributions + blocked
+    # time tile the window exactly
+    wall = doc["window"]["wall_s"]
+    critical = sum(s["critical_s"] for s in stages.values())
+    assert critical == pytest.approx(doc["critical_path_s"], abs=1e-5)
+    assert doc["critical_path_s"] + doc["blocked_s"] == pytest.approx(
+        wall, abs=1e-5
+    )
+    assert 0.0 < doc["attributed_fraction"] <= 1.0
+
+    # verdict consistency with occupancy: the top-ranked stage IS the
+    # busiest stage of the duty table (greedy rank order), and its
+    # exclusive critical time equals its in-window busy time
+    verdict = doc["verdict"]
+    busiest = max(stages, key=lambda s: stages[s]["busy_s"])
+    assert verdict["bottleneck"] == busiest
+    assert stages[busiest]["critical_s"] == pytest.approx(
+        stages[busiest]["busy_s"], abs=1e-6
+    )
+    assert verdict["ranked"][0]["stage"] == busiest
+    assert verdict["est_savings_s"] == stages[busiest]["critical_s"]
+    assert occupancy.STAGES[busiest] in verdict["summary"]
+
+    # offline-only: the capture itself carries no analyzer spans
+    events = [json.loads(line) for line in
+              open(os.path.join(d, "events.jsonl"))]
+    assert not any(
+        e.get("name") == names.SPAN_CRITPATH_ANALYZE for e in events
+    )
+    assert doc["analyzer"]["overhead_s"] >= 0.0
+
+    # artifact: written atomically, schema-valid
+    path = critpath.write_critpath(d, doc=doc)
+    assert path == os.path.join(d, "critpath.json")
+    assert _schema_checker().validate_critpath_file(path) == []
+
+
+# ---------------------------------------------- synthetic semantics
+
+
+def test_straggler_detection_on_skewed_device_schedule():
+    """A skewed per-device schedule (one device 1.6x the median busy)
+    is named a straggler; a balanced one is not."""
+    phase = _span(names.SPAN_SWEEP_PIPELINE, 0.0, 4.0)
+    skewed = [phase] + [
+        _span(names.SPAN_CW_STREAM_STAGE, i * 0.1, busy, device=dev)
+        for i, (dev, busy) in enumerate(
+            [("d0", 1.0), ("d1", 1.0), ("d2", 1.0), ("d3", 1.6)]
+        )
+    ]
+    doc = critpath.analyze(skewed)
+    dev = doc["devices"]
+    assert dev["count"] == 4
+    assert dev["straggler_ratio"] == pytest.approx(1.6)
+    assert dev["stragglers"] == ["d3"]
+
+    balanced = [phase] + [
+        _span(names.SPAN_CW_STREAM_STAGE, i * 0.1, 1.0, device=f"d{i}")
+        for i in range(4)
+    ]
+    dev = critpath.analyze(balanced)["devices"]
+    assert dev["straggler_ratio"] == pytest.approx(1.0)
+    assert dev["stragglers"] == []
+
+
+def test_chunk_chain_queue_wait_and_window_blocking():
+    """The hand-built schedule's known answers: 0.5s queue-wait before
+    chunk 1's drain, 4s blocked-on-window between admissions, drain
+    the bottleneck of both chunks."""
+    doc = critpath.analyze(_chunked_schedule())
+    chunks = doc["chunks"]
+    assert chunks["count"] == 2
+    assert chunks["queue_wait_s"] == {names.SPAN_DRAIN: 0.5}
+    assert chunks["blocked_on_window_s"] == pytest.approx(4.0)
+    assert chunks["bottleneck_fraction"] == {names.SPAN_DRAIN: 1.0}
+    assert doc["verdict"]["bottleneck"] == names.SPAN_DRAIN
+    # drain busy 4.5s of the 10s window, all exclusive (ranked first)
+    assert doc["stages"][names.SPAN_DRAIN]["critical_s"] == (
+        pytest.approx(4.5)
+    )
+
+
+def test_verdict_stable_across_byte_identical_reruns():
+    """Same events in -> byte-identical attribution out, regardless of
+    record order (the analyzer must be a pure function of the capture,
+    or cross-round verdict comparisons are meaningless)."""
+    events = _chunked_schedule()
+    a = json.dumps(critpath.analyze(events), sort_keys=True)
+    b = json.dumps(critpath.analyze(events), sort_keys=True)
+    c = json.dumps(
+        critpath.analyze(list(reversed(events))), sort_keys=True
+    )
+    assert a == b == c
+    assert "render" not in a  # sanity: it's the doc, not the text
+    assert critpath.render_critpath(json.loads(a)) == (
+        critpath.render_critpath(json.loads(b))
+    )
+
+
+# ------------------------------------------------------- the ledger
+
+
+def _plant(root, fname, doc):
+    path = os.path.join(root, fname)
+    with open(path, "w") as fh:
+        if isinstance(doc, str):
+            fh.write(doc)
+        else:
+            json.dump(doc, fh)
+    return path
+
+
+def test_ledger_refuses_malformed_and_newer_artifacts(tmp_path):
+    """Ingest never raises: a malformed artifact, a newer-schema one,
+    and an empty round each degrade to a NAMED refusal with the
+    reason; the good rounds still land as metric points."""
+    root = str(tmp_path)
+    _plant(root, "GOOD_r01.json", {"schema_version": 2, "value": 100.0})
+    _plant(root, "GOOD_r02.json", {"schema_version": 2, "value": 104.0})
+    _plant(root, "BROKEN_r02.json", "{not json at all")
+    _plant(root, "FUTURE_r03.json", {"schema_version": 99, "value": 1.0})
+    _plant(root, "EMPTY_r04.json", {"schema_version": 2})
+    _plant(root, "notes.json", {"ignored": True})  # no round stamp
+
+    led = ledger.build_ledger(root)
+    assert led["schema_version"] == ledger.LEDGER_SCHEMA_VERSION
+    assert led["rounds"] == 2
+    assert set(led["refused"]) == {
+        "BROKEN_r02.json", "FUTURE_r03.json", "EMPTY_r04.json"
+    }
+    assert "unreadable" in led["refused"]["BROKEN_r02.json"]
+    assert "schema_version newer" in led["refused"]["FUTURE_r03.json"]
+    assert "no measurements" in led["refused"]["EMPTY_r04.json"]
+
+    m = led["metrics"]["good.value"]
+    assert m["direction"] == "higher"
+    assert [p["value"] for p in m["points"]] == [100.0, 104.0]
+    assert [p["file"] for p in m["points"]] == [
+        "GOOD_r01.json", "GOOD_r02.json"
+    ]
+    # every direction class the ledger emits is one regress.py knows
+    assert {e["direction"] for e in led["metrics"].values()} <= set(
+        ledger.DIRECTION_CLASSES
+    )
+
+    # round trip + schema validation + future-ledger refusal
+    out = ledger.write_ledger(root, ledger=led)
+    assert ledger.load_ledger(out) == led
+    assert _schema_checker().validate_ledger_file(out) == []
+    # the refusals surface in the trend view, not as tracebacks
+    trend = ledger.render_trend(led, min_points=1)
+    assert "BROKEN_r02.json: refused" in trend
+    future = _plant(root, "LEDGER_FUTURE.json",
+                    {"schema_version": 99, "metrics": {}})
+    with pytest.raises(regress.SchemaMismatch):
+        ledger.load_ledger(future)
+
+
+def test_windowed_gate_catches_monotone_leak_pairwise_misses(tmp_path):
+    """The planted 3-round leak: value decays ~4% per round — every
+    PAIRWISE diff is under the 10% bench-diff threshold ('ok'), but
+    the windowed gate sees the monotone trajectory and fails."""
+    root = str(tmp_path)
+    values = [100.0, 96.0, 92.2]
+    for i, v in enumerate(values, 1):
+        _plant(root, f"FAKE_r{i:02d}.json",
+               {"schema_version": 2, "value": v})
+    # a non-monotone neighbor must NOT trip the gate (one recovery
+    # round breaks the trajectory)
+    for i, v in enumerate([100.0, 96.0, 97.0], 1):
+        _plant(root, f"NOISY_r{i:02d}.json",
+               {"schema_version": 2, "value": v})
+
+    # every adjacent pair is invisible to the pairwise classifier
+    for old, new in zip(values, values[1:]):
+        verdict, _rel = regress.classify(old, new, True, threshold=0.10)
+        assert verdict == "ok"
+
+    led = ledger.build_ledger(root)
+    summary, flagged, rc = ledger.gate(led, window=3)
+    assert rc == 1
+    assert set(flagged) == {"fake.value"}
+    assert flagged["fake.value"] == pytest.approx(0.078, abs=1e-3)
+    assert "REGRESSING fake.value" in summary
+    assert "pairwise diff cannot see" in summary
+
+    # too little history -> nothing gated, gate passes
+    _summary, flagged4, rc4 = ledger.gate(led, window=4)
+    assert rc4 == 0 and flagged4 == {}
+
+
+# ------------------------------------------- route + report round trip
+
+
+def test_critpath_route_and_report_round_trip(tmp_path):
+    """`/critpath` serves the written artifact byte-for-byte; the
+    report renders the attribution section from it (and recomputes
+    from events when the artifact is absent)."""
+    d = str(tmp_path / "cap")
+    os.makedirs(d)
+    with open(os.path.join(d, "events.jsonl"), "w") as fh:
+        for rec in _chunked_schedule():
+            fh.write(json.dumps(rec) + "\n")
+
+    from pta_replicator_tpu.obs.report import render_report
+
+    # no artifact yet: the report recomputes the attribution inline
+    out = render_report(d)
+    assert "critical path (attribution over the phase window):" in out
+    assert "verdict:" in out and names.SPAN_DRAIN in out
+
+    path = critpath.write_critpath(d)
+    assert path is not None
+    doc = json.load(open(path))
+
+    as_json = json.loads(render_report(d, as_json=True))
+    assert as_json["critpath"]["verdict"]["bottleneck"] == (
+        names.SPAN_DRAIN
+    )
+
+    server = serve_directory(d, 0, background=True)
+    try:
+        with urllib.request.urlopen(
+            serve_url(server, "/critpath"), timeout=5
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == "application/json"
+            assert json.loads(resp.read()) == doc
+        with urllib.request.urlopen(
+            serve_url(server, "/"), timeout=5
+        ) as resp:
+            assert "/critpath" in json.loads(resp.read())["endpoints"]
+    finally:
+        server.shutdown()
