@@ -49,6 +49,25 @@ Occupancy: each full-step arm runs under the obs tracer and embeds the
 ("where does the gap go: H2D, readback, or write"), PR 6's attribution
 machinery pointed at the multi-chip path.
 
+Fused mesh arms (r17): every device count also runs the sweep as ONE
+fused stage graph (``fused_stream=True`` + mesh — host tile build,
+per-device H2D, sharded compute, per-shard D2H, and PARALLEL per-shard
+durable writers in a single overlapped graph). Per fused arm the
+critical-path attribution (obs.critpath) records
+``io_write_exclusive_share`` — the exclusive-shadow seconds io_write
+holds on the critical path as a fraction of wall (the r06 baseline
+pinned io_write at 83% busy; the parallel writers + fused overlap must
+pull its exclusive share well below that) — and
+``shard_writer_occupancy``, the mean number of concurrently-busy shard
+writers (sum of shard_write span seconds / io_write busy seconds;
+1.0 = serial writes, >1 = genuinely overlapped pwrite+fsync).
+
+Fused identity evidence: at >= 2 mesh shapes the fused mesh sweep's
+consolidated npz is byte-equal to the stacked mesh sweep AND the
+single-chip pipelined sweep, and a fused sweep killed mid-run under
+one mesh shape resumes FUSED under a different shape to the same
+bytes (the preemption + retopology story, end to end).
+
 Prints one JSON line. Knobs: MULTICHIP_NREAL (2048), MULTICHIP_CHUNK
 (512), MULTICHIP_NPSR (8), MULTICHIP_NTOA (4096), MULTICHIP_NMODES
 (100), MULTICHIP_DEVICES ("1,2,4,8"), MULTICHIP_NREP (3). The default
@@ -56,6 +75,11 @@ chunk is deliberately large: the multi-device execution overhead of
 the virtual-CPU backend is a fixed per-dispatch cost (~0.15 s/chunk at
 8 devices on the 2-core host), so small chunks measure dispatch amortization,
 not the sharded pipeline.
+
+``--fast`` runs the seconds-scale CI arm (scripts/check.sh): 8 virtual
+CPU devices, a 2-chunk fused mesh sweep, the multi-shape byte-identity
++ crash-resume gates, and the writer-overlap gate
+(``shard_writer_occupancy > 1``) — exit 1 with reasons on stderr.
 """
 import json
 import os
@@ -84,6 +108,7 @@ import jax.numpy as jnp  # noqa: E402
 from pta_replicator_tpu import obs  # noqa: E402
 from pta_replicator_tpu.batch import synthetic_batch  # noqa: E402
 from pta_replicator_tpu.models.batched import Recipe, realize  # noqa: E402
+from pta_replicator_tpu.obs import critpath, names, occupancy  # noqa: E402
 from pta_replicator_tpu.parallel.mesh import (  # noqa: E402
     make_mesh,
     sharded_realize,
@@ -128,11 +153,12 @@ def _compute_arm(n_dev, key, batch, recipe, nreal, chunk, nrep):
     return best
 
 
-def _full_step_arm(n_dev, key, batch, recipe, nreal, chunk, workdir):
+def _full_step_arm(n_dev, key, batch, recipe, nreal, chunk, workdir,
+                   fused=False):
     """The complete flagship step: pipelined sweep with full residual
     cubes, per-shard readback, and sharded checkpoints, under the obs
-    tracer. Returns (wall_s, occupancy, result, consolidated sha or
-    bytes path)."""
+    tracer — stacked (default) or as the ONE fused stage graph (r17).
+    Returns (wall_s, occupancy, result, captured span events)."""
     mesh = make_mesh(n_dev, 1) if n_dev > 1 else None
     arm_dir = tempfile.mkdtemp(prefix=f"mc_d{n_dev}_", dir=workdir)
     ck = os.path.join(arm_dir, "sweep.npz")
@@ -140,14 +166,46 @@ def _full_step_arm(n_dev, key, batch, recipe, nreal, chunk, workdir):
     t0 = time.perf_counter()
     out = sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
                 checkpoint_path=ck, reduce_fn=None, mesh=mesh,
-                pipeline_depth=2, durable=True)
+                pipeline_depth=2, durable=True, fused_stream=fused)
     wall = time.perf_counter() - t0
+    events = obs.TRACER.events()
     if obs.TRACER.dropped:
         occ = {"skipped": f"{obs.TRACER.dropped} span records dropped"}
     else:
-        occ = obs.occupancy.analyze(obs.TRACER.events())
+        occ = obs.occupancy.analyze(events)
     shutil.rmtree(arm_dir, ignore_errors=True)
-    return wall, occ, out
+    return wall, occ, out, events
+
+
+def _writer_stats(events):
+    """(io_write_exclusive_share, shard_writer_occupancy, verdict
+    summary) from a fused arm's capture.
+
+    ``io_write_exclusive_share`` is critpath's exclusive-shadow
+    attribution for io_write over the phase window (seconds only
+    io_write was the busiest active stage, / wall) — the honest
+    "is the step write-bound?" number, immune to the double-counting
+    a raw duty figure carries once writes overlap compute.
+    ``shard_writer_occupancy`` is sum(shard_write span wall) / the
+    busy seconds of the shard_write spans' union: the mean number of
+    concurrently-busy per-shard writers while ANY writer is busy
+    (1.0 = strictly serial writes, N = all N writers overlapped)."""
+    spans = [e for e in events if e.get("type") == "span"]
+    doc = critpath.analyze(spans)
+    share = None
+    if doc:
+        st = (doc.get("stages") or {}).get(names.SPAN_IO_WRITE)
+        share = None if st is None else st["critical_share"]
+    shard_iv = occupancy.stage_intervals(
+        spans, stages=[names.SPAN_SHARD_WRITE]
+    ).get(names.SPAN_SHARD_WRITE, [])
+    shard_sum = sum(t1 - t0 for t0, t1 in shard_iv)
+    shard_union = occupancy.busy_seconds(
+        occupancy.merge_intervals(shard_iv))
+    writers = (round(shard_sum / shard_union, 3)
+               if shard_union > 0.0 else None)
+    verdict = ((doc or {}).get("verdict") or {}).get("summary")
+    return share, writers, verdict
 
 
 def _bit_identity_check(key, npsr, ntoa, workdir, n_dev):
@@ -179,15 +237,84 @@ def _bit_identity_check(key, npsr, ntoa, workdir, n_dev):
     return same_bytes and same_values
 
 
-def main():
-    nreal = int(os.environ.get("MULTICHIP_NREAL", "2048"))
-    chunk = int(os.environ.get("MULTICHIP_CHUNK", "512"))
-    npsr = int(os.environ.get("MULTICHIP_NPSR", "8"))
-    ntoa = int(os.environ.get("MULTICHIP_NTOA", "4096"))
-    nmodes = int(os.environ.get("MULTICHIP_NMODES", "100"))
-    nrep = int(os.environ.get("MULTICHIP_NREP", "3"))
+def _fused_identity_check(key, npsr, ntoa, workdir, shapes):
+    """The r17 identity gates on the white-noise workload: at every
+    mesh shape in ``shapes`` the FUSED mesh sweep's consolidated npz is
+    byte-equal to the stacked mesh sweep AND to the single-chip
+    pipelined reference; plus the retopology gate — a fused sweep
+    killed after 2 chunks under shapes[0] resumes FUSED under
+    shapes[-1] to the same bytes. Returns {gate_name: bool}."""
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=2, seed=3)
+    recipe = Recipe(
+        efac=jnp.full((npsr, 2), 1.1, batch.toas_s.dtype),
+        log10_equad=jnp.full((npsr, 2), -6.5, batch.toas_s.dtype),
+    )
+    d = tempfile.mkdtemp(prefix="mc_fused_bitid_", dir=workdir)
+    gates = {}
+    # chunk holds >= 2 realizations per shard on the LARGEST real axis
+    max_real = max(s[0] for s in shapes)
+    nreal, chunk = 8 * max_real, 2 * max_real
+    ck_ref = os.path.join(d, "single.npz")
+    sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+          checkpoint_path=ck_ref, reduce_fn=None, pipeline_depth=2)
+    ref_bytes = open(ck_ref, "rb").read()
+    for shape in shapes:
+        tag = f"{shape[0]}x{shape[1]}"
+        ck_s = os.path.join(d, f"stacked_{tag}.npz")
+        ck_f = os.path.join(d, f"fused_{tag}.npz")
+        sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+              checkpoint_path=ck_s, reduce_fn=None,
+              mesh=make_mesh(*shape), pipeline_depth=2)
+        sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+              checkpoint_path=ck_f, reduce_fn=None,
+              mesh=make_mesh(*shape), pipeline_depth=2,
+              fused_stream=True)
+        gates[f"fused_{tag}_bit_identical"] = (
+            open(ck_f, "rb").read() == ref_bytes)
+        gates[f"stacked_{tag}_bit_identical"] = (
+            open(ck_s, "rb").read() == ref_bytes)
+
+    class _Stop(Exception):
+        pass
+
+    def bomb(done, total):
+        if done == 2:
+            raise _Stop
+
+    ck_r = os.path.join(d, "retopo.npz")
+    try:
+        sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+              checkpoint_path=ck_r, reduce_fn=None,
+              mesh=make_mesh(*shapes[0]), pipeline_depth=2,
+              fused_stream=True, progress=bomb)
+    except _Stop:
+        pass
+    sweep(key, batch, recipe, nreal=nreal, chunk=chunk,
+          checkpoint_path=ck_r, reduce_fn=None,
+          mesh=make_mesh(*shapes[-1]), pipeline_depth=2,
+          fused_stream=True)
+    gates["fused_resume_across_mesh_change_bit_identical"] = (
+        open(ck_r, "rb").read() == ref_bytes)
+    shutil.rmtree(d, ignore_errors=True)
+    return gates
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv[1:]
+    if fast:
+        defaults = dict(nreal="32", chunk="16", npsr="8", ntoa="256",
+                        nmodes="16", nrep="1", devices="1,8")
+    else:
+        defaults = dict(nreal="2048", chunk="512", npsr="8", ntoa="4096",
+                        nmodes="100", nrep="3", devices="1,2,4,8")
+    nreal = int(os.environ.get("MULTICHIP_NREAL", defaults["nreal"]))
+    chunk = int(os.environ.get("MULTICHIP_CHUNK", defaults["chunk"]))
+    npsr = int(os.environ.get("MULTICHIP_NPSR", defaults["npsr"]))
+    ntoa = int(os.environ.get("MULTICHIP_NTOA", defaults["ntoa"]))
+    nmodes = int(os.environ.get("MULTICHIP_NMODES", defaults["nmodes"]))
+    nrep = int(os.environ.get("MULTICHIP_NREP", defaults["nrep"]))
     arms = [int(x) for x in os.environ.get(
-        "MULTICHIP_DEVICES", "1,2,4,8").split(",")]
+        "MULTICHIP_DEVICES", defaults["devices"]).split(",")]
 
     platform = jax.default_backend()
     n_visible = jax.device_count()
@@ -211,16 +338,24 @@ def main():
         first_out = None
         last_out = None
         base = None
+        fused_base_s = None
         for n in arms:
             comp_s, util = _compute_arm(
                 n, key, batch, recipe, nreal, chunk, nrep)
-            full_s, occ, out = _full_step_arm(
+            full_s, occ, out, _ev = _full_step_arm(
                 n, key, batch, recipe, nreal, chunk, workdir)
+            fused_s, _focc, fout, fev = _full_step_arm(
+                n, key, batch, recipe, nreal, chunk, workdir, fused=True)
+            share, writers, verdict = _writer_stats(fev)
+            fused_matches = bool(np.array_equal(out, fout))
+            del fout
             if first_out is None:
                 first_out = out
             last_out = out
             if base is None:
                 base = (comp_s, util)
+            if fused_base_s is None:
+                fused_base_s = fused_s
             speedup = base[0] / comp_s
             if platform == "cpu":
                 # virtual devices share ncores, and the 1-device XLA CPU
@@ -240,6 +375,16 @@ def main():
                 "scaling_efficiency": round(speedup / attainable, 3),
                 "full_step_s": round(full_s, 3),
                 "full_step_real_per_s": round(nreal / full_s, 1),
+                # the fused stage-graph arm (r17): same step, ONE graph
+                "fused_full_step_s": round(fused_s, 3),
+                "fused_full_step_real_per_s": round(nreal / fused_s, 1),
+                "fused_step_speedup": round(fused_base_s / fused_s, 3),
+                "fused_step_scaling_efficiency": round(
+                    fused_base_s / fused_s / attainable, 3),
+                "fused_matches_stacked": fused_matches,
+                "io_write_exclusive_share": share,
+                "shard_writer_occupancy": writers,
+                "fused_verdict": verdict,
                 "occupancy": occ,
             }
             arm_recs[str(n)] = rec
@@ -248,6 +393,9 @@ def main():
         dev = float(np.abs(last_out - first_out).max()) if (
             len(arms) > 1) else 0.0
         bit_identical = _bit_identity_check(key, npsr, ntoa, workdir, top)
+        shapes = [(top // 2, 2), (top, 1)] if top >= 2 else [(1, 1)]
+        fused_gates = _fused_identity_check(
+            key, npsr, ntoa, workdir, shapes)
         head = arm_recs[str(top)]
         rec = {
             "bench": "multichip_scaling",
@@ -256,6 +404,7 @@ def main():
             # parity) and must not clobber the backend/core record
             "host": {"backend": platform, "cores": ncores,
                      "devices_visible": n_visible},
+            "fast": fast,
             "workload": {
                 "nreal": nreal, "chunk": chunk, "npsr": npsr,
                 "ntoa": ntoa, "rn_nmodes": nmodes, "nrep": nrep,
@@ -267,10 +416,19 @@ def main():
             "scaling_efficiency": head["scaling_efficiency"],
             "per_device_real_per_s": head["per_device_real_per_s"],
             "bottleneck": (head["occupancy"] or {}).get("bottleneck"),
+            # r17 headlines, top fused arm: the exclusive-shadow share
+            # io_write holds on the critical path (lower-better; the
+            # r06 stacked baseline pinned io_write at 83% busy) and the
+            # mean concurrently-busy shard writers (higher-better)
+            "io_write_exclusive_share": head["io_write_exclusive_share"],
+            "shard_writer_occupancy": head["shard_writer_occupancy"],
+            "fused_step_scaling_efficiency":
+                head["fused_step_scaling_efficiency"],
             # sharded-checkpoint contract: byte-equal consolidated npz
             # vs the single-chip pipelined path (white-noise workload),
             # and the full workload's cross-topology float deviation
             "bit_identical": bit_identical,
+            "fused_identity": fused_gates,
             "single_chip_max_abs_dev": dev,
             "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                        time.gmtime()),
@@ -280,6 +438,42 @@ def main():
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
+    failures = []
+    if not bit_identical:
+        failures.append(
+            "stacked mesh sweep not byte-identical to single-chip")
+    for gate, ok in fused_gates.items():
+        if not ok:
+            failures.append(f"fused identity gate failed: {gate}")
+    if not head["fused_matches_stacked"]:
+        failures.append(
+            "fused mesh arm's result cube differs from the stacked arm")
+    writers = head["shard_writer_occupancy"]
+    if writers is None or writers <= 1.0:
+        failures.append(
+            "shard writers did not overlap: shard_writer_occupancy "
+            f"{writers} (need > 1.0 — parallel per-shard writes)"
+        )
+    # the io exclusive-share gate (< 0.50 vs r06's 83%-busy baseline)
+    # is enforced on the fast/CI arm only: its write volume is sized so
+    # the stage measures the overlap machinery, not raw disk bandwidth.
+    # At flagship write volume (~0.5 GB durable) a single-disk host
+    # saturates on bandwidth no writer fan-out can exceed — the full
+    # artifact records that share honestly instead of gating on it
+    # (same attainable-adjusted reasoning as the r06 scaling gate).
+    share = head["io_write_exclusive_share"]
+    if fast and (share is None or share >= 0.50):
+        failures.append(
+            f"io_write exclusive-shadow share {share} (need < 0.50 on "
+            "the fast arm — write stage not overlapped by the graph)"
+        )
+    if failures:
+        for reason in failures:
+            print(f"multichip_scaling GATE FAIL: {reason}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

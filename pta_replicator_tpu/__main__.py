@@ -355,9 +355,12 @@ def main(argv=None):
                         "(streamed-CW) delays are rebuilt on a "
                         "static_build stage overlapped with earlier "
                         "chunks' compute, readback, and checkpoint "
-                        "writes (docs/streaming.md). Byte-identical "
-                        "results; requires --pipeline-depth >= 2 and "
-                        "no mesh")
+                        "writes (docs/streaming.md). Composes with "
+                        "--mesh-shape: one fused graph runs tile build, "
+                        "per-device staging, sharded compute, per-shard "
+                        "readback, and parallel per-shard checkpoint "
+                        "writers. Byte-identical results; requires "
+                        "--pipeline-depth >= 2")
     p.add_argument("--drain-timeout", type=float, default=900.0,
                    metavar="S",
                    help="fail a pipelined sweep when a single chunk "
@@ -927,6 +930,15 @@ def _run_command(args):
         raise SystemExit(
             "--fused-stream needs --checkpoint: the fused stage graph "
             "is the checkpointed sweep executor (docs/streaming.md)"
+        )
+    if getattr(args, "fused_stream", False) and args.pipeline_depth < 2:
+        # same pre-ingest gate: at depth 1 there is no concurrency for
+        # the static build to overlap with, so the sweep would refuse
+        # anyway — fail before datasets are loaded.
+        raise SystemExit(
+            "--fused-stream needs --pipeline-depth >= 2: at depth 1 "
+            "there is no concurrency for the static build to overlap "
+            "with (docs/streaming.md)"
         )
 
     with span(names.SPAN_INGEST, pardir=args.pardir):

@@ -218,6 +218,19 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_CHUNKS_TOTAL),
         (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_CHUNKS_DONE),
         (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_REALIZATIONS),
+        # parallel sharded-archive writer (r17): the per-shard writer
+        # spans, the live writer-pool occupancy gauge, and the
+        # overlapped per-shard fsync counter — the fused mesh path's
+        # disk fan-out must stay attributable or the io_write
+        # exclusive-share evidence goes dark. The busy gauge is a text
+        # row: sweep.py passes it as fan_out(busy_gauge=...) and the
+        # gauge() call lives in parallel/stages.py with a variable
+        # name (same referenced-not-emitted idiom as the pipeline.py
+        # rows below).
+        (f"{pkg}/utils/sweep.py", "span", n.SPAN_SHARD_WRITE),
+        (f"{pkg}/utils/sweep.py", "text",
+         "names.SWEEP_SHARD_WRITERS_BUSY"),
+        (f"{pkg}/utils/sweep.py", "metric", n.SWEEP_SHARD_FSYNCS),
         # the sweep pipeline + prefetch stage spans and their window/
         # deadline/stall metrics are DECLARED in pipeline.py/prefetch.py
         # but emitted by the generic stage-graph executor (PR 15,

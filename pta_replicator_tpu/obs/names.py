@@ -57,6 +57,12 @@ SPAN_MULTICHIP_SWEEP = "multichip_sweep"
 #: readback, and checkpoint write — rendered as the ``stage:
 #: static_build`` track in chrome-trace exports (docs/streaming.md)
 SPAN_STATIC_BUILD = "static_build"
+#: one shard writer of the parallel sharded-archive writer (utils/
+#: sweep.py write_shard_archive): the pwrite + overlapped fdatasync of
+#: a single ``shard{k}`` member, labeled ``shard=``, nested inside the
+#: chunk's ``io_write`` span (occupancy.NESTED_STAGES keeps it out of
+#: the serial counterfactual — it is io_write's internal breakdown)
+SPAN_SHARD_WRITE = "shard_write"
 
 # streamed CW-catalog plane pipeline (parallel/prefetch.py,
 # models/batched.py cw_stream_response)
@@ -127,7 +133,7 @@ SPANS = frozenset({
     SPAN_SHARDED_REALIZE, SPAN_SHARDMAP_REALIZE,
     SPAN_SWEEP_CHUNK, SPAN_READBACK_FENCE, SPAN_SWEEP_PIPELINE,
     SPAN_DISPATCH, SPAN_DRAIN, SPAN_IO_WRITE, SPAN_MULTICHIP_SWEEP,
-    SPAN_STATIC_BUILD,
+    SPAN_STATIC_BUILD, SPAN_SHARD_WRITE,
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_LIKELIHOOD_BATCH, SPAN_LIKELIHOOD_SERVE, SPAN_LIKELIHOOD_PROJECT,
     SPAN_LIKELIHOOD_SUBMIT, SPAN_LIKELIHOOD_QUEUE_WAIT,
@@ -199,6 +205,16 @@ SWEEP_LAST_DISPATCHED_CHUNK = "sweep.last_dispatched_chunk"
 #: chunk readback (parallel/mesh.py fetch_shard_blocks): nonzero while
 #: the overlapped D2H drains, 0 between chunks
 SWEEP_SHARDS_INFLIGHT = "sweep.shards_inflight"
+#: shard writers of the parallel sharded-archive writer currently
+#: inside their pwrite/fdatasync (utils/sweep.py write_shard_archive
+#: via parallel.stages.fan_out): >1 while per-shard disk writes
+#: genuinely overlap, 0 between chunk archives
+SWEEP_SHARD_WRITERS_BUSY = "sweep.shard_writers_busy"
+#: per-shard fdatasync calls issued by the parallel archive writer
+#: under ``durable=True`` — each one is a flush of one shard member
+#: riding the writer pool's overlap window instead of the final
+#: pre-rename fsync (which then finds the data already on disk)
+SWEEP_SHARD_FSYNCS = "sweep.shard_fsyncs"
 PIPELINE_DRAIN_TIMEOUTS = "pipeline.drain_timeouts"
 #: transient chunk failures absorbed by the sweep's supervised-recovery
 #: loop (utils/sweep.py): each bump is one resume-from-sidecar retry of
@@ -323,6 +339,7 @@ METRICS = frozenset({
     SWEEP_CHUNKS_TOTAL, SWEEP_CHUNKS_DONE, SWEEP_REALIZATIONS,
     SWEEP_INFLIGHT_CHUNKS, SWEEP_LAST_DISPATCHED_CHUNK,
     SWEEP_SHARDS_INFLIGHT, SWEEP_CHUNK_RETRIES,
+    SWEEP_SHARD_WRITERS_BUSY, SWEEP_SHARD_FSYNCS,
     PIPELINE_DRAIN_TIMEOUTS,
     CW_STREAM_TILES_DONE, CW_STREAM_BYTES_STAGED,
     CW_STREAM_PREFETCH_STALL_S, CW_STREAM_STAGE_RETRIES,

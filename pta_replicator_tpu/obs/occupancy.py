@@ -54,6 +54,7 @@ STAGES: Dict[str, str] = {
     names.SPAN_READBACK_FENCE: "readback",
     names.SPAN_CW_STREAM_STAGE: "host-precompute",
     names.SPAN_STATIC_BUILD: "host-precompute",
+    names.SPAN_SHARD_WRITE: "disk",
 }
 
 #: dataflow order of the stage tracks in chrome-trace exports: the
@@ -67,6 +68,7 @@ STAGE_SORT_ORDER: Tuple[str, ...] = (
     names.SPAN_DISPATCH,
     names.SPAN_DRAIN,
     names.SPAN_IO_WRITE,
+    names.SPAN_SHARD_WRITE,
     names.SPAN_CW_STREAM_STAGE,
     names.SPAN_SWEEP_CHUNK,
     names.SPAN_READBACK_FENCE,
@@ -79,6 +81,10 @@ STAGE_SORT_ORDER: Tuple[str, ...] = (
 #: the parent's breakdown (the synchronous loop's readback share).
 NESTED_STAGES: Dict[str, str] = {
     names.SPAN_READBACK_FENCE: names.SPAN_SWEEP_CHUNK,
+    # per-shard writer spans run INSIDE the chunk's io_write span (the
+    # parallel archive writer is io_write's internal fan-out): their
+    # union is io_write's disk breakdown, never extra serial time
+    names.SPAN_SHARD_WRITE: names.SPAN_IO_WRITE,
 }
 
 #: span names that bound a whole pipelined phase — when present, the

@@ -111,6 +111,12 @@ _HIGHER_BETTER_TOKENS = (
     # stage — falling coverage means the capture (or the analyzer) is
     # losing sight of where wall time goes
     "attributed_fraction",
+    # MULTICHIP fused-mesh series (benchmarks/multichip_scaling.py,
+    # r17): mean concurrent shard writers while the chunk archive is
+    # being written (sum of shard_write busy / io_write busy) — the
+    # parallel writer's whole point is keeping this above 1.0; a fall
+    # back toward 1.0 is the disk fan-out serializing again
+    "writer_occupancy",
 )
 _LOWER_BETTER_SUFFIXES = ("_s", "_ms", "_us")
 # percentile latencies (series.jsonl quantiles -> bench JSON leaves
@@ -157,7 +163,17 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
                         # an info row (its direction is the overlap
                         # efficiency's job to score)
                         "critical_path_s", "blocked_s",
-                        "straggler_ratio")
+                        "straggler_ratio",
+                        # MULTICHIP fused-mesh series (r17): io_write's
+                        # exclusive-shadow share of the phase wall
+                        # (obs/critpath.py critical_share) — the slice
+                        # of wall ONLY the disk covers. The fused graph
+                        # + parallel shard writers exist to shrink it;
+                        # a rising share is the disk re-emerging as the
+                        # uncovered bottleneck. The token is the FULL
+                        # "exclusive_share" leaf, never bare "share",
+                        # so stage duty/coverage shares stay info rows
+                        "exclusive_share")
 #: leaf fragments that must classify lower-better BEFORE the
 #: higher-better token scan: burn_rate_* contains "rate" (a
 #: higher-better token) but a rising SLO burn rate is budget being

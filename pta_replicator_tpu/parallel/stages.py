@@ -906,3 +906,95 @@ class StageGraph:
         stats["occupancy"] = occupancy.overlap_stats(busy, stats["wall_s"])
         self.stats = stats
         return stats
+
+
+def fan_out(
+    tasks: Sequence[Callable[[], Any]],
+    *,
+    workers: Optional[int] = None,
+    name: str = "fan-out",
+    busy_gauge: Optional[str] = None,
+) -> list:
+    """Run ``tasks`` (zero-argument callables) on a bounded worker set
+    and return their results in task order.
+
+    The in-stage fan-out primitive: a stage whose single operation is
+    itself internally parallel — the sharded-archive writer's per-shard
+    pwrite/fdatasync fan-out (utils.sweep.write_shard_archive), which
+    must stay INSIDE the io_write stage so the atomic-write/fault-site
+    contract holds per archive — runs its parallel part through here
+    instead of hand-rolling threads. The executor's thread-boundary
+    guarantees apply per worker: the caller's span ancestry and live
+    trace context are carried over (``TRACER.inherit`` + carry/adopt),
+    so per-task spans nest under the enclosing stage span and keep the
+    item's trace identity; the FIRST task exception re-raises on the
+    caller only after every worker quiesced (a failed shard never races
+    its peers' in-flight writes — the same quiesce-before-raise rule
+    :meth:`StageGraph.run` gives the sink); and ``busy_gauge`` mirrors
+    the live count of busy workers (the writer-pool occupancy
+    evidence).
+
+    ``workers`` bounds concurrency (default: one worker per task). With
+    a single task or ``workers=1`` everything runs on the caller's
+    thread — identical results, exceptions, and gauge movements, no
+    thread overhead for the degenerate case.
+    """
+    tasks = list(tasks)
+    if not tasks:
+        return []
+    n = max(1, min(len(tasks), workers) if workers is not None
+            else len(tasks))
+    lock = threading.Lock()
+    busy = [0]
+
+    def _track(delta: int) -> None:
+        if busy_gauge:
+            with lock:
+                busy[0] += delta
+                gauge(busy_gauge).set(busy[0])
+
+    if n == 1:
+        results = []
+        for task in tasks:
+            _track(+1)
+            try:
+                results.append(task())
+            finally:
+                _track(-1)
+        return results
+
+    results: list = [None] * len(tasks)
+    errors: list = []  # first entry wins (the caller's raise)
+    next_idx = [0]
+    stack = TRACER.current_stack()
+    tctx = carry()  # None = untraced (adopt() shields as a no-op)
+
+    def worker() -> None:
+        with TRACER.inherit(stack), adopt(tctx):
+            while True:
+                with lock:
+                    if errors or next_idx[0] >= len(tasks):
+                        return
+                    j = next_idx[0]
+                    next_idx[0] += 1
+                _track(+1)
+                try:
+                    results[j] = tasks[j]()
+                except BaseException as exc:  # noqa: BLE001 — re-raised on the caller
+                    with lock:
+                        errors.append(exc)
+                    return
+                finally:
+                    _track(-1)
+
+    pool = [
+        threading.Thread(target=worker, name=f"{name}-{w}", daemon=True)
+        for w in range(n)
+    ]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    if errors:
+        raise errors[0]
+    return results

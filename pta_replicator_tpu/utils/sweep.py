@@ -39,8 +39,10 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import struct
 import tempfile
 import zipfile
+import zlib
 from typing import Callable, Optional
 
 import numpy as np
@@ -354,29 +356,179 @@ class ShardedBlock:
         return out
 
 
-def write_shard_archive(path: str, block: ShardedBlock) -> None:
-    """Serialize ``block`` as an ``np.load``-compatible archive: one
-    ``shard{k}.npy`` member per shard (exact ``np.save`` bytes, the same
-    serialization layer as every other checkpoint artifact) plus a JSON
-    ``manifest`` member — written last — recording shape/dtype and each
-    member's global index window, so :func:`load_shard_archive` can
-    reassemble under ANY mesh shape (or none). Callers wrap this in
-    :func:`atomic_write` for the rename + durability sequence."""
-    manifest = {
+#: writer-pool width for the parallel sharded-archive writer: enough
+#: workers to overlap per-shard pwrite/fdatasync syscall latency (both
+#: release the GIL) without spawning a thread per shard on big meshes.
+#: ``PTA_SHARD_WRITERS`` overrides; byte layout is writer-count
+#: independent by construction (absolute offsets, fixed member order).
+_DEFAULT_SHARD_WRITERS = 8
+
+# classic (non-zip64) ZIP record layouts, struct-packed by hand so the
+# whole archive layout is known BEFORE one byte lands and the per-shard
+# writers can pwrite at absolute offsets concurrently. ZIP_STORED only,
+# flags=0 (sizes+CRC in the local header, no data descriptors), fixed
+# 1980-01-01 DOS timestamp — archive bytes are a pure function of the
+# block's content, never of wall clock or writer scheduling.
+_ZIP_LOCAL = struct.Struct("<4s5H3L2H")      # local file header (30 B)
+_ZIP_CENTRAL = struct.Struct("<4s6H3L5H2L")  # central dir entry (46 B)
+_ZIP_EOCD = struct.Struct("<4s4H2LH")        # end-of-central-dir (22 B)
+_ZIP_DOSDATE = (1 << 5) | 1  # (1980, 1, 1) — DOS epoch, time 0
+_ZIP_LIMIT = 0xFFFFFFFF - 1  # past this, classic headers can't speak
+
+
+def _zip_local_header(mname: bytes, buf, crc: int) -> bytes:
+    return _ZIP_LOCAL.pack(
+        b"PK\x03\x04", 20, 0, 0, 0, _ZIP_DOSDATE,
+        crc, len(buf), len(buf), len(mname), 0,
+    ) + mname
+
+
+def _shard_manifest(block: ShardedBlock) -> dict:
+    return {
         "shape": list(block.shape),
         "dtype": block.dtype.str,
-        "shards": [],
+        "shards": [
+            {"member": f"shard{k:06d}",
+             "index": [[int(a), int(b)] for a, b in index]}
+            for k, (index, _arr) in enumerate(block.shards)
+        ],
     }
+
+
+def write_shard_archive(path: str, block: ShardedBlock, *,
+                        durable: bool = False,
+                        writers: Optional[int] = None) -> None:
+    """Serialize ``block`` as an ``np.load``-compatible archive with
+    PARALLEL per-shard writers: one ``shard{k}.npy`` member per shard
+    (exact ``np.save`` bytes, the same serialization layer as every
+    other checkpoint artifact) plus a JSON ``manifest`` member —
+    committed last — recording shape/dtype and each member's global
+    index window, so :func:`load_shard_archive` can reassemble under
+    ANY mesh shape (or none).
+
+    The archive layout (member order, offsets, sizes, CRCs) is computed
+    up front, so N shard writers (``parallel.stages.fan_out``, each
+    under a ``shard_write{shard=}`` span with the live pool occupancy
+    on ``sweep.shard_writers_busy``) land their members via ``pwrite``
+    at absolute offsets concurrently — and with ``durable`` each writer
+    issues its own overlapped ``fdatasync`` (``sweep.shard_fsyncs``),
+    so the disk flush rides the fan-out instead of the final
+    pre-rename fsync. Bytes are identical for every writer count
+    (including 1) by construction.
+
+    The manifest member, central directory, and end record are written
+    strictly AFTER every shard writer returned: a torn archive has no
+    directory, ``np.load`` refuses it, and resume treats the chunk as
+    never written — the same completeness-marker contract the serial
+    writer kept. Callers wrap this in :func:`atomic_write` for the
+    rename + durability sequence (archives past classic-ZIP limits fall
+    back to the serial zip64 writer, same members, same order)."""
+    from ..obs import counter, names, span
+    from ..parallel.stages import fan_out
+
+    if writers is None:
+        writers = int(os.environ.get("PTA_SHARD_WRITERS",
+                                     _DEFAULT_SHARD_WRITERS))
+    manifest = _shard_manifest(block)
+
+    def serialize(arr):
+        def task():
+            buf = bytes(_npy_bytes(np.asarray(arr)))
+            return buf, zlib.crc32(buf)
+        return task
+
+    # phase 1 (parallel): exact-npy serialization + checksum per shard
+    # (zlib.crc32 releases the GIL, so checksums overlap across workers)
+    payloads = fan_out(
+        [serialize(arr) for _index, arr in block.shards],
+        workers=writers, name="shard-crc",
+    )
+    mbuf = bytes(_npy_bytes(np.array(json.dumps(manifest))))
+    members = [(f"shard{k:06d}.npy".encode(), buf, crc)
+               for k, (buf, crc) in enumerate(payloads)]
+    members.append((f"{_SHARD_MANIFEST_MEMBER}.npy".encode(), mbuf,
+                    zlib.crc32(mbuf)))
+
+    # phase 2: the full layout, known before one byte lands — absolute
+    # offsets make the per-shard pwrites commute
+    offsets = []
+    pos = 0
+    for mname, buf, _crc in members:
+        offsets.append(pos)
+        pos += _ZIP_LOCAL.size + len(mname) + len(buf)
+    cd_offset = pos
+    cd = b"".join(
+        _ZIP_CENTRAL.pack(
+            b"PK\x01\x02", 20 | (3 << 8), 20, 0, 0, 0, _ZIP_DOSDATE,
+            crc, len(buf), len(buf), len(mname), 0, 0, 0, 0, 0, off,
+        ) + mname
+        for (mname, buf, crc), off in zip(members, offsets)
+    )
+    end = cd_offset + len(cd) + _ZIP_EOCD.size
+    if (end >= _ZIP_LIMIT or len(members) >= 0xFFFF
+            or any(len(buf) >= _ZIP_LIMIT for _m, buf, _c in members)):
+        _write_shard_archive_zip64(path, block, manifest)
+        return
+
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o666)
+    try:
+        def shard_writer(k):
+            mname, buf, crc = members[k]
+
+            def task():
+                with span(names.SPAN_SHARD_WRITE, shard=k,
+                          nbytes=len(buf)):
+                    header = _zip_local_header(mname, buf, crc)
+                    os.pwrite(fd, header, offsets[k])
+                    os.pwrite(fd, buf, offsets[k] + len(header))
+                    # per-shard torn-write site: a `torn` fault here
+                    # truncates the archive mid-shard — exactly the
+                    # artifact one interrupted writer of a fan-out
+                    # leaves (chaos arm of tests/test_multichip.py)
+                    faults.fire(faults.SITE_CHECKPOINT_WRITE,
+                                path=path, shard=k)
+                    if durable:
+                        faults.fire(faults.SITE_CHECKPOINT_FSYNC,
+                                    path=path, shard=k)
+                        os.fdatasync(fd)
+                        counter(names.SWEEP_SHARD_FSYNCS).inc()
+            return task
+
+        # phase 3 (parallel): the per-shard writers — pwrite releases
+        # the GIL around the syscall and fdatasync is a real disk
+        # wait, so N writers overlap what the serial writer ran back
+        # to back
+        fan_out(
+            [shard_writer(k) for k in range(len(members) - 1)],
+            workers=writers, name="shard-write",
+            busy_gauge=names.SWEEP_SHARD_WRITERS_BUSY,
+        )
+        # the commit tail, strictly last: manifest member + central
+        # directory + end record land only after every shard writer
+        # quiesced — the completeness marker
+        mname, buf, crc = members[-1]
+        os.pwrite(fd, _zip_local_header(mname, buf, crc) + buf,
+                  offsets[-1])
+        os.pwrite(
+            fd,
+            cd + _ZIP_EOCD.pack(b"PK\x05\x06", 0, 0, len(members),
+                                len(members), len(cd), cd_offset, 0),
+            cd_offset,
+        )
+    finally:
+        os.close(fd)
+
+
+def _write_shard_archive_zip64(path: str, block: ShardedBlock,
+                               manifest: dict) -> None:
+    """Serial zip64 fallback for archives past classic-ZIP limits (a
+    >4 GiB member/offset or >64k shards): the pre-r17 zipfile-streamed
+    writer — same members, same order, same manifest-last contract."""
     with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED,
                          allowZip64=True) as zf:
-        for k, (index, arr) in enumerate(block.shards):
-            member = f"shard{k:06d}"
-            with zf.open(member + ".npy", "w", force_zip64=True) as fh:
+        for k, (_index, arr) in enumerate(block.shards):
+            with zf.open(f"shard{k:06d}.npy", "w", force_zip64=True) as fh:
                 fh.write(_npy_bytes(np.asarray(arr)))
-            manifest["shards"].append(
-                {"member": member, "index": [[int(a), int(b)]
-                                             for a, b in index]}
-            )
         with zf.open(_SHARD_MANIFEST_MEMBER + ".npy", "w") as fh:
             fh.write(_npy_bytes(np.array(json.dumps(manifest))))
 
@@ -583,8 +735,10 @@ def sweep(
     the per-device D2H copies overlapped (parallel.mesh.
     fetch_shard_blocks), and — with ``shard_checkpoint`` (default on) —
     the writer persists each chunk as a sharded archive (one npy member
-    per device shard + a manifest member, utils.sweep.
-    write_shard_archive) instead of one monolithic ``.npy``. The
+    per device shard + a manifest member, written by PARALLEL per-shard
+    writers with overlapped fsync and the manifest committed last —
+    utils.sweep.write_shard_archive) instead of one monolithic
+    ``.npy``. The
     manifest records every shard's global index window, so a resume
     reassembles completed chunks under ANY topology (mesh-shape change,
     or none at all), and the consolidated checkpoint plus the returned
@@ -625,26 +779,32 @@ def sweep(
     (benchmarks/stage_graph.py measures exactly this). The fused graph
     is the substrate for sweeps whose per-chunk deterministic content
     genuinely varies; on a fixed recipe it trades redundant (hidden)
-    host tile work for end-to-end overlap. Requires ``mesh=None`` and
-    ``pipeline_depth >= 2``.
+    host tile work for end-to-end overlap. Requires ``pipeline_depth
+    >= 2``.
+
+    Fused streaming COMPOSES with a multi-device ``mesh`` (r17,
+    docs/performance.md "Sharding the sweep"): the same four-stage
+    graph runs host tile-build (with the per-device H2D stagers of
+    parallel.prefetch.prefetch_to_mesh nested inside ``static_build``),
+    sharded compute (``sharded_realize``), per-shard overlapped D2H
+    drain (``fetch_shard_blocks``), and the sharded-archive write —
+    whose per-shard writers fan out in parallel with overlapped fsync
+    (:func:`write_shard_archive`) — as ONE overlapped window. Results
+    and checkpoints stay byte-identical to the stacked mesh sweep and
+    to single-chip consolidation, and resume still works across any
+    mesh-shape change.
     """
     import contextlib
     import time as _time
 
     from ..faults.retry import DEFAULT_POLICY, backoff_delay, is_transient
 
-    if fused_stream:
-        if mesh is not None:
-            raise ValueError(
-                "fused_stream=True runs the single-device fused graph — "
-                "the mesh sweep keeps its own static precompute path"
-            )
-        if pipeline_depth < 2:
-            raise ValueError(
-                "fused_stream=True needs pipeline_depth >= 2 — at depth "
-                "1 there is no concurrency for the static build to "
-                "overlap with"
-            )
+    if fused_stream and pipeline_depth < 2:
+        raise ValueError(
+            "fused_stream=True needs pipeline_depth >= 2 — at depth "
+            "1 there is no concurrency for the static build to "
+            "overlap with"
+        )
 
     phase = contextlib.nullcontext()
     if mesh is not None and int(mesh.devices.size) > 1:
@@ -840,8 +1000,11 @@ def _sweep_impl(
         lands as the per-shard archive (mesh sweep, sharded
         checkpoints); an ndarray as the single-chip ``.npy``."""
         if isinstance(block, ShardedBlock):
+            # durable rides the shard writers too: each one fdatasyncs
+            # its member inside the fan-out, so the pre-rename fsync in
+            # _atomic_write finds the data already flushed
             _atomic_write(
-                lambda p: write_shard_archive(p, block),
+                lambda p: write_shard_archive(p, block, durable=durable),
                 _shard_chunk_path(checkpoint_path, i),
                 ".npz",
                 durable=durable,
@@ -989,6 +1152,8 @@ def _sweep_impl(
                         depth=pipeline_depth,
                         drain_timeout_s=drain_timeout_s,
                         trace_scope=checkpoint_path,
+                        mesh=mesh,
+                        fetch=fetch_fn,
                     )
                 else:
                     stats = run_pipelined(
@@ -1041,6 +1206,8 @@ def _run_fused_stream(
     depth: int,
     drain_timeout_s: Optional[float],
     trace_scope: str,
+    mesh=None,
+    fetch: Callable = np.asarray,
 ) -> dict:
     """The FUSED sweep graph (docs/streaming.md): one end-to-end stage
     graph ``static_build -> dispatch -> drain -> io_write`` where the
@@ -1059,12 +1226,22 @@ def _run_fused_stream(
     measured end-to-end overlap, benchmarks/stage_graph.py) differs.
     Returns the same stats-dict shape as ``run_pipelined``, plus the
     ``static_build`` entry in ``stage_busy_s``.
+
+    On a multi-device ``mesh`` (r17) the SAME graph runs the whole
+    multi-chip sweep: ``static_build`` re-derives and mesh-places the
+    per-chunk static (``static_delays(mesh=...)`` — for a streamed CW
+    recipe the per-device H2D stagers of prefetch_to_mesh fan out as
+    replica stages nested inside this span), ``dispatch`` launches the
+    sharded engine (``sharded_realize``), and ``fetch`` is the
+    overlapped per-shard D2H drain (``fetch_shard_blocks``) feeding the
+    parallel per-shard archive writers inside ``io_write``. There is no
+    separate mesh loop — one declared graph covers every topology.
     """
     import jax
 
     from ..models.batched import realize
     from ..obs import names
-    from ..parallel.mesh import static_delays
+    from ..parallel.mesh import sharded_realize, static_delays
     # the sweep pipeline's shared stage vocabulary: drain/io_write and
     # the stats contract are THE SAME objects run_pipelined declares,
     # so the fused and stacked graphs cannot silently fork the behavior
@@ -1081,13 +1258,19 @@ def _run_fused_stream(
     def build_static(i, _payload, _sp):
         # the streamed-CW tile build + prefetch runs inside this span
         # (cw_stream_response nests its own stage graph here and its
-        # workers adopt this chunk's trace context)
-        return static_delays(batch, recipe, mesh=None)
+        # workers adopt this chunk's trace context); on a mesh the
+        # result is additionally placed/sharded on the devices, so the
+        # per-chunk H2D staging overlaps earlier chunks' compute too
+        return static_delays(batch, recipe, mesh=mesh)
 
     def dispatch_fused(i, static_i, _sp):
         k = jax.random.fold_in(key, i)
-        res = realize(k, batch, recipe, nreal=chunk, fit=fit,
-                      static=static_i)
+        if mesh is not None:
+            res = sharded_realize(k, batch, recipe, nreal=chunk,
+                                  mesh=mesh, fit=fit, static=static_i)
+        else:
+            res = realize(k, batch, recipe, nreal=chunk, fit=fit,
+                          static=static_i)
         return reduce_fn(res, batch) if reduce_fn is not None else res
 
     graph = StageGraph(
@@ -1113,7 +1296,7 @@ def _run_fused_stream(
                 heartbeat_label="chunk dispatch",
                 thread_name="sweep-dispatch",
             ),
-            drain_stage(np.asarray, depth),
+            drain_stage(fetch, depth),
             io_write_stage(write),
         ],
         window=depth,

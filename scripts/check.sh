@@ -87,6 +87,18 @@ echo "== critical-path attribution gate (fast arm) =="
 JAX_PLATFORMS=cpu python benchmarks/critpath_attribution.py --fast \
     > /dev/null
 
+echo "== fused-mesh sweep gate (fast arm) =="
+# the fast arm of benchmarks/multichip_scaling.py: a 2-chunk fused
+# mesh sweep over 8 virtual CPU devices — consolidated checkpoints
+# byte-identical to the stacked mesh sweep AND the single-chip path at
+# two mesh shapes, fused crash-resume across a mesh-shape change, and
+# the parallel per-shard writers measurably overlapped
+# (shard_writer_occupancy > 1) — exit 1, reasons to stderr.
+# Seconds-scale, fixture-free, CPU-only (docs/streaming.md "Case
+# study: the fused MESH sweep").
+JAX_PLATFORMS=cpu python benchmarks/multichip_scaling.py --fast \
+    > /dev/null
+
 echo "== performance ledger gate (windowed regression) =="
 # obs/ledger.py over the committed round artifacts: any direction-
 # classified metric worsening MONOTONICALLY across the last 3 rounds

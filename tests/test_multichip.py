@@ -492,6 +492,155 @@ def test_prefetch_to_mesh_mid_stage_exception_peers_drain():
         np.testing.assert_array_equal(np.asarray(g_psr), psr)
 
 
+# ------------------------------------------- fused mesh path (r17)
+
+@pytest.mark.parametrize("shape", [(2, 2), (4, 2)])
+def test_fused_mesh_sweep_bit_identity(tmp_path, white_sweep, shape):
+    """The r17 tentpole contract: ONE fused stage graph running the
+    whole multi-chip sweep (host tile build -> per-device H2D ->
+    sharded compute -> per-shard D2H -> parallel per-shard writers) is
+    byte-identical to both the stacked mesh sweep and the single-chip
+    pipelined reference, at >= 2 mesh shapes."""
+    b, recipe, key = white_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ref_ck,
+                reduce_fn=None, pipeline_depth=2)
+    mesh = make_mesh(*shape)
+    stacked_ck = str(tmp_path / "stacked.npz")
+    stacked = sweep(key, b, recipe, nreal=32, chunk=8,
+                    checkpoint_path=stacked_ck, reduce_fn=None,
+                    mesh=mesh, pipeline_depth=2)
+    fused_ck = str(tmp_path / "fused.npz")
+    fused = sweep(key, b, recipe, nreal=32, chunk=8,
+                  checkpoint_path=fused_ck, reduce_fn=None,
+                  mesh=mesh, pipeline_depth=2, fused_stream=True)
+    np.testing.assert_array_equal(fused, ref)
+    np.testing.assert_array_equal(fused, stacked)
+    ref_bytes = open(ref_ck, "rb").read()
+    assert open(fused_ck, "rb").read() == ref_bytes
+    assert open(stacked_ck, "rb").read() == ref_bytes
+    assert glob.glob(fused_ck + ".chunk*") == []
+
+
+def test_fused_mesh_crash_resume_across_mesh_change(
+    tmp_path, white_sweep, monkeypatch
+):
+    """Kill a fused mesh sweep in the crash-safety window (sharded
+    archive landed, sidecar missing), resume FUSED on a different mesh
+    shape, and still match the uninterrupted single-chip run
+    byte-for-byte."""
+    b, recipe, key = white_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ref_ck,
+                reduce_fn=None, pipeline_depth=2)
+
+    class _KillSim(BaseException):
+        pass
+
+    orig = sweep_mod._atomic_write
+    seen = {"json": 0}
+
+    def bombed(write_fn, final_path, suffix, durable=False):
+        if suffix == ".json":
+            seen["json"] += 1
+            if seen["json"] == 2:  # chunk index 1's sidecar
+                raise _KillSim()
+        return orig(write_fn, final_path, suffix, durable=durable)
+
+    monkeypatch.setattr(sweep_mod, "_atomic_write", bombed)
+    ck = str(tmp_path / "crash.npz")
+    with pytest.raises(_KillSim):
+        sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+              reduce_fn=None, mesh=make_mesh(2, 2), pipeline_depth=2,
+              fused_stream=True, chunk_retries=0)
+    monkeypatch.undo()
+
+    assert os.path.exists(ck + ".chunk000001.npz")
+    calls = []
+    out = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+                reduce_fn=None, mesh=make_mesh(4, 2), pipeline_depth=2,
+                fused_stream=True, progress=lambda d, t: calls.append(d))
+    assert calls == [2, 3, 4]  # chunk 0 survived; 1..3 recomputed
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+@pytest.mark.parametrize("shape", [(2, 2), (4, 2)])
+@pytest.mark.parametrize("schedule", [
+    "io_write:raise@chunk=1",
+    # call=2 is the SECOND per-shard fire of chunk 0's archive: a torn
+    # fault on ONE shard of a multi-shard archive (the in-flight tmp
+    # file is truncated mid-parallel-write, peers' bytes included)
+    "checkpoint_write:torn@call=2",
+])
+def test_fused_mesh_chaos_recovers_byte_identical(
+    tmp_path, white_sweep, shape, schedule
+):
+    """io_write / checkpoint_write fault schedules on the FUSED mesh
+    path — including torn-on-one-shard — recover byte-identically via
+    sidecar resume at both mesh shapes."""
+    from pta_replicator_tpu.faults import inject
+    from pta_replicator_tpu.faults.retry import RetryPolicy
+
+    b, recipe, key = white_sweep
+    ref_ck = str(tmp_path / "ref.npz")
+    ref = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ref_ck,
+                reduce_fn=None, pipeline_depth=2)
+    ck = str(tmp_path / "chaos.npz")
+    fast = RetryPolicy(max_attempts=4, base_delay_s=0.01, max_delay_s=0.05)
+    with inject.armed(schedule):
+        out = sweep(key, b, recipe, nreal=32, chunk=8, checkpoint_path=ck,
+                    reduce_fn=None, mesh=make_mesh(*shape),
+                    pipeline_depth=2, fused_stream=True,
+                    retry_policy=fast)
+        assert len(inject.fired()) == 1  # the fault really fired
+    np.testing.assert_array_equal(out, ref)
+    assert open(ck, "rb").read() == open(ref_ck, "rb").read()
+
+
+def test_shard_archive_byte_stable_across_writer_counts(tmp_path):
+    """The parallel per-shard writer is byte-deterministic: any writer
+    count (serial included) produces the identical archive — offsets
+    are precomputed, the manifest + central directory commit last."""
+    mesh = make_mesh(4, 2)
+    x = np.arange(8 * 6 * 10, dtype=np.float64).reshape(8, 6, 10)
+    blk = fetch_shard_blocks(put_sharded(x, mesh, P("real", "psr", None)))
+    paths = []
+    for w in (1, 2, 4, None):
+        p = str(tmp_path / f"w{w}.npz")
+        write_shard_archive(p, blk, writers=w)
+        paths.append(p)
+        np.testing.assert_array_equal(load_shard_archive(p), x)
+    ref = open(paths[0], "rb").read()
+    for p in paths[1:]:
+        assert open(p, "rb").read() == ref
+    # still a valid zip with per-member CRCs (np.load checks them)
+    with zipfile.ZipFile(paths[0]) as zf:
+        assert zf.testzip() is None
+        assert zf.namelist()[-1] == "manifest.npy"
+
+
+def test_shard_archive_parallel_writer_telemetry(tmp_path):
+    """Each shard writer emits a shard_write{shard=} span nested in the
+    chunk's io_write shadow, and durable archives count one fsync per
+    shard writer."""
+    from pta_replicator_tpu import obs
+    from pta_replicator_tpu.obs import names as obs_names
+
+    mesh = make_mesh(2, 2)
+    x = np.arange(4 * 6, dtype=np.float64).reshape(4, 6)
+    blk = fetch_shard_blocks(put_sharded(x, mesh, P("real", "psr")))
+    obs.reset_all()
+    f0 = obs.counter(obs_names.SWEEP_SHARD_FSYNCS).value
+    write_shard_archive(str(tmp_path / "t.npz"), blk, durable=True)
+    spans = [e for e in obs.TRACER.events()
+             if e.get("type") == "span"
+             and e.get("name") == obs_names.SPAN_SHARD_WRITE]
+    assert sorted(e["attrs"]["shard"] for e in spans) == [0, 1, 2, 3]
+    assert all(e["attrs"]["nbytes"] > 0 for e in spans)
+    assert obs.counter(obs_names.SWEEP_SHARD_FSYNCS).value == f0 + 4
+
+
 def test_prefetch_to_mesh_transient_stage_fault_retried():
     """A transient per-device staging failure is absorbed by the
     in-place retry: the stream completes, bit-identical, with the

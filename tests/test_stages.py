@@ -30,6 +30,7 @@ from pta_replicator_tpu.parallel.stages import (
     DrainTimeout,
     Stage,
     StageGraph,
+    fan_out,
 )
 from pta_replicator_tpu.utils.sweep import sweep
 
@@ -641,18 +642,89 @@ def test_fused_sweep_absorbs_transient_fault_byte_identical(
     assert open(ck, "rb").read() == open(ref_ck, "rb").read()
 
 
-def test_fused_sweep_rejects_mesh_and_depth1(tmp_path, streamed_cw_sweep):
+def test_fused_sweep_rejects_depth1(tmp_path, streamed_cw_sweep):
+    """Depth 1 has no concurrency for the static build to overlap with;
+    the mesh refusal is GONE (r17: fused streaming composes with a
+    mesh — see tests/test_multichip.py for the fused-mesh identity)."""
     b, recipe, key = streamed_cw_sweep
     with pytest.raises(ValueError, match="pipeline_depth"):
         sweep(key, b, recipe, nreal=8, chunk=4,
               checkpoint_path=str(tmp_path / "x.npz"),
               pipeline_depth=1, fused_stream=True)
-    from pta_replicator_tpu.parallel import make_mesh
 
-    with pytest.raises(ValueError, match="mesh"):
-        sweep(key, b, recipe, nreal=8, chunk=4,
-              checkpoint_path=str(tmp_path / "y.npz"),
-              mesh=make_mesh(2, 1), fused_stream=True)
+
+# ----------------------------------------------- fan_out (r17 writers)
+
+def test_fan_out_preserves_task_order():
+    """Results land at their task's index regardless of which worker
+    ran it or in what order workers finished."""
+    import random
+
+    def task(k):
+        def run():
+            time.sleep(random.uniform(0, 0.01))
+            return k * k
+        return run
+
+    assert fan_out([task(k) for k in range(20)], workers=4) == \
+        [k * k for k in range(20)]
+    assert fan_out([]) == []
+    assert fan_out([task(3)], workers=8) == [9]  # workers clamp to tasks
+
+
+def test_fan_out_serial_path_matches_parallel():
+    assert fan_out([lambda k=k: k + 1 for k in range(5)], workers=1) == \
+        fan_out([lambda k=k: k + 1 for k in range(5)], workers=5)
+
+
+def test_fan_out_first_error_wins_and_stops_dispatch():
+    """A failing task re-raises on the caller; tasks not yet started
+    are abandoned (no half-pool wedge), started peers run to term."""
+    ran = []
+
+    def ok(k):
+        def run():
+            ran.append(k)
+            return k
+        return run
+
+    def boom():
+        raise RuntimeError("writer died")
+
+    tasks = [ok(0), boom] + [ok(k) for k in range(2, 40)]
+    with pytest.raises(RuntimeError, match="writer died"):
+        fan_out(tasks, workers=2)
+    assert 0 in ran and len(ran) < 39  # tail abandoned after the error
+
+
+def test_fan_out_busy_gauge_returns_to_zero():
+    from pta_replicator_tpu.obs import gauge
+
+    obs.reset_all()
+    fan_out([lambda: time.sleep(0.01) for _ in range(6)], workers=3,
+            busy_gauge=names.SWEEP_SHARD_WRITERS_BUSY)
+    assert gauge(names.SWEEP_SHARD_WRITERS_BUSY).value == 0
+
+
+def test_fan_out_inherits_trace_context():
+    """Spans emitted inside fan_out workers inherit the caller's trace
+    identity across the thread hop — the shard_write spans of chunk i
+    must ride chunk i's trace, exactly like every other stage hop."""
+    from pta_replicator_tpu.obs import span
+    from pta_replicator_tpu.obs.trace import adopt
+
+    def emit():
+        with span("inner"):
+            pass
+
+    obs.reset_all()
+    ctx = chunk_trace_context("/tmp/t.npz", 0)
+    with adopt(ctx), span("outer"):
+        fan_out([emit for _ in range(3)], workers=3)
+    spans = [e for e in TRACER.events() if e.get("type") == "span"]
+    inners = [e for e in spans if e["name"] == "inner"]
+    assert len(inners) == 3
+    assert all(e["trace_id"] == ctx.trace_id for e in inners)
 
 
 def test_cli_fused_stream_requires_checkpoint():
@@ -665,3 +737,31 @@ def test_cli_fused_stream_requires_checkpoint():
               "/nonexistent", "--recipe", "/nonexistent.json",
               "--nreal", "4", "--out", "/tmp/never.npz",
               "--fused-stream"])
+
+
+def test_cli_fused_stream_requires_depth2():
+    """--fused-stream --pipeline-depth 1 refuses before ingest — the
+    sweep would refuse anyway, but only after loading datasets."""
+    from pta_replicator_tpu.__main__ import main
+
+    with pytest.raises(SystemExit, match="pipeline-depth"):
+        main(["realize", "--pardir", "/nonexistent", "--timdir",
+              "/nonexistent", "--recipe", "/nonexistent.json",
+              "--nreal", "4", "--out", "/tmp/never.npz",
+              "--checkpoint", "/tmp/never_ck.npz",
+              "--fused-stream", "--pipeline-depth", "1"])
+
+
+def test_cli_fused_stream_accepts_mesh_shape():
+    """--fused-stream --mesh-shape parses and reaches ingest (r17 lifts
+    the mesh refusal): the pre-ingest gates pass and the next failure
+    is the nonexistent pardir, not a fused/mesh refusal."""
+    from pta_replicator_tpu.__main__ import main
+
+    with pytest.raises((SystemExit, OSError, ValueError)) as exc_info:
+        main(["realize", "--pardir", "/nonexistent", "--timdir",
+              "/nonexistent", "--recipe", "/nonexistent.json",
+              "--nreal", "4", "--out", "/tmp/never.npz",
+              "--checkpoint", "/tmp/never_ck.npz",
+              "--fused-stream", "--mesh-shape", "2x2"])
+    assert "fused" not in str(exc_info.value)
