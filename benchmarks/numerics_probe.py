@@ -1,0 +1,433 @@
+"""Numerics-observatory evidence bench: probes proven bitwise-neutral,
+cheap, and pointing at the producing site.
+
+The observatory (obs/numerics.py, docs/numerics.md) is only worth
+committing if four claims hold MEASURABLY:
+
+* **identity arm** — the flagship-shaped sweep cube is sha256-identical
+  across (A) disarmed, (B) armed, and (C) disarmed-after-an-arm/disarm-
+  cycle. Disarmed probes literally ``return x`` before touching jax
+  (A == C is the "imports cost nothing" gate); armed probes are
+  identity on the data path (B == A — the reductions ride beside the
+  graph, never in it).
+* **overhead arm** — the probe machinery (the EXACT subgraph the armed
+  engine adds: per-realization slab stats under vmap, reduced into the
+  donated stats buffer) microbenched standalone, scaled by the site
+  count one flagship realize step ACTUALLY arms (read back from the
+  ledger, not assumed), against the measured step wall. Gate: < 1%
+  (``NP_OVERHEAD_GATE``, enforced on the committed non-fast run).
+  Measured this way — rather than gating on a whole-step wall-clock
+  A/B — because ~100 us of machinery against a ~100 ms step makes the
+  A/B mostly scheduler noise (the TRACE_r14 lesson); the end-to-end
+  armed-vs-disarmed delta is still reported as an informational
+  cross-check.
+* **planted-overflow arm** — ``log10_equad=25`` overflows the f32
+  white-noise variance to inf (an efac blowup alone does NOT plant:
+  XLA simplifies ``sqrt((efac*err)**2)`` to ``|efac*err|`` and the
+  overflowing intermediate never materializes; the ``var + equad**2``
+  sum defeats the rewrite); the ledger must name ``realization.white``
+  (the producing probe site) and no other in-graph site.
+* **planted-NaN arm** — a ``drain:nan@chunk=1`` fault poisons one
+  element of the in-flight chunk AFTER device compute; only the drain
+  seam's host scan can see it, so the ledger must name ``drain`` and
+  no in-graph site (the last-line-of-defense claim).
+* **drift arm** — with 1-in-1 sampling, every chunk's realization 0
+  replays through the f64 shadow oracle; each sampled family's worst
+  relative drift must sit within the fuzzer's family tolerance
+  (``scenarios.fuzz.FAMILY_TOLERANCES`` — the same bar the fuzz gate
+  holds).
+
+Prints one JSON line (committed as ``NUMERICS_r18_cpu.json``); exit 1
+on any gate miss, with the reasons on stderr (stdout is routinely
+/dev/null'd in CI — the PR 12/13 lesson).
+
+Usage: python benchmarks/numerics_probe.py [--fast] [--out PATH]
+  env: NP_NPSR / NP_NTOA / NP_NREAL / NP_CHUNK / NP_STEP_NPSR /
+       NP_STEP_NTOA / NP_STEP_CHUNK reshape the workload (--fast
+       presets a seconds-scale CI arm).
+"""
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from pta_replicator_tpu.batch import synthetic_batch  # noqa: E402
+from pta_replicator_tpu.faults import inject  # noqa: E402
+from pta_replicator_tpu.models.batched import Recipe, realize  # noqa: E402
+from pta_replicator_tpu.obs import numerics  # noqa: E402
+from pta_replicator_tpu.scenarios.fuzz import FAMILY_TOLERANCES  # noqa: E402
+from pta_replicator_tpu.utils.provenance import (  # noqa: E402
+    EVIDENCE_SCHEMA_VERSION,
+    provenance_stamp,
+)
+from pta_replicator_tpu.utils.sweep import sweep  # noqa: E402
+
+#: probe-overhead gate: the observatory must cost < 1% of the flagship
+#: CPU step when armed
+NP_OVERHEAD_GATE = 0.01
+
+#: a drift_every large enough that no bench chunk index ever samples —
+#: arms the probes without the shadow-oracle replay
+NO_DRIFT = 1_000_000_000
+
+
+def _cube_sha(cube: np.ndarray) -> str:
+    arr = np.ascontiguousarray(np.asarray(cube))
+    h = hashlib.sha256()
+    h.update(arr.dtype.str.encode())
+    h.update(str(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _flagship_recipe(npsr: int) -> Recipe:
+    return Recipe(
+        efac=jnp.ones(npsr),
+        rn_log10_amplitude=jnp.full(npsr, -13.5),
+        rn_gamma=jnp.full(npsr, 4.0),
+    )
+
+
+def _run_sweep(tag, key, batch, recipe, nreal, chunk):
+    d = tempfile.mkdtemp(prefix=f"numerics_probe_{tag}_")
+    return sweep(
+        key, batch, recipe, nreal=nreal, chunk=chunk,
+        checkpoint_path=os.path.join(d, "sweep.npz"), reduce_fn=None,
+    )
+
+
+def run_identity_arm(nreal, chunk, npsr, ntoa, failures):
+    """A (disarmed) == B (armed) == C (disarmed after an arm/disarm
+    cycle), by sha256 over the sweep cube's bytes."""
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=11)
+    recipe = _flagship_recipe(npsr)
+    key = jax.random.PRNGKey(3)
+
+    numerics.reset()
+    sha_disarmed = _cube_sha(
+        _run_sweep("disarmed", key, batch, recipe, nreal, chunk)
+    )
+    numerics.arm(drift_every=NO_DRIFT)
+    sha_armed = _cube_sha(
+        _run_sweep("armed", key, batch, recipe, nreal, chunk)
+    )
+    armed_sites = sorted(numerics.snapshot()["sites"])
+    numerics.disarm()
+    sha_cycled = _cube_sha(
+        _run_sweep("cycled", key, batch, recipe, nreal, chunk)
+    )
+    numerics.reset()
+    if sha_cycled != sha_disarmed:
+        failures.append(
+            "identity: disarmed cube changed after an arm/disarm cycle "
+            f"({sha_disarmed[:12]} -> {sha_cycled[:12]}) — the disarmed "
+            "graph is not bitwise the unprobed graph"
+        )
+    if sha_armed != sha_disarmed:
+        failures.append(
+            "identity: ARMED cube differs from disarmed "
+            f"({sha_disarmed[:12]} vs {sha_armed[:12]}) — probes are "
+            "not identity on the data path"
+        )
+    if not armed_sites:
+        failures.append(
+            "identity: the armed sweep recorded no probe sites — the "
+            "probes compiled out of the armed graph"
+        )
+    return {
+        "sha_disarmed": sha_disarmed,
+        "sha_armed": sha_armed,
+        "sha_disarmed_after_cycle": sha_cycled,
+        "armed_probe_sites": armed_sites,
+    }
+
+
+def run_overhead_arm(step_npsr, step_ntoa, step_chunk, fast, failures):
+    """TRACE_r14 method: the probe machinery — the exact subgraph the
+    armed engine adds (per-realization slab stats under vmap, reduced
+    into the donated stats buffer) — microbenched standalone, times the
+    site count one flagship step actually arms (from the ledger), over
+    the measured step wall. The armed-vs-disarmed whole-step A/B rides
+    along informationally; it is NOT the gate because scheduler noise
+    at the ~100 ms scale dwarfs ~100 us of machinery."""
+    batch = synthetic_batch(npsr=step_npsr, ntoa=step_ntoa, seed=5)
+    recipe = _flagship_recipe(step_npsr)
+    key = jax.random.PRNGKey(2)
+
+    def step_wall_median(reps=9):
+        np.asarray(realize(key, batch, recipe, nreal=step_chunk))
+        ws = []
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(realize(jax.random.fold_in(key, rep), batch,
+                               recipe, nreal=step_chunk))
+            ws.append(time.perf_counter() - t0)
+        return float(np.median(ws))
+
+    # the disarmed step wall: the denominator of the <1% claim
+    numerics.reset()
+    step_wall = step_wall_median()
+
+    # sites one armed step arms, read back from the ledger not assumed
+    numerics.arm(drift_every=NO_DRIFT)
+    np.asarray(realize(key, batch, recipe, nreal=step_chunk))
+    numerics.flush()
+    snap = numerics.snapshot()
+    sites_per_step = len(snap["sites"])
+    scanned = {
+        s: rec["elements"] // max(1, rec["calls"])
+        for s, rec in snap["sites"].items()
+    }
+    if sites_per_step < 1:
+        failures.append(
+            "overhead: the armed realize step fired no probes — "
+            "nothing to measure"
+        )
+    armed_wall = step_wall_median()
+    numerics.reset()
+
+    # machinery microbench: one site's collector subgraph, standalone.
+    # Feeding a MATERIALIZED operand is conservative — in the engine the
+    # slab is recomputed from still-fused values, never re-read from
+    # memory.
+    x = jax.random.normal(
+        key, (step_chunk, step_npsr, step_ntoa), jnp.float32
+    )
+
+    def machinery(v):
+        col = numerics.Collector()
+
+        def one(row):
+            col.add("bench.overhead_site", row)
+            return col.take()
+
+        return numerics.reduce_stats(jax.vmap(one)(v))
+
+    m = jax.jit(machinery)
+    fetch = lambda tree: jax.tree_util.tree_map(np.asarray, tree)  # noqa: E731
+    fetch(m(x))
+    ws = []
+    for _ in range(50):
+        t0 = time.perf_counter()
+        fetch(m(x))
+        ws.append(time.perf_counter() - t0)
+    machinery_s = float(np.median(ws))
+
+    overhead_s = machinery_s * sites_per_step
+    fraction = overhead_s / step_wall if step_wall > 0 else 0.0
+    if fraction >= NP_OVERHEAD_GATE and not fast:
+        failures.append(
+            f"overhead: probes cost {100 * fraction:.3f}% of the step "
+            f"({overhead_s * 1e6:.2f} us vs {step_wall:.3f} s) — gate "
+            f"{100 * NP_OVERHEAD_GATE:g}%"
+        )
+    delta = max(0.0, armed_wall - step_wall)
+    return {
+        "machinery_s_per_site": round(machinery_s, 9),
+        "sites_per_step": sites_per_step,
+        "scanned_elements_per_site": scanned,
+        "step_wall_s": round(step_wall, 4),
+        "step_shape": f"{step_npsr}x{step_ntoa}x{step_chunk}",
+        "overhead_fraction": round(fraction, 8),
+        "overhead_gate": NP_OVERHEAD_GATE,
+        "gate_enforced": not fast,
+        "end_to_end_informational": {
+            "armed_wall_s": round(armed_wall, 4),
+            "delta_s": round(delta, 5),
+            "fraction": round(
+                delta / step_wall if step_wall > 0 else 0.0, 6
+            ),
+        },
+    }
+
+
+def run_overflow_arm(npsr, ntoa, failures):
+    """log10_equad=25 overflows the f32 white-noise variance (the
+    ``var + equad**2`` sum — unlike an efac blowup — survives XLA's
+    ``sqrt(x**2) -> |x|`` rewrite): the ledger must name
+    realization.white — the PRODUCING probe site — and no other
+    in-graph site."""
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=7)
+    recipe = Recipe(efac=jnp.ones(npsr), log10_equad=jnp.full(npsr, 25.0))
+    numerics.reset()
+    numerics.arm(drift_every=NO_DRIFT)
+    np.asarray(realize(jax.random.PRNGKey(9), batch, recipe, nreal=4))
+    numerics.flush()
+    snap = numerics.snapshot()
+    numerics.reset()
+    dirty = sorted(
+        site for site, rec in snap["sites"].items() if rec["nonfinite"]
+    )
+    white = snap["sites"].get("realization.white")
+    if white is None or not white["nonfinite"]:
+        failures.append(
+            "overflow: the planted f32 overflow was NOT caught at "
+            f"realization.white (non-finite sites: {dirty})"
+        )
+    elif dirty != ["realization.white"]:
+        failures.append(
+            "overflow: non-finites attributed beyond the producing "
+            f"site: {dirty}"
+        )
+    if white is not None and not white["episodes"]:
+        failures.append(
+            "overflow: no non-finite episode opened at "
+            "realization.white"
+        )
+    return {
+        "nonfinite_sites": dirty,
+        "nonfinite_count": white["nonfinite"] if white else 0,
+        "episodes": white["episodes"] if white else 0,
+    }
+
+
+def run_nan_arm(nreal, chunk, npsr, ntoa, failures):
+    """A drain:nan fault poisons one element AFTER device compute —
+    only the drain seam's host scan can see it, so the ledger must
+    name ``drain`` and no in-graph probe site."""
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=13)
+    recipe = _flagship_recipe(npsr)
+    numerics.reset()
+    numerics.arm(drift_every=NO_DRIFT)
+    with inject.armed(f"{inject.SITE_DRAIN}:nan@chunk=1", seed=5):
+        cube = np.asarray(_run_sweep(
+            "nan", jax.random.PRNGKey(17), batch, recipe, nreal, chunk
+        ))
+    numerics.flush()
+    snap = numerics.snapshot()
+    numerics.reset()
+    dirty = sorted(
+        site for site, rec in snap["sites"].items() if rec["nonfinite"]
+    )
+    drain = snap["sites"].get("drain")
+    planted = int(np.sum(~np.isfinite(cube)))
+    if not planted:
+        failures.append(
+            "nan: the drain:nan fault left no non-finite in the cube — "
+            "the poison never reached the data"
+        )
+    if drain is None or not drain["nonfinite"]:
+        failures.append(
+            "nan: the poisoned chunk was NOT caught at the drain scan "
+            f"(non-finite sites: {dirty})"
+        )
+    elif dirty != ["drain"]:
+        failures.append(
+            "nan: a post-device poison showed up at in-graph sites "
+            f"{dirty} — attribution is wrong"
+        )
+    return {
+        "nonfinite_sites": dirty,
+        "planted_elements": planted,
+        "drain_nonfinite": drain["nonfinite"] if drain else 0,
+    }
+
+
+def run_drift_arm(nreal, chunk, npsr, ntoa, failures):
+    """1-in-1 sampling: every chunk replays realization 0 through the
+    f64 shadow oracle; each family's worst drift must sit within the
+    fuzzer's tolerance."""
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, seed=19)
+    recipe = _flagship_recipe(npsr)
+    numerics.reset()
+    numerics.arm(drift_every=1)
+    _run_sweep("drift", jax.random.PRNGKey(23), batch, recipe, nreal,
+               chunk)
+    numerics.flush()
+    snap = numerics.snapshot()
+    numerics.reset()
+    drift = snap["drift"]
+    if not drift:
+        failures.append(
+            "drift: 1-in-1 sampling recorded no drift families — the "
+            "drain seam never reached the shadow oracle"
+        )
+    for family in ("white", "red"):
+        if family not in drift:
+            failures.append(f"drift: family {family!r} never sampled")
+    for family, rec in drift.items():
+        tol = rec.get("tolerance") or FAMILY_TOLERANCES.get(family)
+        if not rec["samples"]:
+            failures.append(f"drift: family {family!r} has no samples")
+        if tol is not None and rec["worst"] > tol:
+            failures.append(
+                f"drift: family {family!r} drifted {rec['worst']:.3g} "
+                f"> tolerance {tol:g} vs the f64 oracle"
+            )
+    return {
+        family: {
+            "worst": rec["worst"], "samples": rec["samples"],
+            "tolerance": rec["tolerance"],
+        }
+        for family, rec in sorted(drift.items())
+    }
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv[1:]
+    out_path = None
+    if "--out" in sys.argv[1:]:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+    npsr = int(os.environ.get("NP_NPSR", "4"))
+    ntoa = int(os.environ.get("NP_NTOA", "96" if fast else "256"))
+    nreal = int(os.environ.get("NP_NREAL", "8" if fast else "32"))
+    chunk = int(os.environ.get("NP_CHUNK", "4" if fast else "8"))
+    step_npsr = int(os.environ.get("NP_STEP_NPSR", "4" if fast else "8"))
+    step_ntoa = int(os.environ.get("NP_STEP_NTOA",
+                                   "512" if fast else "4096"))
+    step_chunk = int(os.environ.get("NP_STEP_CHUNK",
+                                    "16" if fast else "64"))
+
+    failures = []
+    identity = run_identity_arm(nreal, chunk, npsr, ntoa, failures)
+    overflow = run_overflow_arm(npsr, ntoa, failures)
+    nan = run_nan_arm(nreal, chunk, npsr, ntoa, failures)
+    drift = run_drift_arm(nreal, chunk, npsr, ntoa, failures)
+    overhead = run_overhead_arm(step_npsr, step_ntoa, step_chunk, fast,
+                                failures)
+    numerics.reset()
+
+    rec = {
+        "bench": "numerics_probe",
+        "backend": jax.default_backend(),
+        "fast": fast,
+        "identity": identity,
+        "overflow": overflow,
+        "nan": nan,
+        "drift": drift,
+        "overhead": overhead,
+        "ok": not failures,
+        "failures": failures,
+        **provenance_stamp(
+            EVIDENCE_SCHEMA_VERSION,
+            repo_root=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ),
+        ),
+    }
+    payload = json.dumps(rec)
+    print(payload)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(payload + "\n")
+    for reason in failures:
+        # stdout is routinely /dev/null'd in CI: gate-miss reasons
+        # must reach stderr
+        print(f"numerics_probe GATE MISS: {reason}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

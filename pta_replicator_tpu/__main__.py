@@ -308,6 +308,16 @@ def main(argv=None):
                    help="gate: cumulative relative decline across the "
                         "window below which a monotone drift is not "
                         "flagged (default 0.05)")
+    p = sub.add_parser(
+        "numerics", help="render a capture's precision ledger "
+                         "(numerics.json): per-probe-site non-finite "
+                         "counts, |max| watermarks, overflow headroom "
+                         "in bits, shadow-oracle drift per family, and "
+                         "the per-kernel bf16 ladder-readiness verdict "
+                         "(docs/numerics.md)")
+    p.add_argument("action", choices=("report",),
+                   help="report: pretty-print DIR/numerics.json")
+    p.add_argument("dir", help="the run's --telemetry directory")
     p = sub.choices["realize"]
     p.add_argument("--device-trace", action="store_true",
                    help="also capture an XLA device trace (jax.profiler) "
@@ -519,6 +529,14 @@ def main(argv=None):
             print(summary, file=sys.stderr if rc else sys.stdout)
             if rc:
                 raise SystemExit(rc)
+        return
+    if args.cmd == "numerics":
+        # jax-free like report/watch/perf: the ledger carries its drift
+        # tolerances stamped at sample time, so rendering never needs
+        # the fuzzer (or jax) on the analysis box
+        from .obs import numerics as _numerics
+
+        print(_numerics.render_report(args.dir))
         return
 
     if args.platform:

@@ -49,6 +49,19 @@ the one shape that actually leaks: a buffer that only ever grows.
   with threads, spans in the targets, and no handoff anywhere is the
   orphan shape. Intentionally unstitched workers carry an inline
   ``# graftlint: disable=obs-orphan-thread-span`` with the reason.
+
+* ``obs-unprobed-reduction`` — in the package hot paths (``models/``,
+  ``likelihood/``, ``covariance/``): a jnp/jax ``cholesky``/``slogdet``
+  call whose enclosing function shows no numerics probe
+  (``probe``/``probe_cholesky``/``scan_block``, obs/numerics.py). An
+  indefinite input NaNs whole rows of a Cholesky factor *silently*,
+  and the NaN surfaces three layers downstream as an unattributable
+  NaN lnlike — the exact failure the numerics observatory's identity
+  probes exist to name at the producing site (docs/numerics.md). The
+  numpy f64 oracle factorizations are excluded by construction (the
+  resolved callee must carry jax/jnp); reductions that genuinely
+  cannot go non-finite carry an inline
+  ``# graftlint: disable=obs-unprobed-reduction`` with the reason.
 """
 from __future__ import annotations
 
@@ -333,4 +346,85 @@ class OrphanThreadSpan(Rule):
             )
 
 
-RULES = [UnboundedObsBuffer(), OrphanThreadSpan()]
+#: subtrees whose device reductions the numerics observatory polices —
+#: the hot paths where an f32 factorization NaN surfaces as a silent
+#: NaN lnlike three layers downstream (docs/numerics.md)
+_HOT_PREFIXES = (
+    "pta_replicator_tpu/models/",
+    "pta_replicator_tpu/likelihood/",
+    "pta_replicator_tpu/covariance/",
+)
+#: resolved-callee suffixes that are ill-conditioned reductions: a
+#: cholesky NaNs whole rows on an indefinite input; a slogdet silently
+#: returns -inf/NaN. Both feed logdet terms that poison the likelihood.
+_REDUCTION_SUFFIXES = (".cholesky", ".slogdet")
+#: terminal call names that count as probe evidence in the enclosing
+#: function: the identity probes (obs/numerics.py) and the host-side
+#: block scanner the drain seam runs
+_PROBE_NAMES = {"probe", "probe_cholesky", "scan_block"}
+
+
+def _enclosing_function(mod: Module, node: ast.AST):
+    """Nearest enclosing FunctionDef/AsyncFunctionDef, else None."""
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _function_has_probe(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                _terminal(node.func) in _PROBE_NAMES:
+            return True
+    return False
+
+
+class UnprobedReduction(Rule):
+    id = "obs-unprobed-reduction"
+    severity = "error"
+    description = (
+        "device cholesky/slogdet in a package hot path with no numerics "
+        "probe in the enclosing function — an indefinite input NaNs the "
+        "factorization silently and surfaces as an unattributable NaN "
+        "lnlike; route the result through obs.numerics.probe_cholesky "
+        "(or probe) so the episode names its producing site "
+        "(docs/numerics.md)"
+    )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        if not mod.relpath.startswith(_HOT_PREFIXES):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = mod.resolve(node.func) or ""
+            # jnp/jax-resolved only: the numpy f64 oracle paths
+            # (dense_loglikelihood, dense() pins) are host-side
+            # references a device probe would only add noise to
+            if not resolved.endswith(_REDUCTION_SUFFIXES):
+                continue
+            if "jax" not in resolved and "jnp" not in resolved:
+                continue
+            fn = _enclosing_function(mod, node)
+            if fn is not None and _function_has_probe(fn):
+                continue
+            # suppression window: the call line or the line above it —
+            # same readable homes the cov-f32-cholesky rule accepts
+            if any(
+                self.id in mod.suppressions.get(ln, ())
+                for ln in (node.lineno - 1, node.lineno)
+            ):
+                continue
+            name = resolved.rsplit(".", 1)[-1]
+            yield self.finding(
+                mod, node.lineno,
+                f"{name} in a hot path with no numerics probe in the "
+                "enclosing function: wrap the factor in "
+                "numerics.probe_cholesky(<site>, ...) (or numerics."
+                "probe for a generic reduction), or suppress inline "
+                "with the reason it cannot go non-finite",
+            )
+
+
+RULES = [UnboundedObsBuffer(), OrphanThreadSpan(), UnprobedReduction()]

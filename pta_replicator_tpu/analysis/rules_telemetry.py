@@ -374,6 +374,16 @@ def default_coverage() -> Tuple[Tuple[str, str, str], ...]:
         (f"{pkg}/obs/critpath.py", "metric", n.CRITPATH_STRAGGLERS),
         (f"{pkg}/obs/ledger.py", "metric", n.LEDGER_ROUNDS),
         (f"{pkg}/obs/ledger.py", "metric", n.LEDGER_REGRESSIONS),
+        # numerics observatory (PR 18): the non-finite counter the SLO
+        # layer alerts on, the per-site watermark/headroom gauges, the
+        # shadow-oracle drift gauge, the episode event, and the sampled
+        # drift-replay span that bounds its overhead claim
+        (f"{pkg}/obs/numerics.py", "metric", n.NUMERICS_NONFINITE),
+        (f"{pkg}/obs/numerics.py", "metric", n.NUMERICS_HEADROOM_BITS),
+        (f"{pkg}/obs/numerics.py", "metric", n.NUMERICS_MAX_ABS),
+        (f"{pkg}/obs/numerics.py", "metric", n.NUMERICS_DRIFT),
+        (f"{pkg}/obs/numerics.py", "event", n.EVENT_NUMERICS_EPISODE),
+        (f"{pkg}/obs/numerics.py", "span", n.SPAN_NUMERICS_DRIFT),
         (f"{pkg}/__main__.py", "span", n.SPAN_COMPUTE),
         (f"{pkg}/__main__.py", "span", n.SPAN_INGEST),
         ("bench.py", "span", n.SPAN_BENCH_MEASURE),

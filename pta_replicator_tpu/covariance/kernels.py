@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.scipy.linalg import solve_triangular
 
+from ..obs import numerics
 from ..ops.pallas_cw import cov_syrk_update, cov_tile_update
 
 
@@ -116,6 +117,12 @@ def blocked_cholesky(A, block: int = 128, backend: str = "auto"):
         k0, k1 = k * block, (k + 1) * block
         # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the blocked kernel runs at whatever precision its consumer chose; every consumer is pinned against the f64 dense oracle (tests/test_covariance.py) and the f32 TPU path rides the bench ladder's tolerance gate
         Lkk = jnp.linalg.cholesky(W[:, k0:k1, k0:k1])
+        # numerics observatory: every pivot block's diagonal streams
+        # through ONE aggregated probe site, so a trailing update that
+        # drives a late pivot indefinite (NaN diagonal) is attributed
+        # to the blocked factorization, not its downstream logdet.
+        # Identity when disarmed (obs/numerics.py).
+        Lkk = numerics.probe_cholesky("cov.blocked_pivot", Lkk)
         out = out.at[:, k0:k1, k0:k1].set(Lkk)
         if k1 < nb * block:
             B = W[:, k1:, k0:k1]
@@ -150,7 +157,8 @@ def dense_cholesky(A, block: int = 128, method: str = "auto"):
         method = "blocked" if jax.default_backend() == "tpu" else "xla"
     if method == "xla":
         # graftlint: disable=cov-f32-cholesky  # caller-dtype dispatcher: precision policy is the consumer's (every consumer is pinned against the f64 dense oracle in tests/test_covariance.py)
-        return jnp.linalg.cholesky(A)
+        L = jnp.linalg.cholesky(A)
+        return numerics.probe_cholesky("cov.dense_cholesky", L)
     return blocked_cholesky(A, block=block)
 
 
@@ -204,6 +212,10 @@ def block_tridiag_cholesky(D, E):
         )
         # graftlint: disable=cov-f32-cholesky  # same oracle-pinned caller-dtype contract
         Lk = jnp.linalg.cholesky(S)
+        # one aggregated probe site across every scan step: a late
+        # block column driven indefinite by accumulated Schur updates
+        # shows up here, attributed to the banded factor itself
+        Lk = numerics.probe_cholesky("cov.tridiag_pivot", Lk)
         return Lk, (Lk, M)
 
     init = jnp.tile(jnp.eye(b, dtype=D.dtype), (npsr, 1, 1))
@@ -297,8 +309,13 @@ def kron_cholesky(Ct, Cf):
     epoch-major (row-major) TOA ordering — the Kronecker product of
     lower-triangular factors is lower triangular, and Cholesky factors
     are unique, so the structured factor IS the dense factor."""
+    # Either factor going indefinite breaks the WHOLE Kronecker product,
+    # so the probes keep the temporal/channel factors as separate sites.
     # graftlint: disable=cov-f32-cholesky  # caller-dtype structured factor; pinned vs the f64 dense Kronecker oracle (tests/test_covariance.py)
-    return jnp.linalg.cholesky(Ct), jnp.linalg.cholesky(Cf)
+    Lt = numerics.probe_cholesky("cov.kron_epoch", jnp.linalg.cholesky(Ct))
+    # graftlint: disable=cov-f32-cholesky  # caller-dtype structured factor; pinned vs the f64 dense Kronecker oracle (tests/test_covariance.py)
+    Lf = numerics.probe_cholesky("cov.kron_channel", jnp.linalg.cholesky(Cf))
+    return Lt, Lf
 
 
 def kron_solve(Lt, Lf, X):

@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 
 from . import kernels as K
+from ..obs import numerics
 
 #: fold_in index of the correlated-noise draw on the per-realization
 #: key (models/batched.py realization_delays): the cov family draws
@@ -583,6 +584,11 @@ class LowRankCov(CovOp):
         S = S + jnp.eye(R, dtype=self.U.dtype) / self.phi[:, None, :]
         # graftlint: disable=cov-f32-cholesky  # caller-dtype Woodbury core; pinned vs the f64 dense oracle (tests/test_covariance.py)
         L = jnp.linalg.cholesky(S)
+        # The (R, R) Woodbury core inherits the conditioning of phi:
+        # a tiny prior variance makes I/phi dominate and S near-singular
+        # at f32, so a NaN here names this site instead of surfacing as
+        # a silent NaN solve downstream.
+        L = numerics.probe_cholesky("cov.lowrank_woodbury", L)
         return G, L
 
     def solve(self, x, s2=None):

@@ -43,7 +43,8 @@ Schedule grammar (one spec per fault, ``;``-separated)::
 
     site:kind[=param]@trigger[xN]
 
-    kinds    raise | fatal | stall=SECONDS | torn | enospc | device_lost
+    kinds    raise | fatal | stall=SECONDS | torn | enospc |
+             device_lost | nan
     triggers chunk=K | call=N | p=P        (p uses the schedule seed)
     xN       fire up to N times (default 1 — one-shot, recoverable)
 
@@ -52,7 +53,18 @@ readback), ``checkpoint_write:torn@call=3`` (truncate the 3rd
 checkpoint temp file mid-write), ``drain:stall=4@chunk=1`` (wedge chunk
 1's readback long enough to trip the sweep's ``DrainTimeout``),
 ``cw_stream_stage:device_lost@p=0.1x3`` (seeded 10% device-lost per
-staged tile, at most 3 firings).
+staged tile, at most 3 firings), ``drain:nan@chunk=2`` (silently poison
+one seeded element of chunk 2's fetched block).
+
+``nan`` is the one DATA-CORRUPTION kind: it raises nothing — it
+overwrites one seeded element of the in-flight chunk block with NaN at
+the ``drain`` site (:func:`poison`, wired into utils/sweep's readback).
+Silent corruption is deliberately NOT recoverable by the retry
+machinery (there is no exception to classify; a retry would persist
+the same poisoned bytes) — what it exercises is the numerics
+observatory's host-side drain scan (obs.numerics.scan_block), the only
+layer that can catch it (benchmarks/numerics_probe.py pins that it
+does).
 
 stdlib-only and jax-free; telemetry imports are deferred to the firing
 branch so a disarmed process never pays them.
@@ -85,7 +97,7 @@ SITES = frozenset({
 })
 
 KINDS = frozenset({
-    "raise", "fatal", "stall", "torn", "enospc", "device_lost",
+    "raise", "fatal", "stall", "torn", "enospc", "device_lost", "nan",
 })
 
 
@@ -171,6 +183,11 @@ def parse_schedule(text: str) -> List[FaultSpec]:
                 raise ValueError(
                     "torn faults need a file to tear — only the "
                     "checkpoint_write/checkpoint_fsync sites support them"
+                )
+            if kind == "nan" and site != SITE_DRAIN:
+                raise ValueError(
+                    "nan faults need an in-flight chunk block to "
+                    "poison — only the drain site supports them"
                 )
             max_fires = 1
             trig = trig.strip()
@@ -316,12 +333,17 @@ def fire(site: str, **ctx) -> None:
         # every matching spec's call counter advances for every call at
         # its site, INDEPENDENT of whether some other spec fires on
         # this call — a firing must not shift later specs' "Nth call"
-        # triggers (two call=N specs at one site fire at exactly N)
+        # triggers (two call=N specs at one site fire at exactly N).
+        # `nan` specs are poison()'s alone: fire() is called at the
+        # drain site BEFORE the fetch (there is no block to poison
+        # yet), so counting or matching them here would double-advance
+        # their call counters and mis-fire them as a bare raise.
         for spec in state.specs:
-            if spec.site == site:
+            if spec.site == site and spec.kind != "nan":
                 spec.calls += 1
         for k, spec in enumerate(state.specs):
-            if spec.site != site or spec.fires >= spec.max_fires:
+            if (spec.site != site or spec.kind == "nan"
+                    or spec.fires >= spec.max_fires):
                 continue
             if spec.chunk is not None:
                 hit = index is not None and int(index) == spec.chunk
@@ -361,6 +383,75 @@ def fire(site: str, **ctx) -> None:
     if action.kind == "fatal":
         raise InjectedFault(site, "fatal", transient=False)
     raise InjectedFault(site, "raise", transient=True)
+
+
+def _poison_array(arr, rng: random.Random):
+    """One seeded element of ``arr`` overwritten with NaN, on a COPY —
+    the fetched block may alias a buffer the reader still owns."""
+    import numpy as np
+
+    arr = np.array(arr, copy=True)
+    if arr.size and np.issubdtype(arr.dtype, np.floating):
+        arr.reshape(-1)[rng.randrange(arr.size)] = np.nan
+    return arr
+
+
+def poison(site: str, block, **ctx):
+    """The data-corruption injection point. Disarmed: one ``None``
+    check, ``block`` passes through untouched (the production drain
+    path's entire cost).
+
+    Armed: the first matching ``nan`` spec for this site overwrites ONE
+    seeded element of ``block`` (an ndarray, or the first shard of a
+    ``utils.sweep.ShardedBlock``) with NaN and returns the poisoned
+    copy — silent corruption, no exception, nothing for the retry
+    classifier to absorb. The only layer that can catch it is the
+    numerics observatory's host drain scan (obs.numerics.scan_block),
+    which is exactly what the planted-NaN evidence arm exercises
+    (benchmarks/numerics_probe.py). Triggers and the seeded per-spec
+    RNG work exactly as :func:`fire`'s; the two surfaces are disjoint
+    by kind (``nan`` here, everything else there)."""
+    state = _STATE
+    if state is None:
+        return block
+    index = ctx.get("chunk", ctx.get("tile"))
+    action = None
+    rng = None
+    with state.lock:
+        for spec in state.specs:
+            if spec.site == site and spec.kind == "nan":
+                spec.calls += 1
+        for k, spec in enumerate(state.specs):
+            if (spec.site != site or spec.kind != "nan"
+                    or spec.fires >= spec.max_fires):
+                continue
+            if spec.chunk is not None:
+                hit = index is not None and int(index) == spec.chunk
+            elif spec.call is not None:
+                hit = spec.calls == spec.call
+            else:
+                hit = state.rngs[k].random() < spec.p
+            if not hit:
+                continue
+            spec.fires += 1
+            action = spec
+            rng = state.rngs[k]
+            state._record({
+                "site": site, "kind": "nan", "spec": spec.spec_str(),
+                "chunk": None if index is None else int(index),
+                "call": spec.calls,
+            })
+            break
+    if action is None:
+        return block
+    _emit(site, action, index)
+    shards = getattr(block, "shards", None)
+    if shards is not None:  # utils.sweep.ShardedBlock: poison shard 0
+        if shards:
+            idx0, arr0 = shards[0]
+            shards[0] = (idx0, _poison_array(arr0, rng))
+        return block
+    return _poison_array(block, rng)
 
 
 def _emit(site: str, spec: FaultSpec, index) -> None:

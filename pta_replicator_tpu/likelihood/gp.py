@@ -64,6 +64,12 @@ from jax.scipy.linalg import cho_solve, solve_triangular
 from ..batch import PulsarBatch
 from ..covariance.kernels import _chol_logdet
 from ..models.batched import Recipe, gls_noise_model, white_ecorr_solver
+# numerics observatory: the (R, R)/(ktm, ktm) Cholesky diagonals below
+# pass through identity probes so an indefinite S (NaN rows from f32
+# conditioning loss) names its factorization site instead of surfacing
+# as a silent NaN lnlike. Disarmed, probe_cholesky returns its factor
+# untouched before importing jax machinery (obs/numerics.py).
+from ..obs import numerics
 
 _LOG_2PI = float(np.log(2.0 * np.pi))
 
@@ -141,6 +147,7 @@ def loglikelihood(
         phi_safe = jnp.where(phi > 0, phi, 1.0)
         S = S + jnp.eye(U.shape[-1], dtype=dtype) / phi_safe[:, None, :]
         L = jnp.linalg.cholesky(S)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
+        L = numerics.probe_cholesky("gp.chol_rank", L)
         b = jnp.einsum("pnr,pn->pr", U, x0, precision="highest")
         z = solve_triangular(L, b[..., None], lower=True)[..., 0]  # graftlint: disable=cov-f32-cholesky  # same oracle-pinned contract as the factor above
         quad = quad - jnp.sum(z * z, axis=-1)
@@ -177,6 +184,7 @@ def loglikelihood(
             dtype
         )
         La = jnp.linalg.cholesky(A)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
+        La = numerics.probe_cholesky("gp.chol_tm", La)
         bm = jnp.einsum("pnk,pn->pk", Mn, w, precision="highest")
         zm = solve_triangular(La, bm[..., None], lower=True)[..., 0]  # graftlint: disable=cov-f32-cholesky  # same oracle-pinned contract as the factor above
         quad = quad - jnp.sum(zm * zm, axis=-1)
@@ -360,6 +368,7 @@ class ReducedGP:
         )
         S = TNT_uu + jnp.eye(self.ngp, dtype=dtype) / phi_safe[:, None, :]
         L = jnp.linalg.cholesky(S)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
+        L = numerics.probe_cholesky("gp.reduced_chol_rank", L)
         d_u = proj.d[:, k:] * active
         z = solve_triangular(L, d_u[..., None], lower=True)[..., 0]  # graftlint: disable=cov-f32-cholesky  # same oracle-pinned contract as the factor above
         quad = proj.rNr - jnp.sum(z * z, axis=-1)
@@ -376,6 +385,7 @@ class ReducedGP:
                 :, None, :
             ].astype(dtype)
             La = jnp.linalg.cholesky(A)  # graftlint: disable=cov-f32-cholesky  # caller-dtype by design: the rank-reduced hot path runs at the residual dtype; f32 use is validated against the f64 dense oracle (tests/test_likelihood.py) and map_fit documents its f64 requirement
+            La = numerics.probe_cholesky("gp.reduced_chol_tm", La)
             bm = proj.d[:, :k] - jnp.einsum(
                 "pkr,pr->pk", TNT_mu,
                 cho_solve((L, True), d_u[..., None])[..., 0],

@@ -708,7 +708,7 @@ def cw_stream_response(
     static_delays` to hand to the sharded engines without a host
     round-trip.
     """
-    from ..obs import gauge, names, span
+    from ..obs import gauge, names, numerics, span
     from ..parallel.prefetch import prefetch_to_device
 
     if tiles_per_step < 1:
@@ -796,7 +796,11 @@ def cw_stream_response(
         sp["macros"] = nmacros
         sp["tiles"] = ntiles
         sp["tiles_per_step"] = tiles_per_step
-    return acc * batch.mask
+    # numerics observatory seam: the streamed accumulator is the one
+    # place a whole catalog's f32 accumulation order concentrates —
+    # an overflowing tile surfaces here, not per-source. Identity (and
+    # compiled out entirely) while disarmed; see obs/numerics.py.
+    return numerics.probe("cw.stream_tile", acc * batch.mask)
 
 
 def cgw_catalog_delays_streamed(
@@ -1311,21 +1315,33 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
     ``fold_in(key, covariance.COV_STREAM_FOLD)`` instead of a widened
     split, so enabling it leaves every family's stream bit-identical
     (pinned by tests/test_covariance.py)."""
+    # numerics observatory seams: each enabled family's (Np, Nt) output
+    # passes through an identity probe (obs/numerics.py) that, when
+    # armed, streams non-finite counts and overflow watermarks to the
+    # host per SITE — so an inf lands on the family that produced it,
+    # not on the summed total three ops later. Disarmed (the default)
+    # the probe returns its argument before touching jax: this function
+    # traces to today's graph, bitwise (pinned by tests/test_numerics).
+    from ..obs import numerics
+
     k_wn, k_ec, k_rn, k_chrom, k_gwb = jax.random.split(key, 5)
     total = jnp.zeros(batch.toas_s.shape, batch.toas_s.dtype)
     if recipe.efac is not None or recipe.log10_equad is not None:
-        total = total + white_noise_delays(
+        total = total + numerics.probe("realization.white", white_noise_delays(
             k_wn,
             batch,
             efac=recipe.efac if recipe.efac is not None else 1.0,
             log10_equad=recipe.log10_equad,
             tnequad=recipe.tnequad,
             rows=rows,
-        )
+        ))
     if recipe.log10_ecorr is not None:
-        total = total + jitter_delays(k_ec, batch, recipe.log10_ecorr, rows=rows)
+        total = total + numerics.probe(
+            "realization.ecorr",
+            jitter_delays(k_ec, batch, recipe.log10_ecorr, rows=rows),
+        )
     if recipe.rn_log10_amplitude is not None:
-        total = total + red_noise_delays(
+        total = total + numerics.probe("realization.red", red_noise_delays(
             k_rn,
             batch,
             recipe.rn_log10_amplitude,
@@ -1339,9 +1355,9 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
             libstempo_convention=recipe.rn_libstempo,
             tspan_s=recipe.rn_tspan_s,
             rows=rows,
-        )
+        ))
     if recipe.chrom_log10_amplitude is not None:
-        total = total + chromatic_noise_delays(
+        total = total + numerics.probe("realization.chromatic", chromatic_noise_delays(
             k_chrom,
             batch,
             recipe.chrom_log10_amplitude,
@@ -1352,7 +1368,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
             nmodes=recipe.chrom_nmodes,
             ref_freq_mhz=recipe.chrom_ref_freq_mhz,
             rows=rows,
-        )
+        ))
     if recipe.gwb_log10_amplitude is not None or recipe.gwb_user_spectrum is not None:
         if recipe.orf_cholesky is None:
             # uncorrelated common process: ORF = 2*I (the reference's
@@ -1360,7 +1376,7 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
             orf_chol = jnp.sqrt(2.0) * jnp.eye(batch.npsr, dtype=batch.toas_s.dtype)
         else:
             orf_chol = recipe.orf_cholesky
-        total = total + gwb_delays(
+        total = total + numerics.probe("realization.gwb", gwb_delays(
             k_gwb,
             batch,
             recipe.gwb_log10_amplitude,
@@ -1374,14 +1390,17 @@ def realization_delays(key, batch: PulsarBatch, recipe: Recipe, rows=None):
             beta=recipe.gwb_beta,
             power=recipe.gwb_power,
             synthesis_precision=recipe.gwb_synthesis_precision,
-        )
+        ))
     if recipe.noise_cov is not None:
         from ..covariance.structure import COV_STREAM_FOLD, recipe_cov_s2
 
         k_cov = jax.random.fold_in(key, COV_STREAM_FOLD)
-        total = total + recipe.noise_cov.sample(
-            k_cov, s2=recipe_cov_s2(recipe, total.dtype), rows=rows
-        ) * batch.mask
+        total = total + numerics.probe(
+            "realization.covariance",
+            recipe.noise_cov.sample(
+                k_cov, s2=recipe_cov_s2(recipe, total.dtype), rows=rows
+            ) * batch.mask,
+        )
     return total
 
 
@@ -1554,7 +1573,16 @@ def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype,
     solve and the determinant are exact with no dense (Nt, E) one-hot
     ever materialized:
     log det C0 = sum_t log sigma2_t + sum_e log(1 + ecorr2_e s_e)."""
-    winv = jnp.where(batch.mask > 0, 1.0 / sigma2, 0.0)  # N^-1 diagonal
+    # numerics seams: winv overflows f32 first when a sigma2 underflows
+    # (1/sigma2 before the masked logdet ever sees it), and logdet_c0
+    # is the scalar that silently NaNs a whole pulsar's likelihood —
+    # both probed per-site so a corrupt solve names THIS solver, not
+    # the downstream lnlike. Identity while disarmed (obs/numerics.py).
+    from ..obs import numerics
+
+    winv = numerics.probe(
+        "solver.winv", jnp.where(batch.mask > 0, 1.0 / sigma2, 0.0)
+    )  # N^-1 diagonal
     if extra is not None:
         from ..covariance.structure import (
             BandedCov,
@@ -1571,7 +1599,9 @@ def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype,
             c0inv_mat, logdet_c0 = dense_combined_solver(
                 batch, safe_sigma2, ecorr2, extra, extra_s2, dtype
             )
-        return winv, c0inv_mat, logdet_c0
+        return winv, c0inv_mat, numerics.probe(
+            "solver.logdet_c0", logdet_c0
+        )
     psr_rows = jnp.arange(batch.npsr)[:, None]
 
     def seg_sum(x):
@@ -1607,7 +1637,7 @@ def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype,
         logdet_c0 = logdet_c0 + jnp.sum(
             jnp.log1p(ecorr2 * s_e) * batch.epoch_mask, axis=-1
         )
-    return winv, c0inv_mat, logdet_c0
+    return winv, c0inv_mat, numerics.probe("solver.logdet_c0", logdet_c0)
 
 
 def _gls_design_system(batch: PulsarBatch, design, recipe: "Recipe",
@@ -1910,7 +1940,7 @@ def deterministic_delays(batch: PulsarBatch, recipe: Recipe, mesh=None):
 
 def realize_block(
     keys, batch: PulsarBatch, recipe: Recipe, fit: bool, rows=None,
-    static=None,
+    static=None, collect: bool = False,
 ):
     """The per-block realization pipeline: vmap of
     ``realization_delays + static -> finalize_residuals`` over a key
@@ -1919,15 +1949,38 @@ def realize_block(
     pipeline cannot silently diverge between paths.
 
     ``rows=(npsr_global, row_start)`` makes every stochastic draw an
-    exact row window of the global stream (pulsar-sharded shard_map)."""
+    exact row window of the global stream (pulsar-sharded shard_map).
+
+    ``collect=True`` (the armed single-device engine) threads the
+    numerics observatory's donated stats buffer through the outputs:
+    probes hit inside the vmap stage their stat scalars in a trace-
+    local collector instead of emitting host callbacks, and the return
+    becomes ``(residuals, {site: (nonfinite, max_abs, min_nonzero)})``
+    with the per-realization stats reduced in-graph. Mesh engines keep
+    the default (probe callbacks are shard_map-safe; a donated buffer
+    is not, per-shard partials have no replicated out_spec)."""
     if static is None:
         static = deterministic_delays(batch, recipe)
 
-    def one(k):
-        d = realization_delays(k, batch, recipe, rows=rows) + static
-        return finalize_residuals(d, batch, recipe, fit)
+    if not collect:
+        def one(k):
+            d = realization_delays(k, batch, recipe, rows=rows) + static
+            return finalize_residuals(d, batch, recipe, fit)
 
-    return jax.vmap(one)(keys)
+        return jax.vmap(one)(keys)
+
+    from ..obs import numerics
+
+    col = numerics.Collector()
+
+    def one(k):
+        with numerics.collecting(col):
+            d = realization_delays(k, batch, recipe, rows=rows) + static
+            out = finalize_residuals(d, batch, recipe, fit)
+            return out, col.take()
+
+    out, stats = jax.vmap(one)(keys)
+    return out, numerics.reduce_stats(stats)
 
 
 def donate_keys_argnums(platform: str) -> tuple:
@@ -1959,9 +2012,18 @@ def _realize_engine(fit: bool, donate_keys: bool):
     never donated).
     """
     from ..obs import instrumented_jit, names
+    from ..obs import numerics
 
     def run(keys, batch, recipe, static):
-        return realize_block(keys, batch, recipe, fit, static=static)
+        # trace-time branch, same contract as the probes themselves:
+        # arming clears the compile caches, so this body re-traces with
+        # the current armed state and the donated stats buffer appears
+        # exactly when the probes do
+        if numerics.collector_default():
+            return realize_block(
+                keys, batch, recipe, fit, static=static, collect=True
+            )
+        return realize_block(keys, batch, recipe, fit, static=static), {}
 
     return instrumented_jit(
         run,
@@ -2001,4 +2063,12 @@ def realize(
     if static is None:
         static = deterministic_delays(batch, recipe)
     donate = bool(donate_keys_argnums(jax.default_backend()))
-    return _realize_engine(fit, donate)(keys, batch, recipe, static)
+    out, stats = _realize_engine(fit, donate)(keys, batch, recipe, static)
+    if stats:
+        # the armed engine's donated stats buffer: queue the UN-FETCHED
+        # scalars for the chunk drain (obs.numerics.on_drain/flush) —
+        # fetching here would fence the async dispatch
+        from ..obs import numerics
+
+        numerics.stash_step_stats(stats, nreal)
+    return out

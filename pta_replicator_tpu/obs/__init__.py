@@ -34,6 +34,7 @@ from . import (
     ledger,
     metrics,
     names,
+    numerics,
     occupancy,
     regress,
     report,
@@ -75,7 +76,7 @@ __all__ = [
     "telemetry_summary", "reset_all", "metrics", "trace", "report",
     "jaxhooks", "flightrec", "regress", "FlightRecorder", "StallWarning",
     "names", "devprof", "occupancy", "series", "timeline", "serve",
-    "slo", "critpath", "ledger",
+    "slo", "critpath", "ledger", "numerics",
     "TraceContext", "adopt", "carry", "current_trace",
 ]
 
@@ -130,12 +131,16 @@ def start_capture(
     for stale_artifact in ("progress.json", "postmortem.json",
                            "series.json", "series.jsonl",
                            "timeline.json", "metrics.prom", "slo.json",
-                           "critpath.json"):
+                           "critpath.json", "numerics.json"):
         try:
             _os.remove(_os.path.join(directory, stale_artifact))
         except OSError:
             pass
     jaxhooks.install()
+    # PTA_NUMERICS=1 arms the numerics observatory for this capture —
+    # here, before any engine compiles, so the probes are in the first
+    # traced graph (no cache clear needed; see obs/numerics.py)
+    numerics.arm_from_env()
     if flight_recorder:
         flightrec.FlightRecorder(
             directory,
@@ -237,3 +242,4 @@ def reset_all() -> None:
     TRACER.reset()
     REGISTRY.reset()
     devprof.reset()
+    numerics.reset()

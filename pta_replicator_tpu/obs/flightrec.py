@@ -60,7 +60,8 @@ import traceback
 import warnings
 from typing import Optional
 
-from . import names, occupancy, series as series_mod, slo as slo_mod
+from . import names, numerics as numerics_mod, occupancy
+from . import series as series_mod, slo as slo_mod
 from . import trace as trace_mod
 from .jaxhooks import device_memory_snapshot
 from .metrics import REGISTRY
@@ -74,9 +75,12 @@ from .trace import TRACER
 #: budget + burn rates from the obs.slo engine; empty objectives when
 #: no SLO is configured) and the postmortem's "open_traces" list
 #: (request traces submitted but never resolved — the in-flight
-#: requests a killed serving process took with it). Readers stay
-#: tolerant of older files.
-PROGRESS_SCHEMA_VERSION = 4
+#: requests a killed serving process took with it); v5 adds the
+#: "numerics" block (the numerics observatory's compact health rollup:
+#: armed flag, total non-finite elements, active non-finite episodes,
+#: worst per-site overflow headroom in bits — obs/numerics.py). Readers
+#: stay tolerant of older files.
+PROGRESS_SCHEMA_VERSION = 5
 
 #: Required fields (and JSON types) of progress.json — the heartbeat
 #: contract consumed by the ``watch`` subcommand and validated by
@@ -92,6 +96,7 @@ PROGRESS_SCHEMA = {
     "occupancy": dict,      # {"stages": {name: duty}, "bottleneck": ...}
     "trends": dict,         # {series: {latest, rate_per_s, trend}}
     "slo": dict,            # {"objectives": {...}, "breached": [...]}
+    "numerics": dict,       # armed/nonfinite/episodes_active/headroom
     "jax": dict,            # compiles / traces counters
     "stalls": float,        # flightrec.stalls counter
     "finished": bool,       # True only in the final heartbeat
@@ -393,6 +398,11 @@ class FlightRecorder:
                 os.path.join(self.directory, "slo.json"),
                 json.dumps(self.slo.status(), default=repr),
             )
+        if numerics_mod.is_armed():
+            # the precision ledger's live surface (/numerics scrape +
+            # the /readyz non-finite rung + `numerics report`); absent
+            # when the observatory never armed, same honesty contract
+            numerics_mod.write(self.directory)
 
     def _sweep_block(self, metrics=None) -> dict:
         snap = {}
@@ -492,6 +502,7 @@ class FlightRecorder:
             "slo": self.slo.heartbeat_block(
                 timeout=1.0 if emergency else None
             ),
+            "numerics": numerics_mod.heartbeat_block(),
             "jax": {
                 name.split(".", 1)[1]: val
                 for name in (names.JAX_COMPILES, names.JAX_TRACES)

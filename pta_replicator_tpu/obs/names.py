@@ -125,6 +125,13 @@ SPAN_DEVICE_TRACE = "device_trace"
 #: attribution layer adds zero hot-path time
 SPAN_CRITPATH_ANALYZE = "critpath_analyze"
 
+#: one shadow-oracle drift sample (obs/numerics.py on_drain): a
+#: 1-in-N-chunks replay of one realization's PRNG streams through the
+#: fuzzer's f64 oracle paths — the span makes the sampler's cost
+#: visible in the capture (it rides the drain, off the device's
+#: critical path, but it is NOT free)
+SPAN_NUMERICS_DRIFT = "numerics_drift_sample"
+
 SPANS = frozenset({
     SPAN_FREEZE, SPAN_MAKE_IDEAL, SPAN_LOAD_PULSARS, SPAN_ORACLE_FIT,
     SPAN_READ_PAR, SPAN_READ_TIM, SPAN_DESIGN_TENSOR,
@@ -148,6 +155,7 @@ SPANS = frozenset({
     SPAN_BENCH_MEASURE, SPAN_BENCH_SWEEP_AB,
     SPAN_DEVICE_TRACE,
     SPAN_CRITPATH_ANALYZE,
+    SPAN_NUMERICS_DRIFT,
 })
 
 # -------------------------------------------------------------- events
@@ -177,11 +185,17 @@ EVENT_SLO_BREACH = "slo.breach"
 EVENT_LIKELIHOOD_REJECTED = "likelihood.rejected"
 EVENT_LIKELIHOOD_DEADLINE_EXPIRED = "likelihood.deadline_expired"
 
+#: a probe site opened a non-finite episode (obs/numerics.py): the
+#: first NaN/Inf seen at a clean site — once per episode, re-armed
+#: after EPISODE_CLEAR_AFTER clean calls, mirrored into /readyz
+EVENT_NUMERICS_EPISODE = "numerics.nonfinite_episode"
+
 EVENTS = frozenset({
     EVENT_FLIGHTREC_STALL, EVENT_DEVICE_TRACE,
     EVENT_FAULT_FIRED, EVENT_FAULT_RETRY,
     EVENT_SLO_BREACH,
     EVENT_LIKELIHOOD_REJECTED, EVENT_LIKELIHOOD_DEADLINE_EXPIRED,
+    EVENT_NUMERICS_EPISODE,
 })
 
 # ------------------------------------------------------------- metrics
@@ -323,6 +337,18 @@ CRITPATH_STRAGGLERS = "critpath.stragglers"
 LEDGER_ROUNDS = "ledger.rounds"
 LEDGER_REGRESSIONS = "ledger.regressions"
 
+# numerics observatory (obs/numerics.py): non-finite elements seen by
+# any probe (the SLO-able corruption counter — unlabeled total plus a
+# site= labeled instance per probe site), the per-site overflow margin
+# in bits (distance of the |max| watermark to the dtype's finfo.max —
+# the bf16-ladder headroom gauge), the per-site |max| watermark, and
+# the per-family relative drift vs the f64 shadow oracle (labeled
+# family=, sampled 1-in-N chunks)
+NUMERICS_NONFINITE = "numerics.nonfinite"
+NUMERICS_HEADROOM_BITS = "numerics.headroom_bits"
+NUMERICS_MAX_ABS = "numerics.max_abs"
+NUMERICS_DRIFT = "numerics.drift"
+
 # jax accounting (obs/jaxhooks.py)
 JAX_COMPILES = "jax.compiles"
 JAX_COMPILE_S = "jax.compile_s"
@@ -360,6 +386,8 @@ METRICS = frozenset({
     OCCUPANCY_DUTY_CYCLE, OCCUPANCY_BUSY_S,
     CRITPATH_CHUNKS, CRITPATH_STRAGGLERS,
     LEDGER_ROUNDS, LEDGER_REGRESSIONS,
+    NUMERICS_NONFINITE, NUMERICS_HEADROOM_BITS, NUMERICS_MAX_ABS,
+    NUMERICS_DRIFT,
     JAX_COMPILES, JAX_COMPILE_S, JAX_TRACES, JAX_TRACE_S, JAX_LOWERING_S,
     JAX_TRACE_COUNT,
 })
@@ -397,6 +425,7 @@ TRACE_PREFIX = "trace."
 OCCUPANCY_PREFIX = "occupancy."
 CRITPATH_PREFIX = "critpath."
 LEDGER_PREFIX = "ledger."
+NUMERICS_PREFIX = "numerics."
 OBS_PREFIX = "obs."
 PROC_PREFIX = "proc."
 

@@ -1,0 +1,926 @@
+"""Numerics observatory: streaming tensor-health telemetry, shadow-
+oracle drift probes, and the per-kernel precision ledger.
+
+ROADMAP open item 5 wants a mixed-precision ladder (bf16 compute / f32
+accumulate) over the fused likelihood kernels — but a precision move is
+only safe to chase if numerical health is *measured*, not assumed: a
+NaN born inside a jitted engine otherwise surfaces (if ever) as a
+silently wrong sweep cube. This module is the measuring instrument,
+riding the existing capture stack (docs/numerics.md):
+
+* :func:`probe` — an in-graph health probe, **identity on the data
+  path**. Armed, it accumulates per-probe-site non-finite counts,
+  |max| / |min-nonzero| dynamic-range watermarks, and the overflow
+  margin (distance of |max| to the dtype's ``finfo.max``, in bits)
+  through a ``jax.debug.callback`` whose side effects land with the
+  chunk drain. Disarmed — the default — ``probe(name, x)`` literally
+  ``return x`` before touching jax, so the disarmed graph is bitwise
+  today's graph (pinned by tests/test_numerics.py).
+* :func:`sample_drift` / :func:`on_drain` — low-rate shadow-oracle
+  drift sampling: 1-in-N chunks (seeded) replay one realization's PRNG
+  streams through the fuzzer's existing f64 oracle paths
+  (``scenarios/fuzz.py`` — reused, not duplicated) and record per-
+  family relative drift as ``numerics.drift{family=}`` series.
+* the **precision ledger** — per-site rollups (worst drift, watermarks,
+  non-finite episodes, headroom-in-bits) persisted as ``numerics.json``
+  in the capture dir by the flight recorder, folded into heartbeat /
+  report / watch / ``/metrics``, plus the ``numerics report DIR`` CLI
+  that prints the per-kernel bf16-readiness verdict ("ladder-ready"
+  iff headroom >= :data:`LADDER_HEADROOM_BITS` bits, zero non-finites,
+  and drift within the family tolerance).
+
+Arming contract (the jit-cache hazard): :func:`probe`'s armed/disarmed
+decision happens at TRACE time, and the engines cache their compiled
+graphs (``models.batched._realize_engine`` is lru_cached over an
+``instrumented_jit``). Arming after a graph compiled has no effect on
+it — so :func:`arm` / :func:`disarm` clear jax's compilation caches by
+default (``clear_caches=False`` opts out when the caller knows nothing
+is compiled yet, e.g. arming from the environment at process start).
+
+This module imports jax lazily and only on armed paths: the report /
+watch / serve CLI tools stay jax-free.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import random
+import sys
+import threading
+from functools import lru_cache as _functools_lru_cache
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import names
+from .metrics import counter, gauge
+from .trace import event
+
+NUMERICS_SCHEMA_VERSION = 1
+
+#: headroom (bits of dynamic range left to the dtype's finfo.max) a
+#: probe site must keep to be judged ready for the bf16 ladder — 8 bits
+#: covers bf16's truncated mantissa plus blocked-reduction growth
+LADDER_HEADROOM_BITS = 8.0
+
+#: consecutive clean probe calls at a site before an open non-finite
+#: episode clears (re-arming the /readyz rung)
+EPISODE_CLEAR_AFTER = 3
+
+#: default shadow-oracle sampling rate: one chunk in N
+DRIFT_EVERY = 16
+
+#: per-call element cap on the in-graph reductions: a probe scans the
+#: leading PROBE_SAMPLE_CAP elements of the raveled array (= the leading
+#: realizations of a (nreal, ...) cube — the same slice the shadow
+#: oracle replays). Reducing the full array costs O(step) and collapses
+#: XLA fusion (measured 91% step overhead at the flagship shape); the
+#: capped prefix is statistically zero (<1%). The chunk drain's
+#: :func:`scan_block` stays the exact full-data non-finite backstop.
+PROBE_SAMPLE_CAP = 65536
+
+#: collector-mode per-invocation cap: one probe invocation is ONE
+#: realization's family output, so the slab is the leading elements of
+#: the leading pulsar row of every realization — orthogonal coverage
+#: to the shadow oracle (realization 0, all elements, exact f64
+#: compare) and the drain scan (the whole summed cube, exact). A full
+#: per-realization reduction materializes the otherwise-fused family
+#: arrays and costs ~80%% of the flagship step; the slab's reductions
+#: are what the overhead gate prices (benchmarks/numerics_probe.py:
+#: ~150 us/site at this cap vs a ~90 ms flagship step).
+PROBE_SAMPLE_CAP_COLLECT = 1024
+
+_LOCK = threading.RLock()
+_ARMED = False
+_DRIFT_EVERY = DRIFT_EVERY
+_DRIFT_SEED = 0
+
+#: per-probe-site rollups; bounded by the static set of probe sites
+#: wired into the engines (one entry per distinct site name)
+_SITES: Dict[str, dict] = {}
+#: per-family worst relative drift vs the f64 oracle; bounded by the
+#: fuzzer's fixed family vocabulary
+_DRIFT: Dict[str, dict] = {}
+#: trace-time static metadata per probe site (scanned-elements-per-
+#: invocation, log2(finfo.max), dtype) — written when a probe traces
+#: in collector mode, read back when its donated stats drain
+_SITE_META: Dict[str, tuple] = {}
+#: donated stats buffers dispatched but not yet folded into the ledger:
+#: (stats pytree of unfetched device scalars, per-site element counts)
+_PENDING: List[tuple] = []
+_PENDING_MAX = 512
+#: trace-local collector stack (collector mode is per-thread because
+#: tracing is)
+_TLS = threading.local()
+
+
+def is_armed() -> bool:
+    return _ARMED
+
+
+def arm(drift_every: Optional[int] = None, drift_seed: int = 0,
+        clear_caches: bool = True) -> None:
+    """Arm the observatory: probes start accumulating, the drain hook
+    starts scanning and drift-sampling. ``drift_every`` sets the
+    1-in-N shadow-oracle rate (None keeps :data:`DRIFT_EVERY`);
+    ``drift_seed`` seeds which chunk offset is sampled.
+
+    ``clear_caches`` (default True) clears jax's compilation caches so
+    already-compiled engines re-trace WITH the probes — without it, a
+    graph compiled before arming silently stays unprobed."""
+    global _ARMED, _DRIFT_EVERY, _DRIFT_SEED
+    with _LOCK:
+        _ARMED = True
+        if drift_every is not None:
+            _DRIFT_EVERY = max(1, int(drift_every))
+        _DRIFT_SEED = int(drift_seed)
+    if clear_caches:
+        _clear_jax_caches()
+
+
+def disarm(clear_caches: bool = True) -> None:
+    """Disarm: probes compile back out (``clear_caches`` re-traces the
+    engines so the next graph is bitwise the unprobed one); the ledger
+    keeps its accumulated state until :func:`reset`."""
+    global _ARMED
+    with _LOCK:
+        _ARMED = False
+    if clear_caches:
+        _clear_jax_caches()
+
+
+def arm_from_env(env: Optional[dict] = None) -> bool:
+    """Arm from ``PTA_NUMERICS=1`` (rate: ``PTA_NUMERICS_DRIFT_EVERY``,
+    seed: ``PTA_NUMERICS_SEED``) — called by ``obs.start_capture`` so a
+    capture of any entry point can be observed without code changes.
+    Runs before the engines compile, so no cache clear is needed."""
+    env = os.environ if env is None else env
+    if env.get("PTA_NUMERICS", "").strip() not in ("1", "true", "on"):
+        return False
+    every = env.get("PTA_NUMERICS_DRIFT_EVERY")
+    arm(
+        drift_every=int(every) if every else None,
+        drift_seed=int(env.get("PTA_NUMERICS_SEED", "0") or 0),
+        clear_caches="jax" in sys.modules,
+    )
+    return True
+
+
+def reset() -> None:
+    """Clear the ledger and disarm (tests; ``obs.reset_all``). Does not
+    clear jax caches — a fresh arm() will."""
+    global _ARMED
+    with _LOCK:
+        _ARMED = False
+        _SITES.clear()
+        _DRIFT.clear()
+        _SITE_META.clear()
+        _PENDING.clear()
+
+
+def _clear_jax_caches() -> None:
+    """Force re-trace of every cached engine so the current armed state
+    is what the next call compiles. Only touches jax when it is already
+    imported (this module must stay importable jax-free)."""
+    if "jax" not in sys.modules:
+        return
+    import jax
+
+    jax.clear_caches()
+
+
+# ------------------------------------------------------- in-graph probes
+
+class Collector:
+    """The donated stats buffer, trace-time half: while active (see
+    :func:`collecting`), every :func:`probe` hit appends its in-graph
+    stat scalars here instead of emitting a host callback — the
+    enclosing engine returns them as extra outputs, and the chunk drain
+    folds them into the ledger (:func:`stash_step_stats` /
+    :func:`flush`). This keeps the flagship step free of callback
+    effects, which measurably pessimize the whole XLA CPU program (a
+    single no-op ``jax.debug.callback`` costs ~10%% of the step)."""
+
+    def __init__(self):
+        self._stats: Dict[str, tuple] = {}
+
+    def add(self, name: str, x):
+        """Reduce ``x`` (one probe invocation, e.g. one realization's
+        family output) to (nonfinite, |max|, |min-nonzero|) scalars and
+        stage them; returns ``x`` unchanged."""
+        import jax.numpy as jnp
+
+        s = x
+        cap = PROBE_SAMPLE_CAP_COLLECT
+        if s.ndim >= 1 and s.size > cap:
+            # leading-axis slab (never a reshape: a reshape consumer
+            # forces XLA to materialize the full intermediate)
+            inner = max(1, s.size // s.shape[0])
+            s = s[: max(1, cap // inner)]
+            if s.size > cap:
+                # one leading row alone exceeds the cap: take the
+                # row's leading elements (slice-of-slice still fuses)
+                per_row = max(1, s.size // s.shape[-1])
+                s = s[..., : max(1, cap // per_row)]
+        finite = jnp.isfinite(s)
+        ax = jnp.abs(s)
+        nf = jnp.sum(jnp.logical_not(finite), dtype=jnp.int32)
+        amax = jnp.max(jnp.where(finite, ax, 0.0), initial=0.0)
+        amin = jnp.min(
+            jnp.where(finite & (ax > 0), ax, jnp.inf), initial=jnp.inf
+        )
+        finfo = jnp.finfo(x.dtype)
+        with _LOCK:
+            _SITE_META[name] = (
+                int(s.size),
+                float(math.log2(float(finfo.max))),
+                str(x.dtype),
+            )
+        prev = self._stats.get(name)
+        if prev is not None:
+            # same site probed twice in one trace: merge in-graph
+            nf = nf + prev[0]
+            amax = jnp.maximum(amax, prev[1])
+            amin = jnp.minimum(amin, prev[2])
+        self._stats[name] = (nf, amax, amin)
+        return x
+
+    def take(self) -> Dict[str, tuple]:
+        """Pop the staged stats pytree ({site: (nf, amax, amin)}) —
+        the engine returns this alongside its data output."""
+        stats, self._stats = self._stats, {}
+        return stats
+
+
+class collecting:
+    """Context manager activating ``col`` for probes traced on this
+    thread (``with numerics.collecting(col): ...``). Nest-safe."""
+
+    def __init__(self, col: Collector):
+        self._col = col
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "collector", None)
+        _TLS.collector = self._col
+        return self._col
+
+    def __exit__(self, *exc):
+        _TLS.collector = self._prev
+        return False
+
+
+def collector_default() -> bool:
+    """True when an armed engine being traced NOW should thread a
+    donated stats buffer through its outputs (trace-time decision,
+    same contract as :func:`probe`'s armed check)."""
+    return _ARMED
+
+
+def reduce_stats(stats: Dict[str, tuple]) -> Dict[str, tuple]:
+    """In-graph reduction of vmap-stacked probe stats — (R,)-shaped
+    leaves from a batched engine fold to per-site scalars (sum / max /
+    min) so the donated buffer ships 3 scalars per site, not 3R."""
+    import jax.numpy as jnp
+
+    out = {}
+    for site, (nf, amax, amin) in stats.items():
+        out[site] = (jnp.sum(nf), jnp.max(amax), jnp.min(amin))
+    return out
+
+
+def stash_step_stats(stats: Dict[str, tuple], nreal: int) -> None:
+    """Queue one engine call's donated stats buffer (UN-FETCHED device
+    scalars — fetching here would fence the async dispatch the sweep
+    pipeline depends on). The chunk drain / :func:`flush` folds them
+    into the ledger once the chunk itself has been fetched."""
+    if not stats:
+        return
+    counts = {}
+    with _LOCK:
+        for site in stats:
+            meta = _SITE_META.get(site)
+            counts[site] = (meta[0] if meta else 0) * max(1, int(nreal))
+        _PENDING.append((stats, counts))
+        overflow = len(_PENDING) - _PENDING_MAX
+        oldest = _PENDING[:overflow] if overflow > 0 else []
+        if overflow > 0:
+            del _PENDING[:overflow]
+    for item in oldest:
+        # backstop when nothing ever drains: folding the oldest entry
+        # blocks on long-finished work, keeping the queue bounded
+        _fold_pending(item)
+
+
+def _fold_pending(item) -> None:
+    stats, counts = item
+    for site, (nf, amax, amin) in stats.items():
+        meta = _SITE_META.get(site)
+        if meta is None:
+            continue
+        _record(
+            site, counts.get(site, 0), meta[1], meta[2],
+            np.asarray(nf), np.asarray(amax), np.asarray(amin),
+            elements_exact=True,
+        )
+
+
+def _drain_pending(only_ready: bool = False) -> None:
+    """Fold queued donated-stats buffers into the ledger. With
+    ``only_ready`` (the opportunistic per-chunk drain), stop at the
+    first buffer whose scalars are still in flight — never fence a
+    chunk the pipeline hasn't finished."""
+    while True:
+        with _LOCK:
+            if not _PENDING:
+                return
+            item = _PENDING[0]
+            if only_ready and not _stats_ready(item[0]):
+                return
+            del _PENDING[0]
+        _fold_pending(item)
+
+
+def _stats_ready(stats) -> bool:
+    for leaves in stats.values():
+        for leaf in leaves:
+            ready = getattr(leaf, "is_ready", None)
+            if ready is not None:
+                try:
+                    if not ready():
+                        return False
+                except RuntimeError:
+                    # a deleted/donated buffer has no readiness to
+                    # report: treat it as ready and let the fold's
+                    # np.asarray name the real failure
+                    return True
+    return True
+
+
+def probe(name: str, x):
+    """Tensor-health probe: identity on the data path, always.
+
+    Disarmed (the default) this is literally ``return x`` — no jax
+    import, no graph change, bitwise today's graph. Armed, it computes
+    in-graph reductions (non-finite count, max |x|, min non-zero |x|)
+    and lands them in the host ledger one of two ways:
+
+    * **collector mode** (a :class:`Collector` is active — the
+      single-device realize engine): the stat scalars join the engine's
+      donated stats buffer, returned as extra outputs and folded in at
+      the chunk drain. No callbacks, no effects — this is the flagship
+      path, and the reason the armed step stays inside the <1%%
+      overhead gate (benchmarks/numerics_probe.py): a single no-op
+      ``jax.debug.callback`` alone pessimizes the whole XLA CPU
+      program by ~10%%.
+    * **callback mode** (no collector — likelihood/fit graphs, mesh
+      shards, eager precompute): ``jax.debug.callback`` streams them
+      out; its side effects land with the chunk drain / ``flush()``.
+
+    Arrays above :data:`PROBE_SAMPLE_CAP` elements are sampled by a
+    leading-axis slab (collector mode) or the raveled prefix (callback
+    mode) — the leading realizations of a ``(nreal, ...)`` cube, the
+    same slice the shadow oracle replays. The per-site ``elements``
+    ledger field counts what was actually scanned; the chunk drain's
+    full numpy scan (:func:`scan_block`) remains the exact whole-cube
+    non-finite backstop.
+
+    Transform safety (callback mode):
+
+    * **vmap** (the realization axis): a ``custom_vmap`` rule reduces
+      across the WHOLE batched array in-graph and fires ONE callback
+      per engine call — without it jax unrolls the callback per batch
+      element, and a 64-realization step pays 64 host round-trips per
+      site (measured ~100x the whole probe budget).
+    * **grad** (map_fit's likelihood gradients run through the probed
+      Cholesky factors): a ``custom_jvp`` with a zero tangent — the
+      probe is a constant observer, so its derivative is zero and the
+      inner custom_vmap never meets a JVP trace (which it does not
+      support).
+    * **shard_map**: each shard reports and :func:`_record` aggregates.
+
+    Collector-mode stats are plain outputs, so every transform the
+    engine applies (the realization vmap stacks them; the post-vmap
+    :func:`reduce_stats` folds them back to scalars). Non-float inputs
+    pass through unprobed (there is no finfo to measure against)."""
+    if not _ARMED:
+        return x
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    col = getattr(_TLS, "collector", None)
+    if col is not None:
+        return col.add(name, x)
+    return _emitter(name)(x)
+
+
+@_functools_lru_cache(maxsize=None)
+def _emitter(name: str):
+    """The armed probe's stats emitter for one site, built once per
+    site name (the custom_vmap/custom_jvp wrappers are trace-time
+    objects — rebuilding them per call would re-trace every step).
+
+    The emitter RETURNS ``x`` itself (no ops applied — bitwise the
+    input), and ``probe`` returns that: the caller's graph consumes
+    the probe's output, which is what keeps the attached callback
+    alive. A side-branch emitter whose output nothing consumes is
+    dead code once custom_vmap wraps it — jit silently DCEs the whole
+    call, callback and all, and the armed graph records nothing."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import custom_batching
+
+    def stats(x):
+        # leading-prefix sample (see PROBE_SAMPLE_CAP): a contiguous
+        # slab XLA can recompute without materializing the full array
+        s = jnp.ravel(x)[:PROBE_SAMPLE_CAP]
+        finite = jnp.isfinite(s)
+        ax = jnp.abs(s)
+        nonfinite = jnp.sum(jnp.logical_not(finite), dtype=jnp.int32)
+        absmax = jnp.max(jnp.where(finite, ax, 0.0), initial=0.0)
+        minnz = jnp.min(
+            jnp.where(finite & (ax > 0), ax, jnp.inf), initial=jnp.inf
+        )
+        finfo = jnp.finfo(x.dtype)
+        jax.debug.callback(
+            functools.partial(
+                _record, name, int(s.size),
+                float(math.log2(float(finfo.max))), str(x.dtype),
+            ),
+            nonfinite, absmax, minnz,
+        )
+        return x
+
+    inner = custom_batching.custom_vmap(stats)
+
+    @inner.def_vmap
+    def _vmap_rule(axis_size, in_batched, x):
+        # the batched array reduces in-graph (sampled prefix over the
+        # leading realizations): one callback per engine call, whatever
+        # the realization count; the identity output keeps its axis
+        return stats(x), in_batched[0]
+
+    emit = jax.custom_jvp(inner)
+
+    @emit.defjvp
+    def _jvp_rule(primals, tangents):
+        # identity: the tangent passes through untouched (map_fit's
+        # gradients flow through probed factors), and the inner
+        # custom_vmap never meets the JVP trace it cannot handle
+        (x,) = primals
+        (t,) = tangents
+        return emit(x), t
+
+    return emit
+
+
+def probe_cholesky(name: str, L):
+    """Probe a Cholesky factor through its diagonal: a failed/indefinite
+    factorization lands NaN on the diagonal, and the diagonal's dynamic
+    range IS the factor's conditioning watermark. Identity on ``L``."""
+    if not _ARMED:
+        return L
+    import jax.numpy as jnp
+
+    L = jnp.asarray(L)
+    d = probe(name, jnp.diagonal(L, axis1=-2, axis2=-1))
+    if getattr(_TLS, "collector", None) is not None:
+        return L  # the collector consumed the stats as engine outputs
+    # callback mode: write the (bitwise-identical) probed diagonal back
+    # so the caller's graph consumes the probe output — an unconsumed
+    # emitter is DCE'd under jit, callback and all (see _emitter)
+    idx = jnp.arange(d.shape[-1])
+    return L.at[..., idx, idx].set(d)
+
+
+def _record(site: str, static_size: int, max_log2: float, dtype: str,
+            nonfinite, absmax, minnz, elements_exact: bool = False) -> None:
+    """Host-side accumulator behind ``jax.debug.callback`` and the
+    donated-buffer drain. Callback arguments may arrive batched (vmap)
+    or per-shard (shard_map): aggregate by sum/max/min respectively."""
+    if not _ARMED:
+        # a still-compiled armed graph keeps calling back after disarm;
+        # the ledger must stop moving the moment the operator disarms
+        return
+    nonfinite = np.asarray(nonfinite)
+    nf = int(nonfinite.sum())
+    amax = float(np.max(np.asarray(absmax)))
+    amin = float(np.min(np.asarray(minnz)))
+    if elements_exact:
+        # donated-buffer drain: the caller already multiplied scanned
+        # elements by the realization count
+        elements = int(static_size)
+    else:
+        # static_size is the per-invocation (per-slice under vmap)
+        # SCANNED element count (the sampled prefix, capped at
+        # PROBE_SAMPLE_CAP); the number of stats elements is the
+        # batching factor
+        elements = int(static_size) * max(1, int(nonfinite.size))
+    headroom = (
+        max_log2 - math.log2(amax) if amax > 0.0 else math.inf
+    )
+    with _LOCK:
+        rec = _SITES.get(site)
+        if rec is None:
+            rec = _SITES[site] = {
+                "calls": 0, "elements": 0, "nonfinite": 0,
+                "episodes": 0, "episode_active": False,
+                "clean_streak": 0, "max_abs": 0.0,
+                "min_nonzero": math.inf, "headroom_bits": math.inf,
+                "dtype": dtype,
+            }
+        rec["calls"] += 1
+        rec["elements"] += elements
+        rec["max_abs"] = max(rec["max_abs"], amax)
+        rec["min_nonzero"] = min(rec["min_nonzero"], amin)
+        rec["headroom_bits"] = min(rec["headroom_bits"], headroom)
+        rec["dtype"] = dtype
+        opened = False
+        if nf:
+            rec["nonfinite"] += nf
+            rec["clean_streak"] = 0
+            if not rec["episode_active"]:
+                rec["episode_active"] = True
+                rec["episodes"] += 1
+                opened = True
+        else:
+            rec["clean_streak"] += 1
+            if rec["episode_active"] and \
+                    rec["clean_streak"] >= EPISODE_CLEAR_AFTER:
+                rec["episode_active"] = False
+    if nf:
+        counter(names.NUMERICS_NONFINITE).inc(nf)
+        counter(names.NUMERICS_NONFINITE, site=site).inc(nf)
+    if opened:
+        event(names.EVENT_NUMERICS_EPISODE, site=site, count=nf)
+    if math.isfinite(headroom):
+        gauge(names.NUMERICS_HEADROOM_BITS, site=site).set(
+            min(headroom, rec["headroom_bits"])
+        )
+    gauge(names.NUMERICS_MAX_ABS, site=site).set(rec["max_abs"])
+
+
+def flush() -> None:
+    """Fold every dispatched probe into the ledger: drain the queued
+    donated stats buffers (fencing any still in flight) and barrier on
+    outstanding ``jax.debug.callback`` effects. The chunk drain's fetch
+    usually implies the latter; tests and the drain hook call this
+    explicitly."""
+    if not _ARMED or "jax" not in sys.modules:
+        return
+    import jax
+
+    _drain_pending()
+    jax.effects_barrier()
+
+
+# ------------------------------------------------- drain hook + nan scan
+
+def scan_block(site: str, block) -> int:
+    """Host-side non-finite scan of a fetched chunk block — the last
+    line of defense, DOWNSTREAM of every in-graph probe (a fault-
+    injected ``nan`` poisoning the in-flight chunk is only visible
+    here). Returns the non-finite count recorded at ``site``."""
+    if not _ARMED:
+        return 0
+    arrays: List[np.ndarray] = []
+    if isinstance(block, np.ndarray):
+        arrays.append(block)
+    else:
+        # a mesh sweep's ShardedBlock (utils.sweep) carries per-shard
+        # host arrays as (index, array) pairs; duck-type any
+        # iterable-of-arrays (or iterable-of-pairs) container
+        for attr in ("blocks", "shards"):
+            parts = getattr(block, attr, None)
+            if parts is not None:
+                for p in parts:
+                    if isinstance(p, tuple) and len(p) == 2:
+                        p = p[1]
+                    arrays.append(np.asarray(p))
+                break
+    total_nf = 0
+    total_elems = 0
+    amax = 0.0
+    amin = math.inf
+    max_log2 = None
+    dtype = None
+    for arr in arrays:
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        finite = np.isfinite(arr)
+        total_nf += int(arr.size - np.count_nonzero(finite))
+        total_elems += int(arr.size)
+        ax = np.abs(arr[finite]) if not finite.all() else np.abs(arr)
+        if ax.size:
+            amax = max(amax, float(ax.max()))
+            nz = ax[ax > 0]
+            if nz.size:
+                amin = min(amin, float(nz.min()))
+        if max_log2 is None:
+            max_log2 = float(math.log2(float(np.finfo(arr.dtype).max)))
+            dtype = str(arr.dtype)
+    if max_log2 is None:
+        return 0
+    _record(site, total_elems, max_log2, dtype,
+            np.int64(total_nf), np.float64(amax), np.float64(amin))
+    return total_nf
+
+
+def drift_offset(every: Optional[int] = None,
+                 seed: Optional[int] = None) -> int:
+    """The seeded chunk offset the sampler fires on (deterministic:
+    same seed, same offset — a resumed sweep re-samples the same
+    chunks)."""
+    every = _DRIFT_EVERY if every is None else max(1, int(every))
+    seed = _DRIFT_SEED if seed is None else int(seed)
+    return random.Random(seed * 1_000_003).randrange(every)
+
+
+def should_sample(chunk_index: int) -> bool:
+    """True when the armed sampler replays this chunk's realization 0
+    through the f64 oracle (1-in-``drift_every``, seeded)."""
+    if not _ARMED:
+        return False
+    return int(chunk_index) % _DRIFT_EVERY == drift_offset()
+
+
+class _DriftShim:
+    """The minimal ``CompiledScenario`` surface the fuzzer's family
+    helpers consume (``.batch`` / ``.recipe`` / ``.realize_key()``) —
+    so the drift sampler reuses ``scenarios.fuzz``'s machinery
+    verbatim instead of duplicating the oracle replay."""
+
+    def __init__(self, batch, recipe, key):
+        self.batch = batch
+        self.recipe = recipe
+        self._key = key
+
+    def realize_key(self):
+        return self._key
+
+
+def sample_drift(batch, recipe, key) -> Dict[str, float]:
+    """Replay ONE realization's PRNG streams (``key`` is that
+    realization's engine key) through both the batched ops and the f64
+    oracle paths of ``scenarios/fuzz.py``, and record each enabled
+    family's relative drift (max-abs deviation over oracle RMS) into
+    the ledger and the ``numerics.drift{family=}`` gauges."""
+    from ..scenarios import fuzz
+
+    shim = _DriftShim(batch, recipe, key)
+    dev = fuzz.batched_family_delays(shim)
+    oracle = fuzz.oracle_family_delays(shim)
+    out: Dict[str, float] = {}
+    for family, dev_arr in dev.items():
+        if family not in oracle:
+            continue
+        rel = fuzz._rel(dev_arr, oracle[family])
+        out[family] = rel
+        tol = fuzz.FAMILY_TOLERANCES.get(family)
+        with _LOCK:
+            rec = _DRIFT.get(family)
+            if rec is None:
+                rec = _DRIFT[family] = {
+                    "worst": 0.0, "samples": 0, "tolerance": tol,
+                }
+            rec["worst"] = max(rec["worst"], rel)
+            rec["samples"] += 1
+            rec["tolerance"] = tol
+        gauge(names.NUMERICS_DRIFT, family=family).set(rel)
+    return out
+
+
+def on_drain(chunk_index: int, block=None, *, batch=None, recipe=None,
+             key=None, nreal: Optional[int] = None,
+             site: str = "drain") -> None:
+    """The sweep's per-chunk drain hook (disarmed: a single flag check).
+
+    Armed: flush outstanding probe callbacks, host-scan the fetched
+    ``block`` for non-finites at ``site``, and — on sampled chunks,
+    when the sweep passed its inputs — replay realization 0 of this
+    chunk through the shadow oracle. ``key`` is the SWEEP key;
+    realization 0's engine key is re-derived exactly as the engine
+    does (``split(fold_in(key, chunk_index), nreal)[0]``)."""
+    if not _ARMED:
+        return
+    # fold this chunk's donated stats (ready: its cube was just
+    # fetched) WITHOUT fencing the next chunk the pipeline already
+    # dispatched; the full flush() runs at sweep end / in tests
+    _drain_pending(only_ready=True)
+    if "jax" in sys.modules:
+        import jax
+
+        jax.effects_barrier()
+    if block is not None:
+        scan_block(site, block)
+    if (
+        batch is not None and recipe is not None and key is not None
+        and nreal and should_sample(chunk_index)
+    ):
+        import jax
+
+        from .trace import span
+
+        with span(names.SPAN_NUMERICS_DRIFT, chunk=int(chunk_index)):
+            rkey = jax.random.split(
+                jax.random.fold_in(key, int(chunk_index)), int(nreal)
+            )[0]
+            sample_drift(batch, recipe, rkey)
+
+
+# --------------------------------------------------- ledger persistence
+
+def snapshot() -> dict:
+    """The precision ledger as a JSON-ready document (the
+    ``numerics.json`` shape; schema checked by
+    scripts/check_telemetry_schema.py)."""
+    _drain_pending(only_ready=True)
+    with _LOCK:
+        sites = {}
+        for site, rec in _SITES.items():
+            sites[site] = {
+                "calls": rec["calls"],
+                "elements": rec["elements"],
+                "nonfinite": rec["nonfinite"],
+                "episodes": rec["episodes"],
+                "episode_active": rec["episode_active"],
+                "max_abs": rec["max_abs"],
+                "min_nonzero": (
+                    rec["min_nonzero"]
+                    if math.isfinite(rec["min_nonzero"]) else None
+                ),
+                "headroom_bits": (
+                    rec["headroom_bits"]
+                    if math.isfinite(rec["headroom_bits"]) else None
+                ),
+                "dtype": rec["dtype"],
+            }
+        drift = {
+            family: dict(rec) for family, rec in _DRIFT.items()
+        }
+        episodes_active = sorted(
+            site for site, rec in _SITES.items() if rec["episode_active"]
+        )
+    return {
+        "schema_version": NUMERICS_SCHEMA_VERSION,
+        "armed": _ARMED,
+        "sites": sites,
+        "drift": drift,
+        "nonfinite_total": sum(s["nonfinite"] for s in sites.values()),
+        "episodes_active": episodes_active,
+    }
+
+
+def heartbeat_block() -> dict:
+    """The compact block the flight recorder embeds in every heartbeat
+    (PROGRESS_SCHEMA v5)."""
+    with _LOCK:
+        nonfinite = sum(r["nonfinite"] for r in _SITES.values())
+        active = sum(1 for r in _SITES.values() if r["episode_active"])
+        headrooms = [
+            r["headroom_bits"] for r in _SITES.values()
+            if math.isfinite(r["headroom_bits"])
+        ]
+    return {
+        "armed": _ARMED,
+        "nonfinite": nonfinite,
+        "episodes_active": active,
+        "worst_headroom_bits": min(headrooms) if headrooms else None,
+    }
+
+
+def write(directory: str) -> str:
+    """Atomically persist the ledger as ``DIR/numerics.json`` (the
+    flight recorder calls this with its live-artifact cadence; the
+    serve endpoint and ``numerics report`` read it back)."""
+    from .flightrec import _atomic_json
+
+    path = os.path.join(directory, "numerics.json")
+    _atomic_json(path, snapshot())
+    return path
+
+
+# ------------------------------------------------- readiness + reporting
+
+def _site_family(site: str) -> Optional[str]:
+    """Map a probe site onto the fuzzer's family vocabulary for the
+    drift leg of the verdict (``realization.white`` -> ``white``;
+    ``cw.stream_tile`` -> ``cw``; solver/factor sites have no sampled
+    family and are judged on headroom + non-finites alone)."""
+    leaf = site.rsplit(".", 1)[-1]
+    if site.startswith("realization."):
+        return leaf
+    if site.startswith("cw."):
+        return "cw"
+    return None
+
+
+def ladder_verdict(doc: Optional[dict] = None,
+                   headroom_bits: float = LADDER_HEADROOM_BITS) -> dict:
+    """Per-site bf16-readiness verdict from a ledger document:
+    ``ready`` iff the site saw zero non-finites, kept >=
+    ``headroom_bits`` bits of overflow margin, and (when a shadow-
+    oracle family maps to it) its worst sampled drift stayed within
+    the fuzzer's family tolerance."""
+    doc = snapshot() if doc is None else doc
+    drift = doc.get("drift") or {}
+    verdict = {}
+    for site, rec in sorted((doc.get("sites") or {}).items()):
+        reasons = []
+        if rec.get("nonfinite"):
+            reasons.append(f"{rec['nonfinite']} non-finite element(s)")
+        hb = rec.get("headroom_bits")
+        if hb is not None and hb < headroom_bits:
+            reasons.append(
+                f"headroom {hb:.1f} bits < {headroom_bits:g}"
+            )
+        family = _site_family(site)
+        d = drift.get(family) if family else None
+        if d is not None and d.get("tolerance") is not None:
+            if d["worst"] > d["tolerance"]:
+                reasons.append(
+                    f"drift {d['worst']:.3g} > tolerance "
+                    f"{d['tolerance']:g} ({family})"
+                )
+        elif family is not None:
+            reasons.append(f"no drift samples for family {family!r}")
+        verdict[site] = {
+            "ready": not reasons,
+            "reasons": reasons,
+            "family": family,
+        }
+    return verdict
+
+
+def render_report(directory: str) -> str:
+    """The ``numerics report DIR`` CLI body (jax-free): the per-site
+    ledger table, per-family drift, and the ladder verdict."""
+    path = os.path.join(directory, "numerics.json")
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except OSError:
+        return (
+            f"no numerics.json in {directory} — the run was captured "
+            "without the observatory armed (set PTA_NUMERICS=1, or "
+            "call obs.numerics.arm() before the engines compile)"
+        )
+    except json.JSONDecodeError as exc:
+        return f"numerics.json unreadable: {exc}"
+    parts = [f"numerics ledger: {directory}"]
+    sites = doc.get("sites") or {}
+    if not sites:
+        parts.append("  (no probe sites recorded)")
+    else:
+        parts.append(
+            f"  {'site':<28} {'dtype':<9} {'calls':>7} {'nonfinite':>9} "
+            f"{'max|x|':>10} {'headroom':>9}"
+        )
+        for site in sorted(sites):
+            rec = sites[site]
+            hb = rec.get("headroom_bits")
+            parts.append(
+                f"  {site:<28} {rec.get('dtype', '?'):<9} "
+                f"{rec.get('calls', 0):>7} {rec.get('nonfinite', 0):>9} "
+                f"{rec.get('max_abs', 0.0):>10.3g} "
+                + (f"{hb:>8.1f}b" if hb is not None else f"{'inf':>9}")
+            )
+    drift = doc.get("drift") or {}
+    if drift:
+        parts.append("")
+        parts.append("drift vs the f64 shadow oracle (worst sampled):")
+        for family in sorted(drift):
+            d = drift[family]
+            tol = d.get("tolerance")
+            parts.append(
+                f"  {family:<12} {d.get('worst', 0.0):.3g} over "
+                f"{d.get('samples', 0)} sample(s)"
+                + (f"  (tolerance {tol:g})" if tol is not None else "")
+            )
+    active = doc.get("episodes_active") or []
+    if active:
+        parts.append("")
+        parts.append(
+            "NON-FINITE EPISODE ACTIVE at: " + ", ".join(active)
+            + "  (/readyz serves 503 until it clears)"
+        )
+    parts.append("")
+    parts.append(
+        f"bf16 ladder readiness (headroom >= {LADDER_HEADROOM_BITS:g} "
+        "bits, zero non-finites, drift within family tolerance):"
+    )
+    verdict = ladder_verdict(doc)
+    if not verdict:
+        parts.append("  (no sites to judge)")
+    for site, v in verdict.items():
+        if v["ready"]:
+            parts.append(f"  {site:<28} ladder-ready")
+        else:
+            parts.append(
+                f"  {site:<28} NOT READY: " + "; ".join(v["reasons"])
+            )
+    return "\n".join(parts)

@@ -100,6 +100,10 @@ _HIGHER_BETTER_TOKENS = (
     # engine's higher-is-healthier score (burn rates are lower-better
     # overrides below — "rate" must NOT pull them higher-better)
     "stitched", "budget_remaining",
+    # NUMERICS series (PR 18): bits of overflow margin left to the
+    # dtype ceiling — shrinking headroom is the bf16 ladder's runway
+    # eroding ("drift"/"nonfinite" are lower-better tokens below)
+    "headroom_bits",
     # STAGES series (benchmarks/stage_graph.py, PR 15): the fused
     # sweep's measured end-to-end overlap efficiency over the whole
     # window (host precompute + H2D + compute + D2H + durable write) —
@@ -164,6 +168,12 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
                         # efficiency's job to score)
                         "critical_path_s", "blocked_s",
                         "straggler_ratio",
+                        # NUMERICS series (benchmarks/numerics_probe.py,
+                        # PR 18): non-finite element counts and shadow-
+                        # oracle drift magnitudes are costs — rising
+                        # drift is precision eroding even while every
+                        # family still passes its tolerance
+                        "nonfinite", "drift",
                         # MULTICHIP fused-mesh series (r17): io_write's
                         # exclusive-shadow share of the phase wall
                         # (obs/critpath.py critical_share) — the slice

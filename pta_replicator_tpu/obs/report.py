@@ -55,7 +55,8 @@ def load_telemetry(directory: str) -> dict:
     out = {
         "directory": directory, "events": [], "metrics": None,
         "meta": None, "progress": None, "postmortem": None,
-        "series": None, "slo": None, "critpath": None, "problems": [],
+        "series": None, "slo": None, "critpath": None, "numerics": None,
+        "problems": [],
     }
     if not os.path.isdir(directory):
         out["problems"].append(f"{directory}: not a directory")
@@ -78,6 +79,7 @@ def load_telemetry(directory: str) -> dict:
         ("postmortem", "postmortem.json"),
         ("slo", "slo.json"),
         ("critpath", "critpath.json"),
+        ("numerics", "numerics.json"),
     ):
         p = os.path.join(directory, fname)
         if not os.path.exists(p):
@@ -219,6 +221,7 @@ def render_report(
              "postmortem": data["postmortem"],
              "series": data["series"],
              "slo": data["slo"],
+             "numerics": data["numerics"],
              "critpath": cp,
              "utilization": occupancy.analyze(data["events"]),
              "problems": data["problems"]},
@@ -260,6 +263,12 @@ def render_report(
 
     if data["slo"]:
         section = render_slo(data["slo"])
+        if section:
+            parts.append("")
+            parts.append(section)
+
+    if data["numerics"]:
+        section = render_numerics(data["numerics"])
         if section:
             parts.append("")
             parts.append(section)
@@ -473,6 +482,51 @@ def render_slo(slo: dict) -> str:
     return "\n".join(rows)
 
 
+def render_numerics(doc: dict) -> str:
+    """The report's numerics section from a loaded ``numerics.json``:
+    one row per probe site (non-finites, |max| watermark, overflow
+    headroom in bits), worst sampled drift per family, and a loud
+    marker for open non-finite episodes. The full per-kernel ladder
+    verdict lives in ``numerics report DIR`` (docs/numerics.md)."""
+    sites = (doc or {}).get("sites") or {}
+    drift = (doc or {}).get("drift") or {}
+    if not sites and not drift:
+        return ""
+    rows = ["numerics (tensor health per probe site):"]
+    for site in sorted(sites):
+        rec = sites[site]
+        hb = rec.get("headroom_bits")
+        row = (
+            f"  {site:<28} nonfinite {rec.get('nonfinite', 0):>6}  "
+            f"max|x| {rec.get('max_abs', 0.0):>10.3g}  "
+            + (f"headroom {hb:6.1f}b" if hb is not None
+               else "headroom    inf")
+        )
+        if rec.get("episode_active"):
+            row += "  ** NON-FINITE EPISODE OPEN **"
+        rows.append(row)
+    for family in sorted(drift):
+        d = drift[family]
+        tol = d.get("tolerance")
+        row = (
+            f"  drift[{family}] {d.get('worst', 0.0):.3g} worst over "
+            f"{d.get('samples', 0)} sample(s)"
+        )
+        if tol is not None:
+            row += (
+                f" (tolerance {tol:g}"
+                + (", EXCEEDED)" if d.get("worst", 0.0) > tol else ")")
+            )
+        rows.append(row)
+    active = (doc or {}).get("episodes_active") or []
+    if active:
+        rows.append(
+            f"  NON-FINITE EPISODES ACTIVE: {', '.join(active)} — "
+            "/readyz serves 503 until they clear (docs/numerics.md)"
+        )
+    return "\n".join(rows)
+
+
 def render_utilization(util: dict) -> str:
     """The report's utilization section from an :func:`occupancy.analyze`
     result: per-stage duty table, overlap efficiency, bottleneck
@@ -582,6 +636,13 @@ def render_heartbeat(hb: dict) -> str:
         )
         if worst is not None:
             parts.append(f"slo budget {100 * worst:.0f}%")
+    num = hb.get("numerics") or {}
+    if num.get("nonfinite"):
+        parts.append(
+            f"NONFINITE {int(num['nonfinite'])}"
+            + (f" ({int(num['episodes_active'])} episode(s) open)"
+               if num.get("episodes_active") else "")
+        )
     open_spans = hb.get("open_spans") or {}
     if open_spans:
         deepest = max(open_spans.values(), key=len)

@@ -110,6 +110,10 @@ DEFAULT_PREFIXES: Tuple[str, ...] = (
     # recovery loop reruns them
     names.CRITPATH_PREFIX,
     names.LEDGER_PREFIX,
+    # the numerics observatory (PR 18): non-finite counter, per-site
+    # headroom/watermark gauges, and per-family drift — whether a run's
+    # dynamic range is eroding over hours is precisely a series question
+    names.NUMERICS_PREFIX,
 )
 
 

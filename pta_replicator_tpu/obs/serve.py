@@ -21,8 +21,14 @@ access to the box:
   active SLO verdict (503 with state "slo-breach" while any
   objective's fast-window burn rate is past its breach threshold —
   a live-but-burning server should shed traffic, docs/tracing.md)
+  PLUS the numerics observatory's non-finite verdict (503 with state
+  "numerics" while any probe site has an open non-finite episode —
+  the run is alive but producing corrupt tensors, docs/numerics.md)
 * ``/slo``      — the SLO engine's full status (``slo.json``: per-
   objective error budget remaining + fast/slow burn rates)
+* ``/numerics`` — the precision ledger (``numerics.json``, written by
+  the flight recorder while the numerics observatory is armed —
+  absent, honestly, when it never armed)
 * ``/critpath`` — the critical-path attribution verdict
   (``critpath.json``, written by ``critpath DIR`` / obs.critpath —
   absent until an attribution pass has run over the capture)
@@ -62,6 +68,8 @@ ROUTES = {
     "/slo.json": ("slo.json", "application/json"),
     "/critpath": ("critpath.json", "application/json"),
     "/critpath.json": ("critpath.json", "application/json"),
+    "/numerics": ("numerics.json", "application/json"),
+    "/numerics.json": ("numerics.json", "application/json"),
 }
 
 
@@ -117,6 +125,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             if breached:
                 doc.update(ok=False, state="slo-breach",
                            breached=breached)
+        if readiness and doc["ok"]:
+            episodes = self._numerics_episodes()
+            if episodes:
+                doc.update(ok=False, state="numerics",
+                           nonfinite_sites=episodes)
         self._respond(
             200 if doc["ok"] else 503,
             json.dumps(doc).encode(), "application/json",
@@ -133,6 +146,21 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             with open(os.path.join(self.server.directory, "slo.json"),
                       "rb") as fh:
                 return any_breach(json.loads(fh.read()))
+        except (OSError, json.JSONDecodeError):
+            return []
+
+    def _numerics_episodes(self) -> list:
+        """Probe sites with an OPEN non-finite episode from the live
+        numerics.json (empty when the observatory never armed, the file
+        is absent, or it is torn — the same degrade-to-liveness
+        contract as the SLO rung). The episode clears — and /readyz
+        re-arms — after the site's configured clean streak
+        (obs/numerics.py EPISODE_CLEAR_AFTER)."""
+        try:
+            with open(os.path.join(self.server.directory,
+                                   "numerics.json"), "rb") as fh:
+                doc = json.loads(fh.read())
+            return list(doc.get("episodes_active") or [])
         except (OSError, json.JSONDecodeError):
             return []
 

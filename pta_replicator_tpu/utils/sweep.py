@@ -667,6 +667,33 @@ def iter_checkpoint_chunks(checkpoint_path: str):
         i += 1
 
 
+def _drain_seam(fetch_fn: Callable, start: int, batch, recipe, key,
+                nreal: int) -> Callable:
+    """Wrap the reader's fetch with the drain-site DATA hooks: the
+    ``nan`` fault poison (faults.poison — silent one-element corruption
+    of the fetched block) and the numerics observatory's per-chunk
+    drain hook (host non-finite scan + sampled shadow-oracle drift
+    replay — obs.numerics.on_drain). The drain stage runs on ONE reader
+    thread strictly in chunk order (pipeline.py's pinned contract), so
+    an advancing counter recovers each block's chunk index without
+    widening the executor's ``fetch(out)`` signature. Disarmed, both
+    hooks are a single flag/None check — the production readback path
+    is unchanged."""
+    from ..obs import numerics
+
+    nxt = [int(start)]
+
+    def fetch(out):
+        i = nxt[0]
+        nxt[0] = i + 1
+        block = faults.poison(faults.SITE_DRAIN, fetch_fn(out), chunk=i)
+        numerics.on_drain(i, block, batch=batch, recipe=recipe, key=key,
+                          nreal=nreal)
+        return block
+
+    return fetch
+
+
 def _read_done_marker(meta_path: str) -> int:
     """Completed-chunk count from the sidecar, 0 when absent/corrupt —
     the supervision loop's progress probe (a torn sidecar means the
@@ -962,7 +989,7 @@ def _sweep_impl(
 
         static = static_delays(batch, recipe, mesh=mesh)
 
-    from ..obs import counter, gauge, names, span
+    from ..obs import counter, gauge, names, numerics, span
 
     # chunk-progress gauges: the flight recorder's heartbeat derives
     # "12/64 chunks, ETA 4m" from exactly these (obs/flightrec.py), so
@@ -1054,7 +1081,15 @@ def _sweep_impl(
                 # drains
                 with span(names.SPAN_READBACK_FENCE):
                     faults.fire(faults.SITE_DRAIN, chunk=i)
-                    block = fetch_fn(out)
+                    # same drain-site data hooks the pipelined reader
+                    # runs (_drain_seam): nan poison, then the numerics
+                    # drain scan/drift sample — both no-ops disarmed
+                    block = faults.poison(
+                        faults.SITE_DRAIN, fetch_fn(out), chunk=i
+                    )
+                    numerics.on_drain(i, block, batch=batch,
+                                      recipe=recipe, key=key,
+                                      nreal=chunk)
             host = (block.assemble() if isinstance(block, ShardedBlock)
                     else block)
             return block, host
@@ -1141,6 +1176,11 @@ def _sweep_impl(
             inc.append(i, host, buf=buf)
             place(i, host)
 
+        # the reader's fetch picks up the drain-site data hooks (nan
+        # poison + numerics drain scan/drift sample) — the pipelined
+        # twin of the synchronous loop's explicit calls above
+        drain_fetch = _drain_seam(fetch_fn, done, batch, recipe, key,
+                                  chunk)
         try:
             with span(names.SPAN_SWEEP_PIPELINE, depth=pipeline_depth,
                       chunks=nchunks - done, fused=fused_stream) as sp:
@@ -1153,7 +1193,7 @@ def _sweep_impl(
                         drain_timeout_s=drain_timeout_s,
                         trace_scope=checkpoint_path,
                         mesh=mesh,
-                        fetch=fetch_fn,
+                        fetch=drain_fetch,
                     )
                 else:
                     stats = run_pipelined(
@@ -1161,7 +1201,7 @@ def _sweep_impl(
                         dispatch_chunk,
                         write_and_consolidate,
                         depth=pipeline_depth,
-                        fetch=fetch_fn,
+                        fetch=drain_fetch,
                         drain_timeout_s=drain_timeout_s,
                         # chunk traces scoped to the sweep's identity:
                         # a supervised retry (and a cross-process

@@ -99,6 +99,18 @@ echo "== fused-mesh sweep gate (fast arm) =="
 JAX_PLATFORMS=cpu python benchmarks/multichip_scaling.py --fast \
     > /dev/null
 
+echo "== numerics observatory gate (fast arm) =="
+# the fast arm of benchmarks/numerics_probe.py: the flagship-shaped
+# sweep cube must be sha256-identical across disarmed / armed /
+# disarmed-after-a-cycle (disarmed probes are bitwise today's graph;
+# armed probes are identity on the data path), a planted f32 overflow
+# must be named at realization.white (the PRODUCING probe site), a
+# post-device drain:nan fault at the drain scan only, and every
+# drift-sampled family must sit within the fuzzer's f64-oracle
+# tolerance (exit 1, reasons to stderr). Seconds-scale, fixture-free,
+# CPU-only (docs/numerics.md).
+JAX_PLATFORMS=cpu python benchmarks/numerics_probe.py --fast > /dev/null
+
 echo "== performance ledger gate (windowed regression) =="
 # obs/ledger.py over the committed round artifacts: any direction-
 # classified metric worsening MONOTONICALLY across the last 3 rounds

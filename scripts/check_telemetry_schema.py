@@ -204,9 +204,10 @@ def generate_sample(directory: str) -> str:
 #: version on — a v1 capture (pre-occupancy) must keep validating
 #: ("readers stay tolerant of v1 files", obs/flightrec.py). v3 added
 #: the series-derived "trends" block; v4 the SLO verdict block and the
-#: postmortem's open-traces list.
+#: postmortem's open-traces list; v5 the numerics observatory's
+#: compact health rollup.
 _FIELD_SINCE_VERSION = {"occupancy": 2, "trends": 3, "slo": 4,
-                        "open_traces": 4}
+                        "open_traces": 4, "numerics": 5}
 
 
 def _validate_shape(path: str, doc, schema: dict, kind: str) -> list:
@@ -523,6 +524,93 @@ def validate_ledger_file(path: str) -> list:
     return problems
 
 
+def validate_numerics_file(path: str) -> list:
+    """Validate a ``numerics.json`` precision-ledger artifact
+    (obs/numerics ``snapshot`` shape): schema stamp no newer than this
+    tree's observatory, per-site rollups with the counter/watermark
+    fields, per-family drift entries with sample provenance, and an
+    ``episodes_active`` list naming sites from the sites table."""
+    from pta_replicator_tpu.obs.numerics import NUMERICS_SCHEMA_VERSION
+
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except json.JSONDecodeError as exc:
+        return [f"{path}: unparseable JSON ({exc})"]
+    if not isinstance(doc, dict):
+        return [f"{path}: not a JSON object"]
+    version = doc.get("schema_version")
+    if not isinstance(version, int) or isinstance(version, bool):
+        return [f"{path}: schema_version missing or not an int"]
+    if version > NUMERICS_SCHEMA_VERSION:
+        return [
+            f"{path}: schema_version {version} newer than this tree's "
+            f"observatory ({NUMERICS_SCHEMA_VERSION}) — refusing to "
+            "misread a future artifact"
+        ]
+    problems = []
+    if not isinstance(doc.get("armed"), bool):
+        problems.append(f"{path}: armed not a bool")
+    total = doc.get("nonfinite_total")
+    if not isinstance(total, int) or isinstance(total, bool):
+        problems.append(f"{path}: nonfinite_total not an int")
+    sites = doc.get("sites")
+    if not isinstance(sites, dict):
+        return problems + [f"{path}: sites is not an object"]
+    for name, rec in sites.items():
+        if not isinstance(rec, dict):
+            problems.append(f"{path}: site {name!r} not an object")
+            continue
+        for field in ("calls", "elements", "nonfinite", "episodes"):
+            val = rec.get(field)
+            if not isinstance(val, int) or isinstance(val, bool):
+                problems.append(
+                    f"{path}: site {name!r}.{field} not an int"
+                )
+        if not isinstance(rec.get("episode_active"), bool):
+            problems.append(
+                f"{path}: site {name!r}.episode_active not a bool"
+            )
+        for field in ("max_abs", "min_nonzero", "headroom_bits"):
+            val = rec.get(field)
+            # None encodes "no finite sample yet" (inf is not JSON)
+            if val is not None and (
+                not isinstance(val, (int, float)) or isinstance(val, bool)
+            ):
+                problems.append(
+                    f"{path}: site {name!r}.{field} not numeric/null"
+                )
+        if not isinstance(rec.get("dtype"), str):
+            problems.append(f"{path}: site {name!r}.dtype not a string")
+    drift = doc.get("drift")
+    if not isinstance(drift, dict):
+        problems.append(f"{path}: drift is not an object")
+    else:
+        for family, rec in drift.items():
+            if (
+                not isinstance(rec, dict)
+                or not isinstance(rec.get("worst"), (int, float))
+                or isinstance(rec.get("worst"), bool)
+                or not isinstance(rec.get("samples"), int)
+                or isinstance(rec.get("samples"), bool)
+            ):
+                problems.append(
+                    f"{path}: drift {family!r} must carry numeric "
+                    "worst + int samples"
+                )
+    active = doc.get("episodes_active")
+    if not isinstance(active, list):
+        problems.append(f"{path}: episodes_active is not a list")
+    else:
+        for site in active:
+            if site not in sites:
+                problems.append(
+                    f"{path}: episodes_active names unknown site "
+                    f"{site!r}"
+                )
+    return problems
+
+
 def validate_device_traces(directory: str) -> list:
     """A capture's meta.json may register managed jax.profiler trace
     dirs (obs.devprof.device_trace). Each registered path — relative
@@ -597,6 +685,9 @@ def main(argv=None) -> int:
             ledger_path = os.path.join(target, "PERF_LEDGER.json")
             if os.path.exists(ledger_path):
                 problems += validate_ledger_file(ledger_path)
+            numerics_path = os.path.join(target, "numerics.json")
+            if os.path.exists(numerics_path):
+                problems += validate_numerics_file(numerics_path)
             problems += validate_device_traces(target)
             target = os.path.join(target, "events.jsonl")
         problems += validate_events(target)

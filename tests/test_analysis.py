@@ -1397,3 +1397,87 @@ def test_bench_silent_gate_clean_on_real_tree():
     active, _ = engine.run_rules(mods, [rules_bench.SilentGate()])
     assert problems == []
     assert active == [], [f.format() for f in active]
+
+
+def test_unprobed_reduction_fires_on_bare_hot_path_cholesky(tmp_path):
+    """obs-unprobed-reduction: a jnp cholesky/slogdet in a hot-path
+    package module whose enclosing function carries no numerics probe
+    fires, anchored per call; the numpy f64 oracle form is exempt."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    src = """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def factor(c):
+            L = jnp.linalg.cholesky(c)
+            s, ld = jnp.linalg.slogdet(c)
+            return L, ld
+
+        def oracle(c):
+            return np.linalg.cholesky(c)   # host-side f64 reference
+    """
+    findings, _ = lint_tree(
+        tmp_path, {"pta_replicator_tpu/likelihood/bad.py": src},
+        rules_obs.RULES,
+    )
+    assert rule_ids(findings) == ["obs-unprobed-reduction"] * 2
+    assert "numerics probe" in findings[0].message
+
+
+def test_unprobed_reduction_accepts_probe_and_suppression(tmp_path):
+    """Non-firing shapes: a probe_cholesky (or probe/scan_block) call
+    anywhere in the enclosing function is evidence; an inline
+    graftlint disable on the call line (or the line above) silences
+    the call pre-yield — the same widened-window contract as
+    cov-f32-cholesky, so reasoned suppressions never show up even as
+    suppressed-count noise; non-hot-path modules are out of scope."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    probed = """
+        import jax.numpy as jnp
+        from pta_replicator_tpu.obs import numerics
+
+        def factor(c):
+            L = jnp.linalg.cholesky(c)
+            return numerics.probe_cholesky("gp.chol_rank", L)
+    """
+    suppressed_src = """
+        import jax.numpy as jnp
+
+        def factor(c):
+            # PSD by construction (ridge added)  graftlint: disable=obs-unprobed-reduction
+            return jnp.linalg.cholesky(c)
+    """
+    outside = """
+        import jax.numpy as jnp
+
+        def factor(c):
+            return jnp.linalg.cholesky(c)
+    """
+    findings, suppressed = lint_tree(
+        tmp_path,
+        {
+            "pta_replicator_tpu/covariance/ok.py": probed,
+            "pta_replicator_tpu/models/sup.py": suppressed_src,
+            "pta_replicator_tpu/obs/outside.py": outside,
+        },
+        rules_obs.RULES,
+    )
+    assert findings == []
+    assert "obs-unprobed-reduction" not in rule_ids(suppressed)
+
+
+def test_unprobed_reduction_clean_on_real_tree():
+    """Every device cholesky/slogdet in the shipped hot paths either
+    routes through a numerics probe or carries a reasoned inline
+    suppression — zero findings, empty baseline delta."""
+    from pta_replicator_tpu.analysis import rules_obs
+
+    pkg = os.path.join(REPO, "pta_replicator_tpu")
+    files = engine.iter_python_files([pkg], str(REPO))
+    mods, problems = engine.parse_modules(files, str(REPO))
+    active, _ = engine.run_rules(
+        mods, [rules_obs.UnprobedReduction()])
+    assert problems == []
+    assert active == [], [f.format() for f in active]

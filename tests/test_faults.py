@@ -424,3 +424,55 @@ def test_chaos_bench_diff_directions():
     _table, summary, rc = bench_diff([path, path])
     assert rc == 0 and summary["regressed"] == 0
     assert summary["comparable"] > 10
+
+
+# ------------------------------------------------------- nan (data corruption)
+
+def test_kind_nan_only_parses_at_the_drain_site():
+    """nan needs an in-flight chunk block to poison: drain parses,
+    every other site refuses at parse time (a typo'd schedule must not
+    silently run fault-free)."""
+    assert inject.parse_schedule("drain:nan@chunk=2")[0].kind == "nan"
+    for site in ("dispatch", "io_write", "checkpoint_write",
+                 "likelihood_batch"):
+        with pytest.raises(ValueError, match="only the drain site"):
+            inject.parse_schedule(f"{site}:nan@chunk=0")
+
+
+def test_poison_disarmed_passthrough_and_seeded_determinism():
+    """Disarmed, poison() returns the block untouched (same object).
+    Armed, the same schedule + seed poisons the SAME single element
+    with NaN on a copy — the caller's buffer is never mutated."""
+    block = np.arange(24.0, dtype=np.float32).reshape(2, 3, 4)
+    assert inject.poison(inject.SITE_DRAIN, block) is block
+
+    poisoned = []
+    for _ in range(2):
+        inject.arm("drain:nan@chunk=1", seed=7)
+        out = inject.poison(inject.SITE_DRAIN, block, chunk=1)
+        inject.disarm()
+        assert out is not block and np.all(np.isfinite(block))
+        poisoned.append(np.flatnonzero(~np.isfinite(out.reshape(-1))))
+    assert poisoned[0].size == 1  # exactly one element
+    assert np.array_equal(poisoned[0], poisoned[1])  # seeded: same one
+
+    inject.arm("drain:nan@chunk=1", seed=7)
+    missed = inject.poison(inject.SITE_DRAIN, block, chunk=0)
+    assert missed is block  # wrong chunk: untouched, zero copies
+
+
+def test_nan_specs_are_poisons_alone_fire_never_raises_them():
+    """fire() and poison() are disjoint by kind: a nan spec never
+    raises from fire() at its site, and fire()'s call counters ignore
+    nan specs — a mixed schedule keeps its raise trigger exact."""
+    inject.arm("drain:nan@call=1;drain:raise@call=2", seed=0)
+    block = np.ones(8, dtype=np.float32)
+    inject.fire(inject.SITE_DRAIN)  # call 1 for raise-spec only
+    out = inject.poison(inject.SITE_DRAIN, block)  # call 1 for nan-spec
+    assert np.isnan(out).sum() == 1
+    with pytest.raises(InjectedFault) as exc:
+        inject.fire(inject.SITE_DRAIN)  # call 2: the raise spec
+    assert exc.value.kind == "raise"
+    # both specs spent: everything passes through now
+    assert inject.poison(inject.SITE_DRAIN, block) is block
+    inject.fire(inject.SITE_DRAIN)

@@ -1,0 +1,300 @@
+"""The numerics observatory (obs/numerics.py, PR 18): identity-on-the-
+data-path probes, the donated-stats collector the realize engine drains,
+non-finite episodes, shadow-oracle drift, and the precision ledger's
+persistence/report surface. The flagship-scale evidence lives in
+benchmarks/numerics_probe.py (NUMERICS_r18_cpu.json); these are the
+fast behavioral pins.
+"""
+import hashlib
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.models.batched import Recipe, realize
+from pta_replicator_tpu.obs import numerics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    """Every test starts and ends disarmed with an empty ledger; the
+    disarm clears jax caches so a probed trace never leaks into the
+    next test's (or suite's) disarmed graphs."""
+    numerics.disarm()
+    numerics.reset()
+    yield
+    numerics.disarm()
+    numerics.reset()
+
+
+@pytest.fixture()
+def small():
+    b = synthetic_batch(npsr=3, ntoa=64, seed=3)
+    recipe = Recipe(
+        efac=jnp.ones(3),
+        rn_log10_amplitude=jnp.full(3, -14.0),
+        rn_gamma=jnp.full(3, 4.0),
+    )
+    return b, recipe, jax.random.PRNGKey(11)
+
+
+def _cube_sha(b, recipe, key, nreal=8):
+    out = np.asarray(realize(key, b, recipe, nreal=nreal))
+    return hashlib.sha256(out.tobytes()).hexdigest()
+
+
+# ------------------------------------------------------------ identity
+
+def test_disarmed_probes_are_bitwise_todays_graph(small):
+    """The core contract: the realize cube is sha256-identical across
+    disarmed / armed / disarmed-again — disarmed probes add zero HLO
+    ops, armed probes are identity on the data path. The armed leg is
+    verified to have actually probed (the silent trap is an armed
+    wrapper reusing disarmed jit caches and measuring nothing)."""
+    b, recipe, key = small
+    before = _cube_sha(b, recipe, key)
+    numerics.arm()
+    armed = _cube_sha(b, recipe, key)
+    numerics.flush()
+    sites = numerics.snapshot()["sites"]
+    assert any(s.startswith("realization.") for s in sites), sites
+    numerics.disarm()
+    after = _cube_sha(b, recipe, key)
+    assert before == armed == after
+
+
+def test_probe_disarmed_is_the_object_itself():
+    x = jnp.arange(4.0)
+    assert numerics.probe("anything", x) is x
+    ints = jnp.arange(4)           # non-float: passthrough even armed
+    numerics.arm(clear_caches=False)
+    assert numerics.probe("ints", ints) is ints
+    assert "ints" not in numerics.snapshot()["sites"]
+
+
+# ----------------------------------------------- collector (donated stats)
+
+def test_collector_stats_fold_at_the_drain(small):
+    """The flagship transport: armed realize() stages per-site stats as
+    extra engine outputs and stashes the un-fetched device scalars;
+    flush()/the drain fold them into the ledger with EXACT per-site
+    element accounting (slab elements x realizations)."""
+    b, recipe, key = small
+    nreal = 8
+    numerics.arm()
+    realize(key, b, recipe, nreal=nreal)
+    numerics.flush()
+    doc = numerics.snapshot()
+    white = doc["sites"]["realization.white"]
+    assert white["calls"] == 1
+    # (3, 64) per realization = 192 elements, under the collector cap:
+    # the whole family output of every realization was scanned
+    assert white["elements"] == 3 * 64 * nreal
+    assert white["nonfinite"] == 0 and doc["nonfinite_total"] == 0
+    # the suite runs under x64 (conftest), so the engine's family
+    # outputs are f64 here; the ledger records whatever dtype flowed
+    assert white["max_abs"] > 0 and white["dtype"].startswith("float")
+    finfo_max = np.finfo(np.dtype(white["dtype"])).max
+    assert white["headroom_bits"] == pytest.approx(
+        np.log2(finfo_max) - np.log2(white["max_abs"]))
+    assert "realization.red" in doc["sites"]
+
+
+def math_log2_f32max():
+    return float(np.log2(np.finfo(np.float32).max))
+
+
+def test_collector_slab_respects_the_cap():
+    """One oversized invocation scans only the leading slab — the cap
+    is what keeps armed probes off the flagship step's critical path
+    (< 1% gated in benchmarks/numerics_probe.py)."""
+    col = numerics.Collector()
+    big = jnp.ones((64, 4096), jnp.float32)
+    col.add("cap.site", big)
+    col.take()
+    scanned = numerics._SITE_META["cap.site"][0]
+    assert scanned <= numerics.PROBE_SAMPLE_CAP_COLLECT
+    assert scanned > 0
+
+
+# --------------------------------------------------- episodes + watermarks
+
+def test_episode_opens_on_nonfinite_and_clears_after_streak():
+    numerics.arm(clear_caches=False)
+    bad = jnp.array([1.0, jnp.nan, jnp.inf], jnp.float32)
+    numerics.probe("realization.white", bad)
+    numerics.flush()
+    doc = numerics.snapshot()
+    site = doc["sites"]["realization.white"]
+    assert site["nonfinite"] == 2 and site["episodes"] == 1
+    assert doc["episodes_active"] == ["realization.white"]
+
+    clean = jnp.ones(3, jnp.float32)
+    for _ in range(numerics.EPISODE_CLEAR_AFTER - 1):
+        numerics.probe("realization.white", clean)
+    numerics.flush()
+    assert numerics.snapshot()["episodes_active"] == ["realization.white"]
+    numerics.probe("realization.white", clean)
+    numerics.flush()
+    doc = numerics.snapshot()
+    assert doc["episodes_active"] == []
+    assert doc["sites"]["realization.white"]["episodes"] == 1  # closed, kept
+
+
+def test_watermarks_track_overflow_margin():
+    numerics.arm(clear_caches=False)
+    numerics.probe("solver.winv_diag",
+                   jnp.array([1e30, -2.0, 1e-20, 0.0], jnp.float32))
+    numerics.flush()
+    rec = numerics.snapshot()["sites"]["solver.winv_diag"]
+    assert rec["max_abs"] == pytest.approx(1e30, rel=1e-6)
+    assert rec["min_nonzero"] == pytest.approx(1e-20, rel=1e-6)
+    # f32 overflows at 2**~128: ~28 bits of margin left above 1e30
+    assert rec["headroom_bits"] == pytest.approx(28.3, abs=0.5)
+
+
+def test_scan_block_is_the_post_device_last_line():
+    """The drain scan catches corruption the in-graph probes cannot see
+    (a fault-injected nan lands AFTER device compute — the bench's
+    planted-NaN arm pins the attribution end to end)."""
+    numerics.arm(clear_caches=False)
+    block = np.ones((4, 8), np.float32)
+    block[1, 3] = np.nan
+    assert numerics.scan_block("drain", block) == 1
+    rec = numerics.snapshot()["sites"]["drain"]
+    assert rec["nonfinite"] == 1 and rec["elements"] == 32
+    assert numerics.scan_block("drain", np.ones(4, np.float32)) == 0
+
+
+# -------------------------------------------------- callback-mode fallback
+
+def test_callback_probe_is_jit_vmap_and_grad_safe():
+    """Non-collector graphs (likelihood/fit, mesh shards) use the
+    callback emitter: identity output, one callback per engine call
+    under vmap, and grads flow through probed values unchanged."""
+    numerics.arm(clear_caches=False)
+
+    @jax.jit
+    def f(x):
+        return jnp.sum(numerics.probe("gp.chol_rank", x) ** 2)
+
+    x = jnp.arange(1.0, 5.0)
+    assert float(f(x)) == pytest.approx(float(jnp.sum(x ** 2)))
+    g = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g), 2.0 * np.asarray(x))
+
+    batched = jax.vmap(lambda v: numerics.probe("vmapped", v).sum())(
+        jnp.ones((5, 3)))
+    assert batched.shape == (5,)
+    numerics.flush()
+    doc = numerics.snapshot()
+    assert doc["sites"]["gp.chol_rank"]["calls"] >= 1
+    assert doc["sites"]["vmapped"]["calls"] == 1  # one per engine call
+
+
+def test_arm_from_env(monkeypatch):
+    assert not numerics.arm_from_env({})
+    assert numerics.arm_from_env(
+        {"PTA_NUMERICS": "1", "PTA_NUMERICS_DRIFT_EVERY": "5",
+         "PTA_NUMERICS_SEED": "3"})
+    assert numerics.is_armed()
+    assert numerics.drift_offset() < 5
+    # seeded: the sampled offset is a pure function of the seed
+    assert numerics.drift_offset(5, 3) == numerics.drift_offset(5, 3)
+
+
+# ----------------------------------------------------------------- drift
+
+def test_drain_hook_samples_drift_within_tolerance(small):
+    b, recipe, key = small
+    numerics.arm(drift_every=1, clear_caches=False)
+    numerics.on_drain(0, block=np.ones((2, 3, 64), np.float32),
+                      batch=b, recipe=recipe, key=key, nreal=4)
+    drift = numerics.snapshot()["drift"]
+    assert drift, "sampled chunk recorded no families"
+    for family, rec in drift.items():
+        assert rec["samples"] == 1
+        assert rec["tolerance"] is not None
+        assert rec["worst"] <= rec["tolerance"], (family, rec)
+
+
+# ------------------------------------------------- ledger + report + CLI
+
+def test_numerics_json_roundtrips_through_the_schema_checker(
+        small, tmp_path, capsys):
+    b, recipe, key = small
+    numerics.arm(drift_every=1)
+    realize(key, b, recipe, nreal=4)
+    numerics.on_drain(0, batch=b, recipe=recipe, key=key, nreal=4)
+    numerics.flush()
+    path = numerics.write(str(tmp_path))
+    assert os.path.basename(path) == "numerics.json"
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from check_telemetry_schema import validate_numerics_file
+    finally:
+        sys.path.pop(0)
+    assert validate_numerics_file(path) == []
+
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert doc["schema_version"] == numerics.NUMERICS_SCHEMA_VERSION
+    assert doc["sites"] and doc["drift"]
+
+    from pta_replicator_tpu.__main__ import main
+    main(["numerics", "report", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "realization.white" in out
+    assert "ladder readiness" in out
+
+
+def test_report_names_a_never_armed_capture(tmp_path):
+    text = numerics.render_report(str(tmp_path))
+    assert "no numerics.json" in text and "PTA_NUMERICS" in text
+
+
+def test_ladder_verdict_judges_all_three_legs():
+    doc = {
+        "sites": {
+            "solver.winv_diag": {        # no family: headroom+nf only
+                "nonfinite": 0, "headroom_bits": 20.0},
+            "cov.blocked_pivot": {"nonfinite": 3, "headroom_bits": 30.0},
+            "realization.white": {"nonfinite": 0, "headroom_bits": 2.0},
+            "realization.red": {"nonfinite": 0, "headroom_bits": 12.0},
+            "realization.gwb": {"nonfinite": 0, "headroom_bits": 12.0},
+        },
+        "drift": {
+            "red": {"worst": 1e-5, "tolerance": 3e-3},
+            "gwb": {"worst": 0.5, "tolerance": 3e-3},
+        },
+    }
+    v = numerics.ladder_verdict(doc)
+    assert v["solver.winv_diag"]["ready"]
+    assert not v["cov.blocked_pivot"]["ready"]       # non-finites
+    assert not v["realization.white"]["ready"]       # thin headroom +
+    assert any("no drift samples" in r                # unsampled family
+               for r in v["realization.white"]["reasons"])
+    assert v["realization.red"]["ready"]
+    assert not v["realization.gwb"]["ready"]         # drift over tol
+    assert any("drift" in r for r in v["realization.gwb"]["reasons"])
+
+
+def test_heartbeat_block_is_compact_and_truthful():
+    numerics.arm(clear_caches=False)
+    numerics.probe("realization.white",
+                   jnp.array([jnp.nan, 1e10], jnp.float32))
+    numerics.flush()
+    hb = numerics.heartbeat_block()
+    assert hb["armed"] and hb["nonfinite"] == 1
+    assert hb["episodes_active"] == 1
+    assert hb["worst_headroom_bits"] == pytest.approx(
+        math_log2_f32max() - np.log2(1e10), abs=1e-6)

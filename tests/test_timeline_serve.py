@@ -422,3 +422,51 @@ def test_serve_healthz_readiness_ladder(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_serve_readyz_folds_live_numerics_episode(tmp_path):
+    """The numerics rung (PR 18): a LIVE non-finite episode — a real
+    armed probe seeing NaNs, persisted by numerics.write — turns
+    /readyz 503 with state "numerics" naming the site, while /healthz
+    (pure liveness) stays 200; EPISODE_CLEAR_AFTER clean probe calls
+    close the episode and /readyz recovers to 200."""
+    from pta_replicator_tpu.obs import numerics
+
+    d = str(tmp_path / "cap")
+    os.makedirs(d)
+    with open(os.path.join(d, "progress.json"), "w") as fh:
+        json.dump({"schema": 3}, fh)
+    srv = serve_directory(d, 0, background=True)
+    try:
+        numerics.reset()
+        numerics.arm(clear_caches=False)
+        bad = jnp.array([1.0, jnp.nan, 2.0], jnp.float32)
+        numerics.probe("realization.white", bad)
+        numerics.flush()
+        numerics.write(d)
+
+        status, _ = _get(serve_url(srv, "/healthz"))
+        assert status == 200  # liveness unchanged by corrupt tensors
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(serve_url(srv, "/readyz"),
+                                   timeout=5.0)
+        assert exc.value.code == 503
+        doc = json.loads(exc.value.read())
+        assert doc["state"] == "numerics"
+        assert doc["nonfinite_sites"] == ["realization.white"]
+
+        # the ledger itself is scrapeable while the episode is open
+        _status, body = _get(serve_url(srv, "/numerics"))
+        assert json.loads(body)["episodes_active"] == ["realization.white"]
+
+        clean = jnp.ones(3, jnp.float32)
+        for _ in range(numerics.EPISODE_CLEAR_AFTER):
+            numerics.probe("realization.white", clean)
+        numerics.flush()
+        numerics.write(d)
+        status, _ = _get(serve_url(srv, "/readyz"))
+        assert status == 200  # episode closed: ready again
+    finally:
+        numerics.reset()
+        srv.shutdown()
+        srv.server_close()
