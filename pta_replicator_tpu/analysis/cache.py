@@ -1,0 +1,252 @@
+"""Incremental lint cache: content-hash keyed findings with
+import-graph invalidation.
+
+Two tiers, both pure functions of source bytes (never of mtimes):
+
+* **per-file** — a module's per-file rule findings are keyed by the
+  digest of (its own source, the sources of its *direct project
+  imports*, the analysis-environment signature). The import hashes are
+  the invalidation contract: a module-rule finding is allowed to depend
+  on the linted file, on what its direct imports look like (the
+  telemetry registry a producer references), and on the rule code — on
+  nothing else. Change ``obs/names.py`` and every module importing it
+  re-lints; change an unrelated file and it does not.
+* **whole-tree** — the final, sorted, suppression-classified finding
+  lists are keyed by the digest of every (relpath, content-hash) pair
+  plus the environment signature. On an unchanged tree the engine skips
+  parsing and rule execution entirely — the warm path is hash + load +
+  report, which is what makes the full whole-program lint cheap enough
+  to run on every iteration (``scripts/check.sh`` times it and fails if
+  a warm re-run misses).
+
+The **environment signature** folds in every ``analysis/*.py`` source
+and ``obs/names.py`` (the registry project rules consult), so editing a
+rule or the registry invalidates everything. Cross-file (project +
+interprocedural) findings are only reused on a whole-tree hit: any
+changed file conservatively re-runs them over the full module list,
+which is precisely the "changed file re-runs its dependents' cross-file
+rules" contract ``--changed-only`` needs to stay whole-program-correct.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import module_dotted_name
+from .engine import Finding, Module
+
+CACHE_VERSION = 2
+
+#: default cache file name, created under the lint root
+CACHE_BASENAME = ".graftlint-cache.json"
+
+
+def file_digest(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8", "replace")).hexdigest()[:20]
+
+
+def _digest(*parts: str) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p.encode())
+        h.update(b"\x00")
+    return h.hexdigest()[:20]
+
+
+def env_signature() -> str:
+    """Digest of the analysis package sources + the telemetry registry:
+    the code findings are a function of, beyond the linted sources."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = []
+    analysis_dir = os.path.join(pkg_dir, "analysis")
+    for name in sorted(os.listdir(analysis_dir)):
+        if name.endswith(".py"):
+            paths.append(os.path.join(analysis_dir, name))
+    names_py = os.path.join(pkg_dir, "obs", "names.py")
+    if os.path.exists(names_py):
+        paths.append(names_py)
+    parts = [f"v{CACHE_VERSION}"]
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8", errors="replace") as fh:
+                parts.append(os.path.basename(p))
+                parts.append(file_digest(fh.read()))
+        except OSError:
+            continue
+    return _digest(*parts)
+
+
+def tree_key(hashes: Dict[str, str], env: str) -> str:
+    return _digest(env, *(
+        f"{rel}={h}" for rel, h in sorted(hashes.items())
+    ))
+
+
+# ------------------------------------------------------- import graph
+def project_import_graph(
+    mods: Sequence[Module],
+) -> Dict[str, Set[str]]:
+    """relpath -> relpaths of the *direct* project-internal imports,
+    resolved by dotted-suffix match (relative imports were dot-stripped
+    by the Module parser)."""
+    dotted = {module_dotted_name(m.relpath): m.relpath for m in mods}
+
+    def resolve_head(origin: str, importer: str) -> Optional[str]:
+        parts = origin.split(".")
+        for i in range(len(parts), 0, -1):
+            head = ".".join(parts[:i])
+            if head in dotted:
+                return dotted[head]
+            suffix = "." + head
+            cands = [r for d, r in dotted.items() if d.endswith(suffix)]
+            if len(cands) == 1:
+                return cands[0]
+            if cands:
+                def score(rel):
+                    common = 0
+                    for a, b in zip(rel.split("/"), importer.split("/")):
+                        if a != b:
+                            break
+                        common += 1
+                    return (-common, len(rel), rel)
+                return sorted(cands, key=score)[0]
+        return None
+
+    graph: Dict[str, Set[str]] = {}
+    for m in mods:
+        deps: Set[str] = set()
+        for origin in m.imports.values():
+            rel = resolve_head(origin, m.relpath)
+            if rel is not None and rel != m.relpath:
+                deps.add(rel)
+        graph[m.relpath] = deps
+    return graph
+
+
+def dependents(
+    graph: Dict[str, Set[str]], changed: Set[str]
+) -> Set[str]:
+    """``changed`` plus every module that transitively imports one of
+    them (reverse closure; cycles in the import graph are fine)."""
+    reverse: Dict[str, Set[str]] = {}
+    for rel, deps in graph.items():
+        for d in deps:
+            reverse.setdefault(d, set()).add(rel)
+    out = set(changed)
+    stack = list(changed)
+    while stack:
+        for dep in reverse.get(stack.pop(), ()):
+            if dep not in out:
+                out.add(dep)
+                stack.append(dep)
+    return out
+
+
+def module_key(
+    rel: str, hashes: Dict[str, str], deps: Set[str], env: str
+) -> str:
+    return _digest(
+        env, f"{rel}={hashes[rel]}",
+        *(f"{d}={hashes[d]}" for d in sorted(deps) if d in hashes),
+    )
+
+
+# ------------------------------------------------------------ storage
+def _dump(findings: Sequence[Finding]) -> List[dict]:
+    return [dataclasses.asdict(f) for f in findings]
+
+
+def _load_findings(entries) -> List[Finding]:
+    return [Finding(**e) for e in entries]
+
+
+class LintCache:
+    """On-disk JSON cache; loads tolerant (a corrupt or version-skewed
+    cache is an empty cache, never an error)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.doc: dict = {
+            "version": CACHE_VERSION, "tree": {}, "files": {},
+        }
+        self.hits = 0
+        self.misses = 0
+
+    @classmethod
+    def load(cls, path: str) -> "LintCache":
+        cache = cls(path)
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+            if isinstance(doc, dict) and \
+                    doc.get("version") == CACHE_VERSION:
+                cache.doc = doc
+        except (OSError, ValueError):
+            pass
+        return cache
+
+    def save(self) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(self.doc, fh, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    # -- whole-tree tier -------------------------------------------------
+    def lookup_tree(
+        self, key: str
+    ) -> Optional[Tuple[List[Finding], List[Finding], int]]:
+        entry = self.doc.get("tree", {})
+        if entry.get("key") != key:
+            return None
+        return (
+            _load_findings(entry["active"]),
+            _load_findings(entry["suppressed"]),
+            int(entry["files"]),
+        )
+
+    def store_tree(
+        self, key: str, active: Sequence[Finding],
+        suppressed: Sequence[Finding], nfiles: int,
+    ) -> None:
+        self.doc["tree"] = {
+            "key": key, "active": _dump(active),
+            "suppressed": _dump(suppressed), "files": nfiles,
+        }
+
+    # -- per-file tier ---------------------------------------------------
+    def lookup_module(
+        self, rel: str, key: str
+    ) -> Optional[Tuple[List[Finding], List[Finding]]]:
+        entry = self.doc.get("files", {}).get(rel)
+        if not entry or entry.get("key") != key:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return (
+            _load_findings(entry["active"]),
+            _load_findings(entry["suppressed"]),
+        )
+
+    def store_module(
+        self, rel: str, key: str, active: Sequence[Finding],
+        suppressed: Sequence[Finding],
+    ) -> None:
+        self.doc.setdefault("files", {})[rel] = {
+            "key": key, "active": _dump(active),
+            "suppressed": _dump(suppressed),
+        }
+
+    def prune(self, keep: Set[str]) -> None:
+        files = self.doc.get("files", {})
+        for rel in list(files):
+            if rel not in keep:
+                del files[rel]

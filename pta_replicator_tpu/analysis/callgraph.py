@@ -1,0 +1,379 @@
+"""Project-wide symbol index and conservative call graph.
+
+The per-module rule packs see one file at a time; the interprocedural
+passes (:mod:`.rules_interproc`) need to answer "which functions are
+reachable from this jit entry, through which call chain, holding which
+locks?" across the whole lint target set. This module builds that
+substrate from the already-parsed :class:`~.engine.Module` list — still
+pure ``ast``, no imports of the linted code.
+
+Three layers:
+
+* :class:`SymbolIndex` — every module's top-level functions, classes,
+  methods, nested defs, and name-bound lambdas, addressable as
+  ``relpath::qualpath`` symbols (``parallel/stages.py::StageGraph.stop``),
+  plus alias-resolved import targeting: ``from ..obs import span as s``
+  makes ``s`` resolve to the ``span`` def in the project's ``obs``
+  package. Relative imports were dot-stripped by the Module parser, so
+  origins resolve by *dotted-suffix* match against the lint set's module
+  names (longest match wins, importer-package proximity breaks ties).
+* :class:`CallGraph` — one edge per statically resolvable call site:
+  direct names, imported names, ``self.method()`` resolution through the
+  enclosing class, and lambda targets. Decorated functions keep their
+  def as the edge target (``jit``/``instrumented_jit``/``shard_map``/
+  ``custom_vmap`` wrappers don't hide the body). Dynamic dispatch
+  (``obj.method()`` on an unknown object, dict-of-callables) yields no
+  edge — the graph is deliberately under-approximate, and rules built
+  on it must treat "unreachable" as "not provably reachable".
+* :meth:`CallGraph.reachable_from` — BFS with per-node first-discovery
+  call chains (for printing ``entry -> helper -> sink`` in findings) and
+  a conservative held-lock context: the locks recorded for a function
+  are the intersection over every discovered call path of the ``with
+  <lock>:`` blocks enclosing its call sites.
+"""
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
+
+from .engine import Module
+
+#: wrapper callables whose first argument is (or wraps) the traced
+#: function — unwrapped when resolving decorators and entry points
+WRAPPER_NAMES = {"jit", "instrumented_jit", "shard_map", "custom_vmap",
+                 "custom_jvp", "custom_vjp", "partial", "wraps"}
+
+
+def module_dotted_name(relpath: str) -> str:
+    """``pta_replicator_tpu/utils/sweep.py`` -> ``pta_replicator_tpu.utils.sweep``;
+    an ``__init__.py`` names its package."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else \
+        relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def iter_body_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function/
+    class/lambda scopes (those are their own symbols)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One project function/method/lambda the graph knows about."""
+
+    symbol: str          # "relpath::qualpath"
+    relpath: str
+    qualpath: str        # "fn" | "Class.method" | "outer.inner"
+    name: str            # terminal name
+    cls: Optional[str]   # enclosing class name for methods
+    node: ast.AST        # FunctionDef / AsyncFunctionDef / Lambda
+    module: Module
+    lineno: int
+
+    @property
+    def display(self) -> str:
+        return f"{self.name} ({self.relpath})"
+
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def kwonly_names(self) -> List[str]:
+        return [p.arg for p in self.node.args.kwonlyargs]
+
+
+def arg_bindings(
+    call: ast.Call, info: "FunctionInfo"
+) -> List[Tuple[str, ast.AST]]:
+    """(param_name, argument_expr) pairs for a call to ``info``,
+    positional and keyword, skipping ``*``/``**`` and overflow.
+    Method calls through ``self.m(...)`` bind past the ``self`` slot."""
+    params = info.param_names()
+    offset = 1 if (info.cls and params and params[0] in ("self", "cls")) \
+        else 0
+    out: List[Tuple[str, ast.AST]] = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            break
+        j = i + offset
+        if j < len(params):
+            out.append((params[j], arg))
+    valid = set(params) | set(info.kwonly_names())
+    for kw in call.keywords:
+        if kw.arg and kw.arg in valid:
+            out.append((kw.arg, kw.value))
+    return out
+
+
+class SymbolIndex:
+    """Find project functions by symbol, by (module, name), by AST node
+    identity, or by alias-resolved dotted origin."""
+
+    def __init__(self, mods: Sequence[Module]):
+        self.mods = list(mods)
+        self.by_relpath: Dict[str, Module] = {m.relpath: m for m in mods}
+        #: dotted module name -> relpath (plus reverse-suffix buckets)
+        self.dotted: Dict[str, str] = {
+            module_dotted_name(m.relpath): m.relpath for m in mods
+        }
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.by_qual: Dict[Tuple[str, str], FunctionInfo] = {}
+        self.by_name: Dict[Tuple[str, str], List[FunctionInfo]] = {}
+        self.by_node: Dict[int, FunctionInfo] = {}
+        for m in mods:
+            self._index_module(m)
+
+    # -- construction ----------------------------------------------------
+    def _index_module(self, mod: Module) -> None:
+        def add(node, qualpath, name, cls):
+            info = FunctionInfo(
+                symbol=f"{mod.relpath}::{qualpath}", relpath=mod.relpath,
+                qualpath=qualpath, name=name, cls=cls, node=node,
+                module=mod, lineno=getattr(node, "lineno", 1),
+            )
+            self.functions[info.symbol] = info
+            # first binding wins for duplicate qualpaths (redefinition):
+            # later defs shadow at runtime, but rules want *a* body, and
+            # keeping the first makes chains deterministic
+            self.by_qual.setdefault((mod.relpath, qualpath), info)
+            self.by_name.setdefault((mod.relpath, name), []).append(info)
+            self.by_node[id(node)] = info
+
+        def visit(body, prefix, cls):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qp = f"{prefix}{stmt.name}"
+                    add(stmt, qp, stmt.name, cls)
+                    visit(stmt.body, f"{qp}.", cls)
+                elif isinstance(stmt, ast.ClassDef):
+                    visit(stmt.body, f"{prefix}{stmt.name}.", stmt.name)
+                elif isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Lambda
+                ):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            add(stmt.value, f"{prefix}{t.id}", t.id, cls)
+
+        visit(mod.tree.body, "", None)
+
+    # -- dotted-origin resolution ----------------------------------------
+    def resolve_module(
+        self, head: str, importer_relpath: str = ""
+    ) -> Optional[str]:
+        """relpath of the project module a dotted head names, by exact
+        or suffix match (relative imports were dot-stripped)."""
+        if head in self.dotted:
+            return self.dotted[head]
+        suffix = "." + head
+        candidates = [
+            rel for dn, rel in self.dotted.items() if dn.endswith(suffix)
+        ]
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+        # prefer the module sharing the longest path prefix with the
+        # importer (same-package relative import), then shortest dotted
+        # name, then lexicographic — deterministic either way
+        def score(rel):
+            common = 0
+            for a, b in zip(rel.split("/"), importer_relpath.split("/")):
+                if a != b:
+                    break
+                common += 1
+            return (-common, len(rel), rel)
+        return sorted(candidates, key=score)[0]
+
+    def resolve_origin(
+        self, origin: str, importer_relpath: str = ""
+    ) -> Optional[FunctionInfo]:
+        """Project function an alias-resolved dotted origin names:
+        ``utils.sweep.run`` / ``helpers.Class.method`` -> FunctionInfo."""
+        parts = origin.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            rel = self.resolve_module(".".join(parts[:i]), importer_relpath)
+            if rel is None:
+                continue
+            info = self.by_qual.get((rel, ".".join(parts[i:])))
+            if info is not None:
+                return info
+        return None
+
+    def enclosing_info(
+        self, mod: Module, node: ast.AST
+    ) -> Optional[FunctionInfo]:
+        """The indexed function whose body contains ``node``."""
+        for anc in mod.ancestors(node):
+            info = self.by_node.get(id(anc))
+            if info is not None:
+                return info
+        return None
+
+
+@dataclasses.dataclass
+class CallSite:
+    caller: str
+    callee: str
+    lineno: int
+    locks: FrozenSet[str]    # locks held by `with` blocks at the site
+    call: ast.Call
+
+
+@dataclasses.dataclass
+class Reach:
+    """One reachability answer: the first-discovered call chain from
+    the entry (inclusive) and the locks guaranteed held on every
+    discovered path into the function."""
+
+    chain: Tuple[str, ...]
+    locks: FrozenSet[str]
+
+
+class CallGraph:
+    """Conservative project call graph over a :class:`SymbolIndex`."""
+
+    def __init__(self, index: SymbolIndex):
+        self.index = index
+        self.edges: Dict[str, List[CallSite]] = collections.defaultdict(list)
+        for info in index.functions.values():
+            self._collect_edges(info)
+
+    # -- call resolution -------------------------------------------------
+    def resolve_call(
+        self, mod: Module, func_expr: ast.AST,
+        enclosing: Optional[FunctionInfo],
+    ) -> Optional[FunctionInfo]:
+        """FunctionInfo a call's func expression statically names, else
+        None (dynamic dispatch)."""
+        index = self.index
+        qn = mod.qualname(func_expr)
+        if qn is None:
+            return None
+        parts = qn.split(".")
+        # self.method() / cls.method(): method on the enclosing class
+        if parts[0] in ("self", "cls") and enclosing is not None \
+                and enclosing.cls and len(parts) == 2:
+            return index.by_qual.get(
+                (mod.relpath, f"{enclosing.cls}.{parts[1]}")
+            )
+        if len(parts) == 1:
+            name = parts[0]
+            # nearest definition: sibling nested def, then module level
+            if enclosing is not None:
+                scope_prefix = enclosing.qualpath.rsplit(".", 1)[0] + "." \
+                    if "." in enclosing.qualpath else ""
+                info = index.by_qual.get(
+                    (mod.relpath, f"{enclosing.qualpath}.{name}")
+                ) or index.by_qual.get(
+                    (mod.relpath, f"{scope_prefix}{name}")
+                )
+                if info is not None:
+                    return info
+            info = index.by_qual.get((mod.relpath, name))
+            if info is not None:
+                return info
+            origin = mod.imports.get(name)
+            if origin is not None:
+                return index.resolve_origin(origin, mod.relpath)
+            return None
+        # dotted: resolve the head through import aliases
+        resolved = mod.resolve(func_expr)
+        if resolved is None:
+            return None
+        info = index.resolve_origin(resolved, mod.relpath)
+        if info is not None:
+            return info
+        # Class().method() / local ClassName.method reference
+        if len(parts) == 2:
+            return index.by_qual.get((mod.relpath, f"{parts[0]}.{parts[1]}"))
+        return None
+
+    def _collect_edges(self, info: FunctionInfo) -> None:
+        from .rules_threads import _held_locks
+
+        mod = info.module
+        for node in iter_body_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_call(mod, node.func, info)
+            if callee is None or callee.symbol == info.symbol:
+                continue
+            self.edges[info.symbol].append(CallSite(
+                caller=info.symbol, callee=callee.symbol,
+                lineno=node.lineno,
+                locks=frozenset(_held_locks(mod, node)), call=node,
+            ))
+
+    # -- reachability ----------------------------------------------------
+    def reachable_from(
+        self, entry: str, predicate=None, max_depth: int = 64,
+    ) -> Dict[str, Reach]:
+        """Every function reachable from ``entry`` (inclusive), with the
+        first-discovered chain and the path-intersection lock context.
+        ``predicate(info)`` may prune traversal (return False to stop
+        descending into a function)."""
+        if entry not in self.index.functions:
+            return {}
+        out: Dict[str, Reach] = {
+            entry: Reach(chain=(entry,), locks=frozenset())
+        }
+        queue = collections.deque([(entry, 0)])
+        while queue:
+            sym, depth = queue.popleft()
+            if depth >= max_depth:
+                continue
+            reach = out[sym]
+            info = self.index.functions[sym]
+            if predicate is not None and not predicate(info):
+                continue
+            for site in self.edges.get(sym, ()):
+                locks = reach.locks | site.locks
+                prev = out.get(site.callee)
+                if prev is None:
+                    out[site.callee] = Reach(
+                        chain=reach.chain + (site.callee,), locks=locks
+                    )
+                    queue.append((site.callee, depth + 1))
+                else:
+                    shrunk = prev.locks & locks
+                    if shrunk != prev.locks:
+                        # weaker lock guarantee on a new path: revisit
+                        out[site.callee] = Reach(prev.chain, shrunk)
+                        queue.append((site.callee, depth + 1))
+        return out
+
+    def format_chain(self, chain: Sequence[str]) -> str:
+        """``engine (models/batched.py) -> helper (utils/x.py)``."""
+        return " -> ".join(
+            self.index.functions[s].display for s in chain
+        )
+
+
+# A tiny keyed memo so the interprocedural rules (each invoked
+# separately by the engine) share one graph per run. Entries hold the
+# Modules alive, so id() keys cannot be recycled while cached.
+_GRAPH_MEMO: "collections.OrderedDict[tuple, CallGraph]" = \
+    collections.OrderedDict()
+
+
+def project_graph(mods: Sequence[Module]) -> CallGraph:
+    key = tuple(id(m) for m in mods)
+    graph = _GRAPH_MEMO.get(key)
+    if graph is None:
+        graph = CallGraph(SymbolIndex(mods))
+        _GRAPH_MEMO[key] = graph
+        while len(_GRAPH_MEMO) > 4:
+            _GRAPH_MEMO.popitem(last=False)
+    return graph

@@ -3,7 +3,8 @@
 Deliberately jax-free (the engine parses source, it never imports the
 linted code) so the lint gate stays fast enough for the tier-1 test
 path and pre-commit use. Exit codes: 0 clean (possibly with baselined/
-suppressed findings), 1 new findings, 2 usage/internal error.
+suppressed findings), 1 new findings (or a cache miss under
+``--expect-warm``), 2 usage/internal error.
 """
 from __future__ import annotations
 
@@ -12,7 +13,7 @@ import os
 import sys
 from typing import Optional, Sequence
 
-from .engine import lint, write_baseline
+from .engine import default_rules, lint, write_baseline
 
 #: default lint targets, relative to the repo root (missing entries are
 #: skipped so an installed package without the repo harness still lints)
@@ -21,6 +22,12 @@ DEFAULT_TARGETS = (
     "scripts",
     "benchmarks",
     "bench.py",
+)
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
 )
 
 
@@ -35,13 +42,114 @@ def default_baseline_path() -> str:
                         "baseline.json")
 
 
+def default_cache_path(root: str) -> str:
+    from .cache import CACHE_BASENAME
+
+    return os.path.join(root, CACHE_BASENAME)
+
+
+# ----------------------------------------------------------------- SARIF
+def _sarif_result(f, suppressed_kind: Optional[str] = None) -> dict:
+    out = {
+        "ruleId": f.rule,
+        "level": "error" if f.severity == "error" else "warning",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(1, f.line)},
+            },
+        }],
+        "partialFingerprints": {"graftlint/v1": f.fingerprint},
+    }
+    if suppressed_kind is not None:
+        out["suppressions"] = [{"kind": suppressed_kind}]
+    return out
+
+
+def to_sarif(result: dict) -> dict:
+    """SARIF 2.1.0 document: new findings as plain results, baselined
+    ones carried with an ``external`` suppression (so a SARIF viewer
+    shows the debt without failing on it)."""
+    rules_meta = []
+    seen = set()
+    for rule in default_rules():
+        if rule.id in seen:
+            continue  # per-module and interprocedural variants share ids
+        seen.add(rule.id)
+        rules_meta.append({
+            "id": rule.id,
+            "shortDescription": {"text": rule.description or rule.id},
+            "defaultConfiguration": {
+                "level": "error" if rule.severity == "error"
+                else "warning",
+            },
+        })
+    results = [_sarif_result(f) for f in result["new"]]
+    results += [
+        _sarif_result(f, suppressed_kind="external")
+        for f in result["baselined"]
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": sorted(rules_meta, key=lambda r: r["id"]),
+            }},
+            "results": results,
+        }],
+    }
+
+
+# --------------------------------------------------------------- explain
+def explain_rule(rule_id: str, out) -> int:
+    matches = [r for r in default_rules() if r.id == rule_id]
+    if not matches:
+        known = sorted({r.id for r in default_rules()})
+        print(f"graftlint: unknown rule {rule_id!r}; known rules:",
+              file=out)
+        for rid in known:
+            print(f"  {rid}", file=out)
+        return 2
+    for rule in matches:
+        cls = type(rule)
+        print(f"{rule.id} [{rule.severity}] — "
+              f"{cls.__module__.rsplit('.', 1)[-1]}.{cls.__name__}",
+              file=out)
+        if rule.description:
+            print(f"  {rule.description}", file=out)
+        doc = (cls.__doc__ or "").strip()
+        if doc:
+            print(file=out)
+            for line in doc.splitlines():
+                print(f"  {line.strip()}", file=out)
+        if rule.example_fire:
+            print("\n  fires on:", file=out)
+            for line in rule.example_fire.rstrip().splitlines():
+                print(f"    {line}", file=out)
+        if rule.example_ok:
+            print("\n  clean:", file=out)
+            for line in rule.example_ok.rstrip().splitlines():
+                print(f"    {line}", file=out)
+        print(file=out)
+    return 0
+
+
+# ------------------------------------------------------------------ lint
 def run_lint(
     paths: Sequence[str],
     fmt: str = "text",
     baseline: Optional[str] = None,
     update_baseline: bool = False,
+    prune_baseline: bool = False,
     changed_only: bool = False,
     root: Optional[str] = None,
+    use_cache: bool = True,
+    expect_warm: bool = False,
     out=None,
 ) -> int:
     out = out if out is not None else sys.stdout
@@ -52,15 +160,21 @@ def run_lint(
             "--update-baseline needs the full finding set; it cannot be "
             "combined with --changed-only"
         )
+    if prune_baseline and (update_baseline or changed_only):
+        raise ValueError(
+            "--prune-baseline needs the full finding set on its own; it "
+            "cannot be combined with --update-baseline or --changed-only"
+        )
     root = root or repo_root()
     if not paths:
         paths = [p for p in DEFAULT_TARGETS
                  if os.path.exists(os.path.join(root, p))]
     baseline = baseline if baseline is not None else default_baseline_path()
+    cache_path = default_cache_path(root) if use_cache else None
 
     result = lint(
         paths, root, baseline_path=None if update_baseline else baseline,
-        changed_only=changed_only,
+        changed_only=changed_only, cache_path=cache_path,
     )
 
     if update_baseline:
@@ -71,7 +185,20 @@ def run_lint(
         )
         return 0
 
-    if fmt == "json":
+    if prune_baseline:
+        kept = result["baselined"]  # entries still matching a finding
+        write_baseline(baseline, kept)
+        n = len(result["stale"])
+        print(
+            f"pruned {n} stale entr{'y' if n == 1 else 'ies'} from "
+            f"{baseline} ({len(kept)} kept)", file=out,
+        )
+        return 0
+
+    if fmt == "sarif":
+        json.dump(to_sarif(result), out, indent=1, sort_keys=True)
+        out.write("\n")
+    elif fmt == "json":
         json.dump({
             "files": result["files"],
             "new": [f.to_json() for f in result["new"]],
@@ -81,30 +208,38 @@ def run_lint(
             "exit_code": result["exit_code"],
         }, out, indent=1, sort_keys=True)
         out.write("\n")
-        return result["exit_code"]
-
-    if result["note"]:
-        print(f"note: {result['note']}", file=out)
-    for f in result["new"]:
-        print(f.format(), file=out)
-    for f in result["baselined"]:
-        print(f"{f.format()}  (baselined)", file=out)
-    for entry in result["stale"]:
+    else:
+        if result["note"]:
+            print(f"note: {result['note']}", file=out)
+        for f in result["new"]:
+            print(f.format(), file=out)
+        for f in result["baselined"]:
+            print(f"{f.format()}  (baselined)", file=out)
+        for entry in result["stale"]:
+            print(
+                f"stale baseline entry (finding fixed — remove it): "
+                f"{entry.get('rule')} {entry.get('path')}: "
+                f"{entry.get('message')}", file=out,
+            )
         print(
-            f"stale baseline entry (finding fixed — remove it): "
-            f"{entry.get('rule')} {entry.get('path')}: "
-            f"{entry.get('message')}", file=out,
+            f"graftlint: {result['files']} file(s), "
+            f"{len(result['new'])} new, "
+            f"{len(result['baselined'])} baselined, "
+            f"{len(result['suppressed'])} suppressed"
+            + (f", {len(result['stale'])} stale baseline entr"
+               f"{'y' if len(result['stale']) == 1 else 'ies'}"
+               if result["stale"] else ""),
+            file=out,
         )
-    print(
-        f"graftlint: {result['files']} file(s), "
-        f"{len(result['new'])} new, "
-        f"{len(result['baselined'])} baselined, "
-        f"{len(result['suppressed'])} suppressed"
-        + (f", {len(result['stale'])} stale baseline entr"
-           f"{'y' if len(result['stale']) == 1 else 'ies'}"
-           if result["stale"] else ""),
-        file=out,
-    )
+
+    if expect_warm and result.get("cache") != "warm":
+        print(
+            f"graftlint: --expect-warm: cache was "
+            f"{result.get('cache')!r}, not 'warm' (the tree changed, "
+            "the cache was invalidated, or caching is off)",
+            file=sys.stderr,
+        )
+        return 1
     return result["exit_code"]
 
 
@@ -118,7 +253,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="files/directories to lint (default: the "
                          "package, scripts/, benchmarks/, bench.py)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--baseline", default=None, metavar="PATH",
                     help="baseline JSON (default: "
                          "pta_replicator_tpu/analysis/baseline.json)")
@@ -126,17 +262,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="rewrite the baseline with every current "
                          "finding and exit 0 (use sparingly: the "
                          "baseline is a ratchet, not a dumping ground)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop stale fingerprints (fixed findings) from "
+                         "the baseline, keep the rest, exit 0")
     ap.add_argument("--changed-only", action="store_true",
-                    help="lint only files differing from main "
-                         "(plus uncommitted work) for quick iteration")
+                    help="report only findings in files differing from "
+                         "main (plus uncommitted work); the analysis "
+                         "still runs whole-program")
+    ap.add_argument("--explain", default=None, metavar="RULE",
+                    help="print a rule's documentation plus a firing "
+                         "and a non-firing example, then exit")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the incremental cache "
+                         "(.graftlint-cache.json at the repo root)")
+    ap.add_argument("--expect-warm", action="store_true",
+                    help="exit 1 unless this run was served entirely "
+                         "from the warm cache (CI guard: the cache must "
+                         "hit on an unchanged tree)")
     args = ap.parse_args(argv)
     try:
+        if args.explain is not None:
+            return explain_rule(args.explain, sys.stdout)
         return run_lint(
             args.paths,
             fmt=args.format,
             baseline=args.baseline,
             update_baseline=args.update_baseline,
+            prune_baseline=args.prune_baseline,
             changed_only=args.changed_only,
+            use_cache=not args.no_cache,
+            expect_warm=args.expect_warm,
         )
     except (OSError, ValueError) as exc:
         print(f"graftlint: {exc}", file=sys.stderr)

@@ -1,0 +1,196 @@
+"""Bounded interprocedural dataflow over the project call graph.
+
+A deliberately small taint-style framework: facts about *function
+parameters* and *return values* propagate through call arguments and
+returns, iterated to a bounded fixpoint over the whole
+:class:`~.callgraph.CallGraph`. No abstract interpretation, no path
+conditions — each rule supplies a per-function *scan* that reads the
+current summary table and produces this function's facts; the engine
+re-scans until the table stops changing (or the round bound trips,
+which truncates to an under-approximation: interprocedural rules built
+here may miss deep chains but never invent facts from stale rounds).
+
+Shipped fact kinds (what :mod:`.rules_interproc` needs today):
+
+* :func:`key_consumer_params` — which parameters of each function flow
+  into a ``jax.random`` *sampler* (directly, or by being passed on to
+  another function's key-consuming parameter). Flow-sensitive per
+  function: a rebinding of the name before the consuming call kills the
+  fact, mirroring the per-module ``jax-key-reuse`` semantics. Each fact
+  carries a witness chain for finding messages.
+* :func:`fresh_key_returns` — functions whose return value is a freshly
+  derived PRNG key (``split``/``fold_in``/``PRNGKey``/``clone`` result,
+  or transitively another fresh-key-returning call), so callers'
+  ``key = derive(key, i)`` rebindings register as key-variable makers
+  even when the maker lives in another module.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FunctionInfo, arg_bindings, iter_body_nodes
+
+#: fixpoint round bound: facts deeper than this many call layers are
+#: dropped (bounded-depth truncation, never stale propagation)
+MAX_ROUNDS = 8
+
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "clone"}
+#: jax.random callables that *inspect* a key without drawing from it
+#: (serialization/introspection): passing a key here is not consumption
+_NON_CONSUMING = {"key_data", "wrap_key_data", "key_impl"}
+_RANDOM_PREFIX = "jax.random."
+
+
+def fixpoint(
+    graph: CallGraph,
+    scan: Callable[[FunctionInfo, Dict[str, object]], object],
+    max_rounds: int = MAX_ROUNDS,
+) -> Dict[str, object]:
+    """Iterate ``scan(info, summaries)`` over every function until the
+    summary table is stable (or ``max_rounds``). ``scan`` must be
+    monotone in the summaries it reads for the bound to truncate safely.
+    """
+    summaries: Dict[str, object] = {}
+    order = sorted(graph.index.functions)
+    for _ in range(max_rounds):
+        changed = False
+        for sym in order:
+            facts = scan(graph.index.functions[sym], summaries)
+            if facts != summaries.get(sym):
+                summaries[sym] = facts
+                changed = True
+        if not changed:
+            break
+    return summaries
+
+
+# -------------------------------------------------------- key dataflow
+@dataclasses.dataclass(frozen=True)
+class KeyConsume:
+    """Parameter ``param`` of a function reaches a jax.random sampler —
+    ``witness`` is the call chain (display strings) from the function
+    down to the sampler call."""
+
+    param: str
+    witness: Tuple[str, ...]
+
+
+def _is_sampler(mod, call: ast.Call) -> Optional[str]:
+    """Resolved jax.random sampler name for a call (``normal``,
+    ``uniform``, ...), None for makers/non-random calls."""
+    resolved = mod.resolve(call.func) or ""
+    if not resolved.startswith(_RANDOM_PREFIX):
+        return None
+    terminal = resolved.rsplit(".", 1)[-1]
+    if terminal in _KEY_MAKERS or terminal in _NON_CONSUMING:
+        return None
+    return terminal
+
+
+def _is_key_maker_call(mod, call: ast.Call) -> bool:
+    resolved = mod.resolve(call.func) or ""
+    return (
+        resolved.startswith(_RANDOM_PREFIX)
+        and resolved.rsplit(".", 1)[-1] in _KEY_MAKERS
+    )
+
+
+def _line_order(node: ast.AST) -> Tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+def _assigned_names(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    else:
+        return out
+    for t in targets:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            out.update(e.id for e in t.elts if isinstance(e, ast.Name))
+    return out
+
+
+def key_consumer_params(graph: CallGraph) -> Dict[str, Dict[str, Tuple[str, ...]]]:
+    """symbol -> {param name -> witness chain} for every parameter that
+    flows into a jax.random sampler before being rebound."""
+
+    def scan(info: FunctionInfo, summaries):
+        params = set(info.param_names()) | set(info.kwonly_names())
+        if not params:
+            return {}
+        mod = info.module
+        events = []  # (order, kind, name, witness)
+        for node in iter_body_nodes(info.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for name in _assigned_names(node):
+                    events.append((_line_order(node), "rebind", name, ()))
+            if not isinstance(node, ast.Call):
+                continue
+            sampler = _is_sampler(mod, node)
+            if sampler is not None and node.args and isinstance(
+                node.args[0], ast.Name
+            ):
+                events.append((
+                    _line_order(node), "consume", node.args[0].id,
+                    (f"jax.random.{sampler}",),
+                ))
+                continue
+            callee = graph.resolve_call(mod, node.func, info)
+            if callee is None:
+                continue
+            callee_facts = summaries.get(callee.symbol) or {}
+            if not callee_facts:
+                continue
+            for pname, arg in arg_bindings(node, callee):
+                if pname in callee_facts and isinstance(arg, ast.Name):
+                    events.append((
+                        _line_order(node), "consume", arg.id,
+                        (callee.display,) + tuple(callee_facts[pname]),
+                    ))
+        facts: Dict[str, Tuple[str, ...]] = {}
+        for _order, kind, name, witness in sorted(
+            events, key=lambda e: e[0]
+        ):
+            if kind == "rebind":
+                params.discard(name)
+            elif name in params and name not in facts:
+                facts[name] = witness
+        return facts
+
+    return fixpoint(graph, scan)  # type: ignore[return-value]
+
+
+def fresh_key_returns(graph: CallGraph) -> Set[str]:
+    """Symbols of functions whose return value is a freshly derived
+    PRNG key (directly or through another fresh-key-returning call)."""
+
+    def scan(info: FunctionInfo, summaries):
+        mod = info.module
+        if isinstance(info.node, ast.Lambda):
+            returns = [info.node.body]
+        else:
+            returns = [
+                n.value for n in iter_body_nodes(info.node)
+                if isinstance(n, ast.Return) and n.value is not None
+            ]
+        for value in returns:
+            # split(...)[0] / tuple returns: look through subscripts
+            expr = value.value if isinstance(value, ast.Subscript) else value
+            if not isinstance(expr, ast.Call):
+                continue
+            if _is_key_maker_call(mod, expr):
+                return True
+            callee = graph.resolve_call(mod, expr.func, info)
+            if callee is not None and summaries.get(callee.symbol):
+                return True
+        return False
+
+    table = fixpoint(graph, scan)
+    return {sym for sym, fresh in table.items() if fresh}

@@ -167,6 +167,9 @@ class Rule:
     id: str = "abstract"
     severity: str = SEVERITY_ERROR
     description: str = ""
+    #: short firing / non-firing source examples for ``lint --explain``
+    example_fire: str = ""
+    example_ok: str = ""
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
         return ()
@@ -187,6 +190,7 @@ def default_rules() -> List[Rule]:
     from . import (
         rules_bench,
         rules_cov,
+        rules_interproc,
         rules_jax,
         rules_obs,
         rules_robust,
@@ -204,6 +208,7 @@ def default_rules() -> List[Rule]:
         *rules_scenarios.RULES,
         *rules_cov.RULES,
         *rules_bench.RULES,
+        *rules_interproc.RULES,
     ]
 
 
@@ -243,14 +248,20 @@ def iter_python_files(paths: Sequence[str], root: str) -> List[str]:
 
 
 def parse_modules(
-    files: Sequence[str], root: str
+    files: Sequence[str], root: str,
+    sources: Optional[Dict[str, str]] = None,
 ) -> Tuple[List[Module], List[Finding]]:
     """Parse every file; a syntax error becomes a finding, not a crash
-    (the linter must be able to report on a broken tree)."""
+    (the linter must be able to report on a broken tree). ``sources``
+    (abspath -> text) lets callers that already read the files for
+    hashing skip the second read."""
     mods, problems = [], []
     for path in files:
         try:
-            mods.append(Module(path, root))
+            source = None if sources is None else sources.get(
+                os.path.abspath(path)
+            )
+            mods.append(Module(path, root, source=source))
         except SyntaxError as exc:
             rel = os.path.relpath(path, root).replace(os.sep, "/")
             problems.append(Finding(
@@ -260,28 +271,70 @@ def parse_modules(
     return mods, problems
 
 
+def _finding_sort_key(f: Finding):
+    return (f.path, f.line, f.rule, f.message)
+
+
+def _classify(
+    findings: Iterable[Finding], by_rel: Dict[str, Module]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Split raw rule output into (active, suppressed) through the
+    per-line inline-suppression tables."""
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        mod = by_rel.get(f.path)
+        if mod is not None and mod.is_suppressed(f):
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+def run_module_rules(
+    mod: Module, rules: Sequence[Rule]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Per-file layer only: every rule's ``check_module`` over one
+    module. Cacheable per file — depends on this source (plus whatever
+    its direct imports contribute to name resolution) and the rules."""
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check_module(mod))
+    return _classify(raw, {mod.relpath: mod})
+
+
+def run_project_rules(
+    mods: Sequence[Module], rules: Sequence[Rule]
+) -> Tuple[List[Finding], List[Finding]]:
+    """Cross-file layers (project rules + interprocedural passes): every
+    rule's ``check_project`` over the full module list. Never cached
+    per-file — any source change can shift a cross-file fact."""
+    by_rel = {m.relpath: m for m in mods}
+    raw: List[Finding] = []
+    for rule in rules:
+        raw.extend(rule.check_project(mods))
+    return _classify(raw, by_rel)
+
+
 def run_rules(
     mods: Sequence[Module], rules: Optional[Sequence[Rule]] = None
 ) -> Tuple[List[Finding], List[Finding]]:
     """Run every rule; returns (active findings, suppressed findings),
     both sorted by (path, line, rule)."""
     rules = list(rules) if rules is not None else default_rules()
-    by_rel = {m.relpath: m for m in mods}
     active: List[Finding] = []
     suppressed: List[Finding] = []
-    for rule in rules:
-        collected: List[Finding] = []
-        for mod in mods:
-            collected.extend(rule.check_module(mod))
-        collected.extend(rule.check_project(mods))
-        for f in collected:
-            mod = by_rel.get(f.path)
-            if mod is not None and mod.is_suppressed(f):
-                suppressed.append(f)
-            else:
-                active.append(f)
-    key = lambda f: (f.path, f.line, f.rule, f.message)  # noqa: E731
-    return sorted(active, key=key), sorted(suppressed, key=key)
+    for mod in mods:
+        a, s = run_module_rules(mod, rules)
+        active.extend(a)
+        suppressed.extend(s)
+    a, s = run_project_rules(mods, rules)
+    active.extend(a)
+    suppressed.extend(s)
+    return (
+        sorted(active, key=_finding_sort_key),
+        sorted(suppressed, key=_finding_sort_key),
+    )
 
 
 # -------------------------------------------------------------- baseline
@@ -378,29 +431,129 @@ def lint(
     rules: Optional[Sequence[Rule]] = None,
     baseline_path: Optional[str] = None,
     changed_only: bool = False,
+    changed_files: Optional[Sequence[str]] = None,
+    cache_path: Optional[str] = None,
 ) -> dict:
     """Run the engine end to end; returns a result dict with keys
     ``new`` / ``baselined`` / ``suppressed`` (Finding lists), ``stale``
-    (baseline entries), ``files`` (count), and ``exit_code``."""
+    (baseline entries), ``files`` (count), ``cache`` (state string), and
+    ``exit_code``.
+
+    ``--changed-only`` is a *report* filter, not an analysis filter: the
+    engine always parses and runs every rule over the full file set
+    (cross-file facts from unchanged files must keep informing findings
+    in changed files, and stale-baseline detection needs the full
+    picture), then restricts the reported new/baselined/suppressed
+    findings to the changed scope. ``changed_files`` overrides the git
+    query for tests.
+
+    ``cache_path`` enables the two-tier incremental cache
+    (:mod:`.cache`). Only the default rule set is ever cached — passing
+    explicit ``rules`` bypasses it, since cache keys don't encode
+    out-of-tree rule code.
+    """
     files = iter_python_files(paths, root)
     note = None
+    scope: Optional[set] = None
     if changed_only:
-        changed = git_changed_files(root)
+        changed = (
+            list(changed_files) if changed_files is not None
+            else git_changed_files(root)
+        )
         if changed is None:
             note = "--changed-only: git unavailable, linting everything"
         else:
-            files = filter_changed(files, changed, root)
-    mods, parse_problems = parse_modules(files, root)
-    active, suppressed = run_rules(mods, rules)
-    active = parse_problems + active
+            changed_abs = {
+                os.path.abspath(os.path.join(root, c)) for c in changed
+            }
+            scope = {
+                os.path.relpath(f, os.path.abspath(root)).replace(os.sep, "/")
+                for f in files if os.path.abspath(f) in changed_abs
+            }
+
+    sources: Dict[str, str] = {}
+    rels: Dict[str, str] = {}
+    abs_root = os.path.abspath(root)
+    for path in files:
+        apath = os.path.abspath(path)
+        with open(apath, encoding="utf-8", errors="replace") as fh:
+            sources[apath] = fh.read()
+        rels[apath] = os.path.relpath(apath, abs_root).replace(os.sep, "/")
+
+    cache = None
+    cache_state = "off"
+    active: Optional[List[Finding]] = None
+    suppressed: List[Finding] = []
+    if cache_path is not None and rules is None:
+        from . import cache as cache_mod
+
+        cache = cache_mod.LintCache.load(cache_path)
+        env = cache_mod.env_signature()
+        hashes = {
+            rels[a]: cache_mod.file_digest(src)
+            for a, src in sources.items()
+        }
+        tkey = cache_mod.tree_key(hashes, env)
+        hit = cache.lookup_tree(tkey)
+        if hit is not None:
+            active, suppressed, _ = hit
+            cache_state = "warm"
+
+    if active is None:
+        mods, parse_problems = parse_modules(files, root, sources)
+        rule_list = list(rules) if rules is not None else default_rules()
+        mod_active: List[Finding] = []
+        mod_suppressed: List[Finding] = []
+        if cache is not None:
+            from . import cache as cache_mod
+
+            igraph = cache_mod.project_import_graph(mods)
+            for mod in mods:
+                mkey = cache_mod.module_key(
+                    mod.relpath, hashes, igraph.get(mod.relpath, set()),
+                    env,
+                )
+                cached = cache.lookup_module(mod.relpath, mkey)
+                if cached is None:
+                    a, s = run_module_rules(mod, rule_list)
+                    cache.store_module(mod.relpath, mkey, a, s)
+                else:
+                    a, s = cached
+                mod_active.extend(a)
+                mod_suppressed.extend(s)
+        else:
+            for mod in mods:
+                a, s = run_module_rules(mod, rule_list)
+                mod_active.extend(a)
+                mod_suppressed.extend(s)
+        proj_active, proj_suppressed = run_project_rules(mods, rule_list)
+        active = sorted(
+            mod_active + proj_active, key=_finding_sort_key
+        )
+        suppressed = sorted(
+            mod_suppressed + proj_suppressed, key=_finding_sort_key
+        )
+        active = parse_problems + active
+        if cache is not None:
+            cache_state = "cold" if cache.hits == 0 else "partial"
+            cache.store_tree(tkey, active, suppressed, len(files))
+            cache.prune(set(rels.values()))
+            cache.save()
+
     baseline = load_baseline(baseline_path)
     new, old, stale = apply_baseline(active, baseline)
+    if scope is not None:
+        new = [f for f in new if f.path in scope]
+        old = [f for f in old if f.path in scope]
+        suppressed = [f for f in suppressed if f.path in scope]
     return {
         "new": new,
         "baselined": old,
         "suppressed": suppressed,
         "stale": stale,
         "files": len(files),
+        "scoped": None if scope is None else len(scope),
         "note": note,
+        "cache": cache_state,
         "exit_code": 1 if new else 0,
     }

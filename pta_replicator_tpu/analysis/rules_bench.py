@@ -175,6 +175,16 @@ class SilentGate(Rule):
         "benchmark gate failure exits nonzero without printing the "
         "reason to stderr — CI goes red with an empty log"
     )
+    example_fire = (
+        "if regression > budget:\n"
+        "    sys.exit(1)                  # red CI, empty log: FIRES\n"
+    )
+    example_ok = (
+        "if regression > budget:\n"
+        "    print(f'gate: {regression:.1%} > {budget:.1%}',\n"
+        "          file=sys.stderr)\n"
+        "    sys.exit(1)\n"
+    )
 
     def _scan(
         self,

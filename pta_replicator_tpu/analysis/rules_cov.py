@@ -65,6 +65,12 @@ def _mentions_float64(node: ast.AST) -> bool:
 class CovF32Cholesky(Rule):
     id = "cov-f32-cholesky"
     severity = "error"
+    example_fire = (
+        "L = jnp.linalg.cholesky(c)       # caller dtype unknown: FIRES\n"
+    )
+    example_ok = (
+        "L = jnp.linalg.cholesky(c.astype(jnp.float64))\n"
+    )
     description = (
         "cholesky/solve_triangular call without an explicit float64 "
         "cast or an inline suppression naming why the caller dtype is "

@@ -1,0 +1,653 @@
+"""graftlint rule pack: whole-program interprocedural passes.
+
+These rules run as project rules over the :mod:`.callgraph` /
+:mod:`.dataflow` substrate and catch the defect classes the per-module
+packs provably cannot see:
+
+* ``jax-host-sync`` (interprocedural) — a host sync (``.item()``,
+  ``.block_until_ready()``, ``np.asarray``, ``float(x)``) in any
+  function *reachable from* a jit-traced entry, including entries
+  wrapped in another module (``instrumented_jit(helper_from_b)``). The
+  finding message prints the call chain from the entry to the sync.
+* ``jax-key-reuse`` (interprocedural) — a PRNG key consumed twice where
+  at least one consumption happens *through* a helper call (the key
+  flows into a parameter that reaches a ``jax.random`` sampler,
+  possibly in another module), or where the key itself was derived by a
+  helper (``key = derive(seed)`` whose body ends in ``split``/
+  ``fold_in``). The per-module rule only sees direct sampler calls on
+  module-visible key variables.
+* ``thread-shared-state-race`` — collects every ``Thread(target=...)``
+  / executor ``submit(fn)`` in the package, computes which instance
+  attributes and module globals each spawned target (transitively)
+  mutates and under which locks (``with`` context at the write site
+  plus locks held along the call chain), and flags state written from
+  two or more threads-of-control with no common lock. A target spawned
+  in a loop (worker pools) races with its own siblings and counts as
+  two threads by itself. Locks are matched by terminal name against the
+  same convention :data:`.rules_threads.LOCK_HIERARCHY` records.
+* ``telemetry-dead-name`` — a constant registered in ``obs/names.py``
+  that no call site in the whole tree ever emits: never referenced by
+  name in any linted module (or in ``tests/``), and its string value
+  never appears at a producer call site. Dead names rot the registry —
+  the report renderer and schema checker keep promising a signal nobody
+  produces.
+
+Module-covered findings are skipped: anything the per-module packs
+already report (a sync lexically inside a decorated jit function, a
+double direct-sampler consumption) never double-reports here.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import dataflow
+from .callgraph import (
+    CallGraph,
+    FunctionInfo,
+    arg_bindings,
+    iter_body_nodes,
+    project_graph,
+)
+from .engine import Finding, Module, Rule
+from .rules_jax import (
+    _decorator_is_jit,
+    _is_jitlike_callable,
+    _module_level_mutables,
+    iter_host_syncs,
+    jit_function_nodes,
+)
+from .rules_telemetry import NAMES_RELPATH, _PRODUCER_KINDS, _is_test_file
+from .rules_threads import _MUTATOR_METHODS, _held_locks
+
+#: methods that run before an object is published to other threads —
+#: their writes are construction, not racing
+_CONSTRUCTION_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+# ------------------------------------------------------------ jit entries
+def jit_entry_symbols(graph: CallGraph) -> Dict[str, str]:
+    """symbol -> entry label for every function that ends up
+    jit-compiled, including cross-module wrapper forms the per-module
+    detector cannot attribute (``instrumented_jit(imported_helper)``)."""
+    index = graph.index
+    entries: Dict[str, str] = {}
+    for mod in index.mods:
+        for fn in jit_function_nodes(mod):
+            info = index.by_node.get(id(fn))
+            if info is not None:
+                entries.setdefault(info.symbol, info.name)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            is_jit = _is_jitlike_callable(mod, node.func) or (
+                isinstance(node.func, (ast.Name, ast.Attribute))
+                and _decorator_is_jit(mod, node)
+            )
+            if not is_jit:
+                continue
+            enclosing = index.enclosing_info(mod, node)
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, (ast.Name, ast.Attribute)):
+                    info = graph.resolve_call(mod, sub, enclosing)
+                    if info is not None:
+                        entries.setdefault(info.symbol, info.name)
+    return entries
+
+
+def _tracer_barrier(info: FunctionInfo) -> bool:
+    """True for functions that explicitly discriminate tracers from
+    concrete values (``isinstance(x, jax.core.Tracer)``). Both shapes in
+    the tree — raise-on-tracer guards and ``host_ok`` branching — mean
+    the host-only body can never execute under a trace, so host syncs
+    inside (or reached through) such a function are not jit syncs."""
+    mod = info.module
+    for node in ast.walk(info.node):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            resolved = mod.resolve(node) or ""
+            if resolved.endswith("core.Tracer"):
+                return True
+    return False
+
+
+def _module_covered(index) -> Set[str]:
+    """Symbols whose body the per-module jax rules already scan (defs
+    the module-local jit detector marks)."""
+    covered: Set[str] = set()
+    for mod in index.mods:
+        for fn in jit_function_nodes(mod):
+            info = index.by_node.get(id(fn))
+            if info is not None:
+                covered.add(info.symbol)
+    return covered
+
+
+class InterprocHostSync(Rule):
+    """Host syncs in helpers reachable from a jit entry — the cross-
+    module extension of the per-module ``jax-host-sync`` rule, with the
+    call chain printed in the finding."""
+
+    id = "jax-host-sync"
+    severity = "error"
+    description = (
+        "host-device sync in a function reachable from a jit-traced "
+        "entry (cross-module call chain printed in the finding)"
+    )
+    example_fire = (
+        "# helpers.py\n"
+        "def summarize(x):\n"
+        "    return x.mean().item()       # host sync, two calls deep\n"
+        "# engine.py\n"
+        "from helpers import summarize\n"
+        "@jax.jit\n"
+        "def engine(x):\n"
+        "    return summarize(x)\n"
+    )
+    example_ok = (
+        "# engine.py\n"
+        "@jax.jit\n"
+        "def engine(x):\n"
+        "    return x.mean()\n"
+        "print(engine(x).item())          # sync outside the trace\n"
+    )
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        graph = project_graph(mods)
+        index = graph.index
+        covered = _module_covered(index)
+        entries = jit_entry_symbols(graph)
+        barriers: Dict[str, bool] = {}
+
+        def not_barrier(info: FunctionInfo) -> bool:
+            sym = info.symbol
+            if sym not in barriers:
+                barriers[sym] = _tracer_barrier(info)
+            return not barriers[sym]
+
+        seen: Set[Tuple[str, int, str]] = set()
+        for entry in sorted(entries):
+            label = entries[entry]
+            for sym, reach in sorted(
+                graph.reachable_from(entry, predicate=not_barrier).items()
+            ):
+                if sym in covered:
+                    continue  # the per-module rule already scans it
+                info = index.functions[sym]
+                if _is_test_file(info.relpath) or barriers.get(sym):
+                    continue
+                for node, head, tail in iter_host_syncs(
+                    info.module, info.node
+                ):
+                    key = (info.relpath, node.lineno, head)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    chain = graph.format_chain(reach.chain)
+                    yield self.finding(
+                        info.module, node.lineno,
+                        f"{head} in {info.name!r} is reachable from jit "
+                        f"entry {label!r}: {chain} — {tail}",
+                    )
+
+
+class InterprocKeyReuse(Rule):
+    """PRNG key reuse where a consumption (or the key's derivation)
+    crosses a function boundary — invisible to the per-module rule."""
+
+    id = "jax-key-reuse"
+    severity = "error"
+    description = (
+        "PRNG key consumed twice where a consumption or the key's "
+        "derivation flows through a helper call (interprocedural)"
+    )
+    example_fire = (
+        "# helpers.py\n"
+        "def draw(key, shape):\n"
+        "    return jax.random.normal(key, shape)\n"
+        "# model.py\n"
+        "from helpers import draw\n"
+        "def realize(key):\n"
+        "    a = draw(key, (4,))          # consumes key in helpers.py\n"
+        "    key = jax.random.PRNGKey(0)  # (fresh key: no finding)\n"
+        "    b = jax.random.uniform(key)\n"
+        "    c = draw(key, (4,))          # second consumption: FIRES\n"
+    )
+    example_ok = (
+        "def realize(key):\n"
+        "    k1, k2 = jax.random.split(key)\n"
+        "    a = draw(k1, (4,))\n"
+        "    b = draw(k2, (4,))\n"
+    )
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        graph = project_graph(mods)
+        consumers = dataflow.key_consumer_params(graph)
+        fresh = dataflow.fresh_key_returns(graph)
+        for sym in sorted(graph.index.functions):
+            info = graph.index.functions[sym]
+            if _is_test_file(info.relpath) or isinstance(
+                info.node, ast.Lambda
+            ):
+                continue
+            yield from self._check_fn(graph, info, consumers, fresh)
+
+    def _check_fn(self, graph, info, consumers, fresh):
+        mod = info.module
+        key_vars: Dict[str, str] = {}  # name -> "maker" | "helper"
+        events: List[tuple] = []
+        for node in iter_body_nodes(info.node):
+            if isinstance(node, ast.Assign):
+                value = node.value
+                expr = value.value if isinstance(value, ast.Subscript) \
+                    else value
+                origin = None
+                if isinstance(expr, ast.Call):
+                    if dataflow._is_key_maker_call(mod, expr):
+                        origin = "maker"
+                    else:
+                        callee = graph.resolve_call(mod, expr.func, info)
+                        if callee is not None and callee.symbol in fresh:
+                            origin = "helper"
+                for name in dataflow._assigned_names(node):
+                    events.append((
+                        dataflow._line_order(node), "assign", name,
+                        origin, (),
+                    ))
+            if not isinstance(node, ast.Call):
+                continue
+            sampler = dataflow._is_sampler(mod, node)
+            if sampler is not None and node.args and isinstance(
+                node.args[0], ast.Name
+            ):
+                events.append((
+                    dataflow._line_order(node), "consume",
+                    node.args[0].id, "direct",
+                    (f"jax.random.{sampler}",),
+                ))
+                continue
+            callee = graph.resolve_call(mod, node.func, info)
+            if callee is None:
+                continue
+            facts = consumers.get(callee.symbol) or {}
+            for pname, arg in arg_bindings(node, callee):
+                if pname in facts and isinstance(arg, ast.Name):
+                    events.append((
+                        dataflow._line_order(node), "consume", arg.id,
+                        "helper",
+                        (callee.display,) + tuple(facts[pname]),
+                    ))
+
+        consumed: Dict[str, List[tuple]] = {}
+        for order, kind, name, how, witness in sorted(
+            events, key=lambda e: e[0]
+        ):
+            if kind == "assign":
+                consumed[name] = []
+                if how is not None:
+                    key_vars[name] = how
+                elif name in key_vars and how is None:
+                    del key_vars[name]
+            elif name in key_vars:
+                consumed.setdefault(name, []).append(
+                    (order, how, witness)
+                )
+                if len(consumed[name]) == 2:
+                    first, second = consumed[name]
+                    # the per-module rule already reports the all-local
+                    # shape: maker-derived key + two direct samplers
+                    if key_vars[name] == "maker" and first[1] == \
+                            "direct" and second[1] == "direct":
+                        continue
+                    lineno = second[0][0]
+                    chain = " -> ".join(
+                        (info.display,) + second[2]
+                    )
+                    yield self.finding(
+                        mod, lineno,
+                        f"key {name!r} consumed twice in {info.name!r} "
+                        "with no intervening split/fold_in; second "
+                        f"consumption via {chain} — the two draws are "
+                        "identical/correlated (cross-module: the "
+                        "per-module rule cannot see this)",
+                    )
+
+
+# --------------------------------------------------------- race detection
+_PKG_PREFIX = "pta_replicator_tpu/"
+
+
+def _spawn_target_expr(mod: Module, node: ast.Call) -> Optional[ast.AST]:
+    """Target expression of a thread-of-control spawn: ``Thread(
+    target=f)`` or ``pool.submit(f, ...)`` with a static callable."""
+    resolved = mod.resolve(node.func) or ""
+    if resolved.rsplit(".", 1)[-1] == "Thread":
+        for kw in node.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr == "submit"
+        and node.args
+        and isinstance(node.args[0], (ast.Name, ast.Attribute))
+    ):
+        return node.args[0]
+    return None
+
+
+def _in_loop(mod: Module, node: ast.AST) -> bool:
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.For, ast.While, ast.AsyncFor,
+                            ast.comprehension, ast.ListComp,
+                            ast.GeneratorExp)):
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+    return False
+
+
+def _attr_writes(info: FunctionInfo):
+    """(key, node, verb) for every shared-state write in ``info``'s
+    body: instance attributes (``self.x = `` / ``self.x.append()`` /
+    ``self.x[k] = ``) keyed by (relpath, class, attr), and module-global
+    container mutations keyed by (relpath, '', name)."""
+    mod = info.module
+    if info.name in _CONSTRUCTION_METHODS:
+        return
+    globals_ = _module_level_mutables(mod)
+
+    def self_attr(expr) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ) and expr.value.id in ("self", "cls"):
+            return expr.attr
+        return None
+
+    for node in iter_body_nodes(info.node):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                attr = self_attr(t)
+                if attr is not None and info.cls:
+                    yield ((info.relpath, info.cls, attr), node,
+                           "assignment")
+                    continue
+                if isinstance(t, ast.Subscript):
+                    attr = self_attr(t.value)
+                    if attr is not None and info.cls:
+                        yield ((info.relpath, info.cls, attr), node,
+                               "item assignment")
+                    elif isinstance(t.value, ast.Name) and \
+                            t.value.id in globals_:
+                        yield ((info.relpath, "", t.value.id), node,
+                               "item assignment")
+        elif isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in _MUTATOR_METHODS:
+            base = node.func.value
+            attr = self_attr(base)
+            if attr is not None and info.cls:
+                yield ((info.relpath, info.cls, attr), node,
+                       f".{node.func.attr}()")
+            elif isinstance(base, ast.Name) and base.id in globals_:
+                yield ((info.relpath, "", base.id), node,
+                       f".{node.func.attr}()")
+
+
+class ThreadSharedStateRace(Rule):
+    """Static write-write race detection across every thread-of-control
+    the package spawns. See the pack docstring for the model; precision
+    notes: reads are not tracked, lock identity is by terminal name
+    (the ``LOCK_HIERARCHY`` convention), and a function reachable from
+    a spawn is attributed to that spawn's thread wholesale."""
+
+    id = "thread-shared-state-race"
+    severity = "error"
+    description = (
+        "instance/module state written from >=2 threads-of-control "
+        "(spawned Thread/executor targets, or a worker pool racing "
+        "itself) with no common lock"
+    )
+    example_fire = (
+        "class Pool:\n"
+        "    def start(self):\n"
+        "        for _ in range(4):\n"
+        "            threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        self.done += 1           # 4 threads, no lock: FIRES\n"
+    )
+    example_ok = (
+        "    def _run(self):\n"
+        "        with self._lock:\n"
+        "            self.done += 1       # common lock on every writer\n"
+    )
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        pkg_mods = [
+            m for m in mods
+            if m.relpath.startswith(_PKG_PREFIX)
+            and not _is_test_file(m.relpath)
+        ]
+        if not pkg_mods:
+            return
+        graph = project_graph(mods)
+        index = graph.index
+
+        # 1. every spawn site in package code
+        spawns = []  # (target FunctionInfo, mod, lineno, multi)
+        for mod in pkg_mods:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                expr = _spawn_target_expr(mod, node)
+                if expr is None:
+                    continue
+                enclosing = index.enclosing_info(mod, node)
+                target = graph.resolve_call(mod, expr, enclosing)
+                if target is None:
+                    continue
+                multi = _in_loop(mod, node) or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "submit"
+                )
+                spawns.append((target, mod, node.lineno, multi))
+
+        # 2. per-thread write events, lock context carried along chains
+        events: Dict[tuple, List[dict]] = {}
+        threads_per_key: Dict[tuple, Set[str]] = {}
+        reached_symbols: Set[str] = set()
+
+        def record(key, thread_id, lockset, node, verb, info, chain):
+            events.setdefault(key, []).append({
+                "thread": thread_id, "locks": frozenset(lockset),
+                "relpath": info.relpath, "lineno": node.lineno,
+                "verb": verb, "fn": info.display, "chain": chain,
+            })
+            threads_per_key.setdefault(key, set()).add(thread_id)
+
+        for target, smod, slineno, multi in spawns:
+            thread_id = f"{target.display} spawned at " \
+                        f"{smod.relpath}:{slineno}"
+            reach = graph.reachable_from(target.symbol)
+            for sym, r in sorted(reach.items()):
+                info = index.functions[sym]
+                if not info.relpath.startswith(_PKG_PREFIX):
+                    continue
+                reached_symbols.add(sym)
+                for key, node, verb in _attr_writes(info):
+                    locks = r.locks | set(
+                        _held_locks(info.module, node)
+                    )
+                    record(key, thread_id, locks, node, verb, info,
+                           graph.format_chain(r.chain))
+                    if multi:
+                        threads_per_key[key].add(thread_id + " [pool]")
+
+        # 3. the spawning/main thread-of-control: writes to the same
+        # state from functions no spawn reaches
+        for sym in sorted(index.functions):
+            if sym in reached_symbols:
+                continue
+            info = index.functions[sym]
+            if not info.relpath.startswith(_PKG_PREFIX) or \
+                    _is_test_file(info.relpath):
+                continue
+            for key, node, verb in _attr_writes(info):
+                if key not in events:
+                    continue  # nobody threaded writes it: not shared
+                record(key, "main thread", set(
+                    _held_locks(info.module, node)
+                ), node, verb, info, info.display)
+
+        # 4. verdicts
+        for key in sorted(events):
+            if len(threads_per_key[key]) < 2:
+                continue
+            evs = events[key]
+            common = frozenset.intersection(*(e["locks"] for e in evs))
+            if common:
+                continue
+            relpath, cls, attr = key
+            what = (
+                f"attribute {attr!r} of {cls} ({relpath})" if cls
+                else f"module-level {attr!r} ({relpath})"
+            )
+            anchor = min(
+                evs, key=lambda e: (len(e["locks"]), e["relpath"],
+                                    e["lineno"]),
+            )
+            writers = sorted({
+                f"{e['thread']} [{e['relpath']}:{e['lineno']}"
+                f"{', holding ' + '/'.join(sorted(e['locks'])) if e['locks'] else ', no lock'}]"
+                for e in evs
+            })
+            detail = "; ".join(writers[:3]) + (
+                f"; +{len(writers) - 3} more" if len(writers) > 3 else ""
+            )
+            yield self.finding(
+                anchor["relpath"], anchor["lineno"],
+                f"{what} is written from "
+                f"{len(threads_per_key[key])} threads-of-control with "
+                f"no common lock: {detail} — guard every writer with "
+                "one shared lock (and record it in "
+                "rules_threads.LOCK_HIERARCHY), or suppress with the "
+                "reason the write is single-threaded by construction",
+            )
+
+
+# --------------------------------------------------------- dead names
+class TelemetryDeadName(Rule):
+    """Registry entries nobody emits. Usage evidence: the constant's
+    name referenced in any linted module outside ``obs/names.py`` or in
+    ``tests/``, or its string value at a telemetry producer call."""
+
+    id = "telemetry-dead-name"
+    severity = "error"
+    description = (
+        "constant registered in obs/names.py that no call site in the "
+        "whole tree ever emits (by constant or by literal)"
+    )
+    example_fire = (
+        "# obs/names.py\n"
+        "SPAN_OLD_PHASE = 'old_phase'   # nothing references it: FIRES\n"
+    )
+    example_ok = (
+        "# obs/names.py\n"
+        "SPAN_FREEZE = 'freeze'\n"
+        "# batch.py\n"
+        "with span(names.SPAN_FREEZE): ...\n"
+    )
+
+    def check_project(self, mods: Sequence[Module]) -> Iterable[Finding]:
+        names_mod = next(
+            (m for m in mods if m.relpath == NAMES_RELPATH), None
+        )
+        if names_mod is None:
+            return
+        constants: List[Tuple[str, str, int]] = []  # (NAME, value, line)
+        for stmt in names_mod.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Constant
+            ) and isinstance(stmt.value.value, str):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id.isupper():
+                        constants.append(
+                            (t.id, stmt.value.value, stmt.lineno)
+                        )
+        if not constants:
+            return
+
+        other_sources = [
+            m.source for m in mods if m.relpath != NAMES_RELPATH
+        ]
+        # the whole tree includes tests/ and examples/, which are not
+        # default lint targets — read them off disk so a name emitted
+        # only by a test fixture is not declared dead
+        root = names_mod.path[: -len(names_mod.relpath)].rstrip(os.sep)
+        linted = {m.path for m in mods}
+        for extra_dir in ("tests", "examples"):
+            d = os.path.join(root, extra_dir)
+            if not os.path.isdir(d):
+                continue
+            for dirpath, _dirnames, filenames in os.walk(d):
+                for f in sorted(filenames):
+                    p = os.path.join(dirpath, f)
+                    if f.endswith(".py") and p not in linted:
+                        try:
+                            with open(p, encoding="utf-8",
+                                      errors="replace") as fh:
+                                other_sources.append(fh.read())
+                        except OSError:
+                            continue
+
+        produced: Set[str] = set()
+        for m in mods:
+            if m.relpath == NAMES_RELPATH:
+                continue
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = m.resolve(node.func) or ""
+                if resolved.rsplit(".", 1)[-1] not in _PRODUCER_KINDS:
+                    continue
+                for expr in list(node.args[:1]) + [
+                    kw.value for kw in node.keywords if kw.arg == "name"
+                ]:
+                    if isinstance(expr, ast.Constant) and isinstance(
+                        expr.value, str
+                    ):
+                        produced.add(expr.value)
+
+        blob = "\n".join(other_sources)
+        all_values = {v for _n, v, _l in constants}
+        for name, value, lineno in constants:
+            if value in produced:
+                continue
+            if re.search(rf"\b{re.escape(name)}\b", blob):
+                continue
+            # prefix constants name a dotted *family*, matched by value
+            # (startswith) rather than emitted verbatim — live as long
+            # as any registered or produced name belongs to the family
+            if name.endswith("_PREFIX") and any(
+                v != value and v.startswith(value)
+                for v in all_values | produced
+            ):
+                continue
+            yield self.finding(
+                names_mod, lineno,
+                f"{name} = {value!r} is registered but no call site in "
+                "the tree ever emits it (no constant reference outside "
+                "names.py, no literal at a producer) — remove it or "
+                "wire the instrumentation it promises",
+            )
+
+
+RULES = [
+    InterprocHostSync(),
+    InterprocKeyReuse(),
+    ThreadSharedStateRace(),
+    TelemetryDeadName(),
+]

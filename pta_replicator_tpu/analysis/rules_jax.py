@@ -141,52 +141,75 @@ def _module_level_mutables(mod: Module) -> Dict[str, int]:
     return out
 
 
+_SYNC_ATTRS = {"block_until_ready", "item"}
+
+
+def iter_host_syncs(mod: Module, fn: ast.AST):
+    """Host-sync call sites inside ``fn``: yields ``(node, head, tail)``
+    where messages compose as ``f"{head} inside jit-traced {name!r}:
+    {tail}"``. Shared by the per-module rule and the interprocedural
+    pass (:mod:`.rules_interproc`)."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SYNC_ATTRS:
+                yield (
+                    node, f".{func.attr}()",
+                    "forces a host sync per call (fence outside the "
+                    "jit boundary instead)",
+                )
+                continue
+            resolved = mod.resolve(func) or ""
+            if resolved.startswith("numpy.") and func.attr in (
+                "asarray", "array",
+            ):
+                yield (
+                    node, f"np.{func.attr}()",
+                    "pulls the tracer to host (use jnp, or hoist the "
+                    "conversion out of the jit)",
+                )
+        elif isinstance(func, ast.Name) and func.id == "float":
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                yield (
+                    node, "float(...)",
+                    "concretizes a tracer (host sync); keep it an "
+                    "array or move the cast outside the jit",
+                )
+
+
 class HostSyncInJit(Rule):
+    """A host-device synchronization primitive lexically inside a
+    function this module jit-traces. The interprocedural variant (same
+    rule id, :mod:`.rules_interproc`) extends this through the project
+    call graph into helpers the traced entry reaches."""
+
     id = "jax-host-sync"
     severity = "error"
     description = (
         "host-device sync (.block_until_ready()/np.asarray/.item()/"
         "float()) inside a jit-traced function"
     )
-
-    _SYNC_ATTRS = {"block_until_ready", "item"}
+    example_fire = (
+        "@jax.jit\n"
+        "def engine(x):\n"
+        "    return float(x.sum())   # concretizes a tracer\n"
+    )
+    example_ok = (
+        "@jax.jit\n"
+        "def engine(x):\n"
+        "    return x.sum()\n"
+        "total = float(engine(x))     # sync on the host side\n"
+    )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
         for fn in jit_function_nodes(mod):
-            for node in ast.walk(fn):
-                if not isinstance(node, ast.Call):
-                    continue
-                func = node.func
-                if isinstance(func, ast.Attribute):
-                    if func.attr in self._SYNC_ATTRS:
-                        yield self.finding(
-                            mod, node.lineno,
-                            f".{func.attr}() inside jit-traced "
-                            f"{fn.name!r}: forces a host sync per call "
-                            "(fence outside the jit boundary instead)",
-                        )
-                        continue
-                    resolved = mod.resolve(func) or ""
-                    if resolved.startswith("numpy.") and func.attr in (
-                        "asarray", "array",
-                    ):
-                        yield self.finding(
-                            mod, node.lineno,
-                            f"np.{func.attr}() inside jit-traced "
-                            f"{fn.name!r}: pulls the tracer to host "
-                            "(use jnp, or hoist the conversion out of "
-                            "the jit)",
-                        )
-                elif isinstance(func, ast.Name) and func.id == "float":
-                    if node.args and not isinstance(
-                        node.args[0], ast.Constant
-                    ):
-                        yield self.finding(
-                            mod, node.lineno,
-                            f"float(...) inside jit-traced {fn.name!r}: "
-                            "concretizes a tracer (host sync); keep it "
-                            "an array or move the cast outside the jit",
-                        )
+            for node, head, tail in iter_host_syncs(mod, fn):
+                yield self.finding(
+                    mod, node.lineno,
+                    f"{head} inside jit-traced {fn.name!r}: {tail}",
+                )
 
 
 class F64LiteralInJit(Rule):
@@ -195,6 +218,17 @@ class F64LiteralInJit(Rule):
     description = (
         "float64 dtype literal in jit-traced device code (f32 "
         "discipline; io/ and timing/ host-precision modules exempt)"
+    )
+    example_fire = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x.astype(jnp.float64)   # f64 in device code: FIRES\n"
+    )
+    example_ok = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x.astype(jnp.float32)\n"
+        "planes = np.asarray(raw, np.float64)  # host precompute: fine\n"
     )
 
     def _exempt(self, mod: Module) -> bool:
@@ -245,6 +279,17 @@ class KeyReuse(Rule):
     description = (
         "PRNG key consumed by two jax.random calls without an "
         "intervening split/fold_in"
+    )
+    example_fire = (
+        "key = jax.random.PRNGKey(0)\n"
+        "a = jax.random.normal(key, (4,))\n"
+        "b = jax.random.uniform(key, (4,))   # same key twice: FIRES\n"
+    )
+    example_ok = (
+        "key = jax.random.PRNGKey(0)\n"
+        "k1, k2 = jax.random.split(key)\n"
+        "a = jax.random.normal(k1, (4,))\n"
+        "b = jax.random.uniform(k2, (4,))\n"
     )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
@@ -320,6 +365,17 @@ class GlobalClosureInJit(Rule):
     description = (
         "jit-traced function reads a module-level mutable object "
         "(captured by value at trace time; later mutation is ignored)"
+    )
+    example_fire = (
+        "CONFIG = {'scale': 2.0}\n"
+        "@jax.jit\n"
+        "def apply(x):\n"
+        "    return x * CONFIG['scale']   # trace-time snapshot: FIRES\n"
+    )
+    example_ok = (
+        "@jax.jit\n"
+        "def apply(x, scale):\n"
+        "    return x * scale             # pass state as an argument\n"
     )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:

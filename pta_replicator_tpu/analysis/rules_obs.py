@@ -205,6 +205,16 @@ class UnboundedObsBuffer(Rule):
         "sampler path (deque without maxlen, or list growth with no "
         "bounding mechanism) — a slow leak over a multi-hour capture"
     )
+    example_fire = (
+        "class Sampler:\n"
+        "    def __init__(self):\n"
+        "        self.samples = deque()   # no maxlen, appended from a\n"
+        "    def tick(self):              # sampler thread: FIRES\n"
+        "        self.samples.append(read())\n"
+    )
+    example_ok = (
+        "        self.samples = deque(maxlen=4096)\n"
+    )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
         if not mod.relpath.startswith(OBS_PREFIX):
@@ -319,6 +329,18 @@ class OrphanThreadSpan(Rule):
         "carry()/adopt()/inherit handoff — its spans land at the span-"
         "tree root and outside any causal trace (docs/tracing.md)"
     )
+    example_fire = (
+        "def worker():\n"
+        "    with span('stage'):          # orphan span in a thread\n"
+        "        ...\n"
+        "threading.Thread(target=worker).start()   # FIRES\n"
+    )
+    example_ok = (
+        "token = trace.carry()\n"
+        "def worker():\n"
+        "    with trace.adopt(token), span('stage'):\n"
+        "        ...\n"
+    )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
         if not mod.relpath.startswith(_PKG_PREFIX):
@@ -383,6 +405,15 @@ def _function_has_probe(fn: ast.AST) -> bool:
 class UnprobedReduction(Rule):
     id = "obs-unprobed-reduction"
     severity = "error"
+    example_fire = (
+        "def gls(c):\n"
+        "    return jnp.linalg.cholesky(c)    # unprobed: FIRES\n"
+    )
+    example_ok = (
+        "def gls(c):\n"
+        "    c = numerics.probe_cholesky(c, 'gls.cov')\n"
+        "    return jnp.linalg.cholesky(c)\n"
+    )
     description = (
         "device cholesky/slogdet in a package hot path with no numerics "
         "probe in the enclosing function — an indefinite input NaNs the "

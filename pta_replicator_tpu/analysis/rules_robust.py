@@ -115,6 +115,17 @@ def _handled(handler: ast.ExceptHandler) -> bool:
 class SwallowedException(Rule):
     id = "robust-swallowed-exception"
     severity = "error"
+    example_fire = (
+        "try:\n"
+        "    stage.drain()\n"
+        "except Exception:\n"
+        "    pass                         # invisible fault: FIRES\n"
+    )
+    example_ok = (
+        "except Exception as exc:\n"
+        "    obs.counter('stages.drain_errors')\n"
+        "    log.warning('drain failed: %s', exc)\n"
+    )
     description = (
         "broad except handler in a threaded/pipeline module that "
         "neither re-raises, records the exception, logs, nor bumps a "

@@ -50,6 +50,16 @@ def _names_in(node: ast.AST):
 class ScenarioSplitChain(Rule):
     id = "scenario-split-chain"
     severity = "error"
+    example_fire = (
+        "for i in range(n):\n"
+        "    key, sub = jax.random.split(key)   # chain: FIRES\n"
+        "    draws.append(jax.random.normal(sub, shape))\n"
+    )
+    example_ok = (
+        "for i in range(n):\n"
+        "    sub = jax.random.fold_in(key, i)   # indexed, order-free\n"
+        "    draws.append(jax.random.normal(sub, shape))\n"
+    )
     description = (
         "sequential PRNG key chain in scenarios/ (split rebinding its "
         "own operand, or a key derivation/draw inside a loop): scenario "

@@ -158,6 +158,15 @@ class UnknownTelemetryName(Rule):
         "telemetry name at a producer call site is not registered in "
         "obs/names.py"
     )
+    example_fire = (
+        "with span('realize_blk'):        # typo, not in names.py: FIRES\n"
+        "    ...\n"
+    )
+    example_ok = (
+        "from ..obs import names\n"
+        "with span(names.SPAN_REALIZE_BLOCK):\n"
+        "    ...\n"
+    )
 
     def __init__(self, registry: Optional[dict] = None):
         self._registry = registry
@@ -398,6 +407,15 @@ class TelemetryCoverage(Rule):
     description = (
         "required pipeline instrumentation missing (span/metric removed "
         "or renamed without updating the coverage table)"
+    )
+    example_fire = (
+        "# models/batched.py: the realize span the coverage table\n"
+        "# requires was deleted in a refactor -> FIRES on the file\n"
+    )
+    example_ok = (
+        "# every (file, producer, name) row of REQUIRED_INSTRUMENTATION\n"
+        "# resolves to a real call site (or the table row is removed\n"
+        "# alongside the instrumentation, in the same PR)\n"
     )
 
     def __init__(

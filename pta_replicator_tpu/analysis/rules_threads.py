@@ -111,6 +111,18 @@ class UnlockedGlobalMutation(Rule):
         "module-level mutable state mutated outside a lock in a "
         "module that uses threads"
     )
+    example_fire = (
+        "_SAMPLES = []\n"
+        "def worker():                    # module also spawns threads\n"
+        "    _SAMPLES.append(read())      # unlocked mutation: FIRES\n"
+    )
+    example_ok = (
+        "_SAMPLES = []\n"
+        "_lock = threading.Lock()\n"
+        "def worker():\n"
+        "    with _lock:\n"
+        "        _SAMPLES.append(read())\n"
+    )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
         if not _uses_threads(mod):
@@ -172,6 +184,17 @@ class WallTimeDuration(Rule):
         "time.time() used in duration/deadline arithmetic — wall clock "
         "steps; use time.monotonic()"
     )
+    example_fire = (
+        "t0 = time.time()\n"
+        "work()\n"
+        "elapsed = time.time() - t0       # wall clock steps: FIRES\n"
+    )
+    example_ok = (
+        "t0 = time.monotonic()\n"
+        "work()\n"
+        "elapsed = time.monotonic() - t0\n"
+        "stamp = time.time()              # timestamps (not durations) ok\n"
+    )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
         for node in ast.walk(mod.tree):
@@ -206,6 +229,16 @@ class AdhocStagePipeline(Rule):
     description = (
         "raw threading.Thread + queue.Queue pipeline outside parallel/ "
         "— the shape parallel/stages.py (StageGraph) exists to replace"
+    )
+    example_fire = (
+        "# models/foo.py\n"
+        "q = queue.Queue(maxsize=2)\n"
+        "threading.Thread(target=producer, args=(q,)).start()  # FIRES\n"
+    )
+    example_ok = (
+        "# models/foo.py\n"
+        "from ..parallel.stages import StageGraph\n"
+        "graph = StageGraph([('produce', producer), ('write', writer)])\n"
     )
 
     def check_module(self, mod: Module) -> Iterable[Finding]:
@@ -245,6 +278,16 @@ class LockOrderInversion(Rule):
     description = (
         "nested lock acquisition inverts the recorded tracer/flightrec "
         "lock hierarchy (deadlock risk)"
+    )
+    example_fire = (
+        "with self._lock:                 # innermost lock first...\n"
+        "    with self._trace_lock:       # ...then an outer one: FIRES\n"
+        "        flush()\n"
+    )
+    example_ok = (
+        "with self._trace_lock:           # LOCK_HIERARCHY order\n"
+        "    with self._lock:\n"
+        "        flush()\n"
     )
 
     def __init__(self, hierarchy: Tuple[str, ...] = LOCK_HIERARCHY):

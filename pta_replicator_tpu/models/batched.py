@@ -724,7 +724,7 @@ def cw_stream_response(
         buf_s, buf_p = [], []
         tail_seen = False
         for src, psr in tiles:
-            src, psr = np.asarray(src), np.asarray(psr)
+            src, psr = np.asarray(src), np.asarray(psr)  # graftlint: disable=jax-host-sync — prefetch worker thread stacking host tiles (cw_stream_response is host-driven; traced params raise in cw_catalog_plane_tiles_for)
             if width[0] is None:
                 width[0] = src.shape[-1]
             if tail_seen or src.shape[-1] > width[0]:

@@ -42,7 +42,7 @@ def gwb_grid(start_s: float, stop_s: float, npts: int, howml: float):
     # would silently shift every subsequent RNG draw. Fix the count
     # analytically instead (endpoint excluded when the ratio is integral).
     ratio = npts * howml / 2.0
-    nf = int(np.floor(ratio)) if float(ratio).is_integer() else int(np.ceil(ratio))
+    nf = int(np.floor(ratio)) if float(ratio).is_integer() else int(np.ceil(ratio))  # graftlint: disable=jax-host-sync — ratio is Python scalar config (npts*howml/2), never a tracer; the grid is static shape metadata
     f = np.arange(nf) / (dur * howml)
     f[0] = f[1]
     return ut, dt_grid, f
@@ -78,7 +78,7 @@ def characteristic_strain(
         # fires on the host/oracle path and whenever concrete values
         # reach this function.
         try:
-            n_floored = int(np.count_nonzero(np.asarray(raw) < 1e-30))
+            n_floored = int(np.count_nonzero(np.asarray(raw) < 1e-30))  # graftlint: disable=jax-host-sync — deliberate host-path inspection; the except arm below handles the traced case
         except Exception:  # traced under jit — values not inspectable
             n_floored = 0
         if n_floored:

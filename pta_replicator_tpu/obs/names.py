@@ -99,11 +99,13 @@ SPAN_COV_SOLVE = "cov_solve"
 #: kernels.py sample_eager — the fuzz harness's batched-side entry)
 SPAN_COV_SAMPLE = "cov_sample"
 
-# CLI runner (the top-level span is the subcommand name)
-SPAN_CLI_REALIZE = "realize"
-SPAN_CLI_INFO = "info"
-SPAN_CLI_LIKELIHOOD = "likelihood"
-SPAN_CLI_SCENARIO = "scenario"
+# CLI runner (the top-level span is the subcommand name). Emitted
+# dynamically — __main__ runs `with obs.span(args.cmd)` — so these
+# constants register the names without ever being referenced.
+SPAN_CLI_REALIZE = "realize"  # graftlint: disable=telemetry-dead-name — emitted as obs.span(args.cmd)
+SPAN_CLI_INFO = "info"  # graftlint: disable=telemetry-dead-name — emitted as obs.span(args.cmd)
+SPAN_CLI_LIKELIHOOD = "likelihood"  # graftlint: disable=telemetry-dead-name — emitted as obs.span(args.cmd)
+SPAN_CLI_SCENARIO = "scenario"  # graftlint: disable=telemetry-dead-name — emitted as obs.span(args.cmd)
 SPAN_INGEST = "ingest"
 SPAN_BUILD_RECIPE = "build_recipe"
 SPAN_COMPUTE = "compute"

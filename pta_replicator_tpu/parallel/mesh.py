@@ -112,7 +112,7 @@ def put_sharded(x, mesh: Mesh, spec):
         # with np.asarray + re-uploading the whole plane (no host bytes
         # move, so no transfer is recorded)
         return jax.device_put(x, sharding)
-    arr = np.asarray(x)
+    arr = np.asarray(x)  # graftlint: disable=jax-host-sync — host->device staging helper: the input is a host tile by contract (the streamed CW path is host-driven; tracers raise upstream in cw_catalog_plane_tiles_for)
     idx_map = sharding.addressable_devices_indices_map(arr.shape)
     pieces = [jax.device_put(arr[idx], d) for d, idx in idx_map.items()]
     record_transfer(sum(int(p.nbytes) for p in pieces), "h2d")

@@ -222,7 +222,7 @@ def prefetch_to_mesh(
     def produce(i, tile, sp):
         """Host tile build + flatten (the source worker's f64 math)."""
         leaves, treedef = jax.tree_util.tree_flatten(tile)
-        leaves = [np.asarray(x) for x in leaves]
+        leaves = [np.asarray(x) for x in leaves]  # graftlint: disable=jax-host-sync — prefetch worker thread: tiles are host f64 data by contract (host-driven streaming path; tracers raise upstream)
         if len(leaves) != len(shardings):
             raise ValueError(
                 f"tile has {len(leaves)} leaves but specs "

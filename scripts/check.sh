@@ -15,7 +15,30 @@ cd "$(dirname "$0")/.."
 
 echo "== graftlint =="
 if [ "${CHECK_FULL:-0}" = "1" ]; then
-    python -m pta_replicator_tpu lint
+    # Full whole-program lint, timed cold vs warm: the incremental
+    # cache must serve an unchanged tree entirely warm (--expect-warm
+    # exits 1 on any miss), byte-identically, and >= 5x faster.
+    rm -f .graftlint-cache.json
+    t0=$(date +%s%N)
+    python -m pta_replicator_tpu lint --format json > /tmp/graftlint-cold.json
+    t1=$(date +%s%N)
+    python -m pta_replicator_tpu lint --format json --expect-warm \
+        > /tmp/graftlint-warm.json
+    t2=$(date +%s%N)
+    cmp /tmp/graftlint-cold.json /tmp/graftlint-warm.json || {
+        echo "graftlint: warm-cache findings differ from cold run" >&2
+        exit 1
+    }
+    cold_ms=$(( (t1 - t0) / 1000000 ))
+    warm_ms=$(( (t2 - t1) / 1000000 ))
+    echo "graftlint: cold ${cold_ms}ms, warm ${warm_ms}ms"
+    if [ $(( warm_ms * 5 )) -gt "$cold_ms" ]; then
+        echo "graftlint: warm cache not >=5x faster than cold" \
+             "(${cold_ms}ms cold vs ${warm_ms}ms warm)" >&2
+        exit 1
+    fi
+    # SARIF for the CI upload step (served from the warm cache)
+    python -m pta_replicator_tpu lint --format sarif > lint.sarif
 else
     python -m pta_replicator_tpu lint --changed-only
 fi

@@ -5,6 +5,7 @@ snippet, plus the whole-package gate — the real tree must lint clean
 against the checked-in baseline (that assertion IS the PR gate the
 subsystem exists for). Everything here is jax-free and fast.
 """
+import io
 import json
 import os
 import shutil
@@ -12,7 +13,7 @@ import textwrap
 
 import pytest
 
-from pta_replicator_tpu.analysis import engine
+from pta_replicator_tpu.analysis import callgraph, engine, rules_interproc
 from pta_replicator_tpu.analysis import rules_jax, rules_telemetry, \
     rules_threads
 from pta_replicator_tpu.analysis.cli import run_lint
@@ -1481,3 +1482,691 @@ def test_unprobed_reduction_clean_on_real_tree():
         mods, [rules_obs.UnprobedReduction()])
     assert problems == []
     assert active == [], [f.format() for f in active]
+
+
+# --------------------------------------- interprocedural passes (whole-program)
+def parse_tree(tmp_path, files):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    found = engine.iter_python_files([str(tmp_path)], str(tmp_path))
+    mods, problems = engine.parse_modules(found, str(tmp_path))
+    assert problems == [], [p.format() for p in problems]
+    return mods
+
+
+CROSS_MODULE_SYNC = {
+    "helpers.py": """
+        import numpy as np
+
+        def summarize(x):
+            return np.asarray(x)
+    """,
+    "engine.py": """
+        import jax
+        from helpers import summarize
+
+        @jax.jit
+        def engine(x):
+            return summarize(x)
+    """,
+}
+
+
+def test_interproc_host_sync_crosses_modules_with_verbatim_chain(tmp_path):
+    """The planted sync lives in a helper the per-module rule never
+    scans; the interprocedural pass reports it WITH the call chain."""
+    mods = parse_tree(tmp_path, CROSS_MODULE_SYNC)
+    per_module, _ = engine.run_rules(mods, [rules_jax.HostSyncInJit()])
+    assert per_module == []  # provably invisible to the module layer
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.InterprocHostSync()]
+    )
+    assert rule_ids(findings) == ["jax-host-sync"]
+    f = findings[0]
+    assert f.path == "helpers.py"
+    # the chain is the rule's contract, not decoration: verbatim
+    assert "engine (engine.py) -> summarize (helpers.py)" in f.message
+    assert "np.asarray()" in f.message and "'engine'" in f.message
+
+
+def test_interproc_host_sync_stops_at_tracer_barriers(tmp_path):
+    """A helper that explicitly discriminates tracers (raise-on-tracer
+    guard) is host-only by construction — no finding through it."""
+    files = dict(CROSS_MODULE_SYNC)
+    files["helpers.py"] = """
+        import jax
+        import numpy as np
+
+        def summarize(x):
+            if isinstance(x, jax.core.Tracer):
+                raise TypeError("host-only helper")
+            return np.asarray(x)
+    """
+    mods = parse_tree(tmp_path, files)
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.InterprocHostSync()]
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_interproc_host_sync_wrapper_entry_across_modules(tmp_path):
+    """``instrumented_jit(imported_helper)`` marks the helper (defined
+    in another module) as a jit entry; syncs it reaches are reported."""
+    mods = parse_tree(tmp_path, {
+        "deep.py": """
+            def leaf(x):
+                return float(x.sum())
+        """,
+        "body.py": """
+            from deep import leaf
+
+            def step(x):
+                return leaf(x) + 1
+        """,
+        "wire.py": """
+            from pta_replicator_tpu.obs import instrumented_jit
+            from body import step
+
+            run = instrumented_jit(step, name="jax.jit.step")
+        """,
+    })
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.InterprocHostSync()]
+    )
+    assert rule_ids(findings) == ["jax-host-sync"]
+    assert findings[0].path == "deep.py"
+    assert "step (body.py) -> leaf (deep.py)" in findings[0].message
+
+
+CROSS_MODULE_KEY = {
+    "draws.py": """
+        import jax
+
+        def draw(key, shape):
+            return jax.random.normal(key, shape)
+    """,
+    "model.py": """
+        import jax
+        from draws import draw
+
+        def realize(seed):
+            key = jax.random.PRNGKey(seed)
+            a = draw(key, (4,))
+            b = draw(key, (4,))
+            return a + b
+    """,
+}
+
+
+def test_interproc_key_reuse_through_helper_call(tmp_path):
+    """Both consumptions flow through a helper in another module — the
+    per-module rule sees no sampler at all; the dataflow pass does, and
+    prints the witness chain down to the sampler."""
+    mods = parse_tree(tmp_path, CROSS_MODULE_KEY)
+    per_module, _ = engine.run_rules(mods, [rules_jax.KeyReuse()])
+    assert per_module == []
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.InterprocKeyReuse()]
+    )
+    assert rule_ids(findings) == ["jax-key-reuse"]
+    f = findings[0]
+    assert f.path == "model.py"
+    assert "key 'key' consumed twice in 'realize'" in f.message
+    assert (
+        "realize (model.py) -> draw (draws.py) -> jax.random.normal"
+        in f.message
+    )
+
+
+def test_interproc_key_reuse_quiet_on_split_keys(tmp_path):
+    files = dict(CROSS_MODULE_KEY)
+    files["model.py"] = """
+        import jax
+        from draws import draw
+
+        def realize(seed):
+            key = jax.random.PRNGKey(seed)
+            k1, k2 = jax.random.split(key)
+            a = draw(k1, (4,))
+            b = draw(k2, (4,))
+            return a + b
+    """
+    mods = parse_tree(tmp_path, files)
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.InterprocKeyReuse()]
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_interproc_key_reuse_leaves_all_local_shape_to_module_rule(tmp_path):
+    """Maker + two DIRECT samplers is the per-module rule's territory —
+    exactly one finding between the two layers, from the module layer."""
+    mods = parse_tree(tmp_path, {"local.py": """
+        import jax
+
+        def realize(seed):
+            key = jax.random.PRNGKey(seed)
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+    """})
+    per_module, _ = engine.run_rules(mods, [rules_jax.KeyReuse()])
+    assert rule_ids(per_module) == ["jax-key-reuse"]
+    interproc, _ = engine.run_rules(
+        mods, [rules_interproc.InterprocKeyReuse()]
+    )
+    assert interproc == [], [f.format() for f in interproc]
+
+
+RACE_POOL = {
+    "pta_replicator_tpu/pool.py": """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self.done = 0
+                self._lock = threading.Lock()
+
+            def start(self):
+                for _ in range(4):
+                    threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.done += 1
+    """,
+}
+
+
+def test_thread_shared_state_race_fires_on_unlocked_pool_writes(tmp_path):
+    mods = parse_tree(tmp_path, RACE_POOL)
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.ThreadSharedStateRace()]
+    )
+    assert rule_ids(findings) == ["thread-shared-state-race"]
+    f = findings[0]
+    assert f.path == "pta_replicator_tpu/pool.py"
+    assert "attribute 'done' of Pool" in f.message
+    assert "no common lock" in f.message
+
+
+def test_thread_shared_state_race_quiet_under_common_lock(tmp_path):
+    files = {"pta_replicator_tpu/pool.py": """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self.done = 0
+                self._lock = threading.Lock()
+
+            def start(self):
+                for _ in range(4):
+                    threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self.done += 1
+
+            def finish(self):
+                with self._lock:
+                    self.done += 1
+    """}
+    mods = parse_tree(tmp_path, files)
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.ThreadSharedStateRace()]
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_thread_shared_state_race_sees_transitive_writes(tmp_path):
+    """The write happens two calls below the spawn target, in another
+    module — only the call graph can attribute it to the thread."""
+    mods = parse_tree(tmp_path, {
+        "pta_replicator_tpu/store.py": """
+            class Store:
+                def record(self, item):
+                    self.items.append(item)
+        """,
+        "pta_replicator_tpu/worker.py": """
+            import threading
+
+            from pta_replicator_tpu.store import Store
+
+            class Runner:
+                def __init__(self):
+                    self.store = Store()
+
+                def start(self):
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self._step()
+
+                def _step(self):
+                    self.state = "running"
+
+            def drive(runner):
+                runner.state = "stopped"
+        """,
+    })
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.ThreadSharedStateRace()]
+    )
+    # Runner.state: written by the spawned thread (via _run -> _step)
+    # AND by the main-thread drive()... but drive writes through a
+    # parameter, not self — only the self/cls writes count, so the one
+    # reported race needs a second thread-of-control. A single spawn,
+    # not in a loop, with no other writer stays quiet.
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_thread_shared_state_race_spawned_vs_main_writer(tmp_path):
+    mods = parse_tree(tmp_path, {"pta_replicator_tpu/runner.py": """
+        import threading
+
+        class Runner:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                self.state = "running"
+
+            def stop(self):
+                self.state = "stopped"
+    """})
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.ThreadSharedStateRace()]
+    )
+    assert rule_ids(findings) == ["thread-shared-state-race"]
+    assert "attribute 'state' of Runner" in findings[0].message
+
+
+DEAD_NAME_TREE = {
+    "pta_replicator_tpu/obs/names.py": """
+        SPAN_LIVE = "live"
+        SPAN_DEAD = "zz_dead_span"
+        LIKE_PREFIX = "like."
+        LIKE_STEP = "like.step"
+    """,
+    "pta_replicator_tpu/work.py": """
+        from pta_replicator_tpu.obs import names, span
+
+        def go():
+            with span(names.SPAN_LIVE):
+                pass
+            with span("like.step"):
+                pass
+    """,
+}
+
+
+def test_telemetry_dead_name_flags_only_truly_dead(tmp_path):
+    """SPAN_LIVE is referenced by constant, LIKE_STEP emitted by literal,
+    LIKE_PREFIX is a live dotted family — only SPAN_DEAD fires."""
+    mods = parse_tree(tmp_path, DEAD_NAME_TREE)
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.TelemetryDeadName()]
+    )
+    assert rule_ids(findings) == ["telemetry-dead-name"]
+    f = findings[0]
+    assert f.path == "pta_replicator_tpu/obs/names.py"
+    assert "SPAN_DEAD" in f.message and "zz_dead_span" in f.message
+
+
+def test_telemetry_dead_name_counts_test_files_as_usage(tmp_path):
+    """A name emitted only by a test fixture is not dead — tests/ is
+    read off disk even though it is not a lint target."""
+    files = dict(DEAD_NAME_TREE)
+    mods = parse_tree(tmp_path, files)
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    (tests_dir / "test_span.py").write_text(
+        "from pta_replicator_tpu.obs import names\n"
+        "def test_it():\n"
+        "    assert names.SPAN_DEAD\n"
+    )
+    findings, _ = engine.run_rules(
+        mods, [rules_interproc.TelemetryDeadName()]
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+# ------------------------------------------------- call-graph edge cases
+def build_graph(tmp_path, files):
+    return callgraph.project_graph(parse_tree(tmp_path, files))
+
+
+def test_callgraph_resolves_aliased_imports(tmp_path):
+    graph = build_graph(tmp_path, {
+        "util.py": """
+            def fetch(x):
+                return x
+        """,
+        "caller.py": """
+            from util import fetch as grab
+
+            def run(x):
+                return grab(x)
+        """,
+    })
+    callees = [s.callee for s in graph.edges["caller.py::run"]]
+    assert callees == ["util.py::fetch"]
+
+
+def test_callgraph_resolves_self_methods_and_chains(tmp_path):
+    graph = build_graph(tmp_path, {"svc.py": """
+        class Svc:
+            def top(self):
+                return self.mid()
+
+            def mid(self):
+                return self.leaf()
+
+            def leaf(self):
+                return 1
+    """})
+    reach = graph.reachable_from("svc.py::Svc.top")
+    assert "svc.py::Svc.leaf" in reach
+    assert graph.format_chain(reach["svc.py::Svc.leaf"].chain) == (
+        "top (svc.py) -> mid (svc.py) -> leaf (svc.py)"
+    )
+
+
+def test_callgraph_indexes_decorated_and_lambda_targets(tmp_path):
+    graph = build_graph(tmp_path, {"deco.py": """
+        import functools
+
+        def leaf():
+            return 1
+
+        @functools.lru_cache(maxsize=None)
+        def cached():
+            return leaf()
+
+        handler = lambda x: cached()
+    """})
+    assert "deco.py::handler" in graph.index.functions
+    assert [s.callee for s in graph.edges["deco.py::handler"]] == \
+        ["deco.py::cached"]
+    reach = graph.reachable_from("deco.py::handler")
+    assert "deco.py::leaf" in reach
+
+
+def test_callgraph_terminates_on_import_cycles(tmp_path):
+    graph = build_graph(tmp_path, {
+        "a.py": """
+            from b import bee
+
+            def aye():
+                return bee()
+        """,
+        "b.py": """
+            from a import aye as back
+
+            def bee():
+                return back()
+        """,
+    })
+    reach = graph.reachable_from("a.py::aye")
+    assert set(reach) == {"a.py::aye", "b.py::bee"}
+    assert graph.format_chain(reach["b.py::bee"].chain) == \
+        "aye (a.py) -> bee (b.py)"
+
+
+# -------------------------------------------------- incremental cache
+def write_tree(tmp_path, files):
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+
+
+CACHE_TREE = {
+    "base.py": """
+        VALUE = 3
+
+        def helper(x):
+            return x + VALUE
+    """,
+    "user.py": """
+        from base import helper
+
+        def run(x):
+            return helper(x)
+    """,
+    "solo.py": """
+        def alone():
+            return 42
+    """,
+}
+
+
+def test_cache_cold_then_warm_same_findings(tmp_path):
+    write_tree(tmp_path, CACHE_TREE)
+    cpath = str(tmp_path / ".graftlint-cache.json")
+    r1 = engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    assert r1["cache"] == "cold"
+    r2 = engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    assert r2["cache"] == "warm"
+    key = lambda r: [(f.fingerprint, f.line) for f in r["new"]]
+    assert key(r1) == key(r2)
+
+
+def test_cache_invalidates_on_file_and_import_change(tmp_path):
+    write_tree(tmp_path, CACHE_TREE)
+    cpath = str(tmp_path / ".graftlint-cache.json")
+    engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    # editing base.py must re-lint base.py AND its dependent user.py,
+    # while solo.py is served from the per-file tier -> "partial"
+    (tmp_path / "base.py").write_text(
+        "VALUE = 4\n\n\ndef helper(x):\n    return x + VALUE\n"
+    )
+    r = engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    assert r["cache"] == "partial"
+    doc = json.load(open(cpath))
+    assert set(doc["files"]) == {"base.py", "user.py", "solo.py"}
+
+
+def test_cache_invalidates_on_env_change(tmp_path, monkeypatch):
+    """Editing any rule-pack source (the env signature) must flush
+    everything — simulated by monkeypatching the signature."""
+    from pta_replicator_tpu.analysis import cache as cache_mod
+
+    write_tree(tmp_path, CACHE_TREE)
+    cpath = str(tmp_path / ".graftlint-cache.json")
+    engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    monkeypatch.setattr(
+        cache_mod, "env_signature", lambda: "zz-new-rule-code"
+    )
+    r = engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    assert r["cache"] == "cold"
+
+
+def test_cache_bypassed_for_custom_rule_sets(tmp_path):
+    """Cache keys don't encode out-of-tree rule code: explicit rules
+    never touch the cache."""
+    write_tree(tmp_path, CACHE_TREE)
+    cpath = str(tmp_path / ".graftlint-cache.json")
+    r = engine.lint(
+        [str(tmp_path)], str(tmp_path),
+        rules=[rules_jax.HostSyncInJit()], cache_path=cpath,
+    )
+    assert r["cache"] == "off"
+    assert not os.path.exists(cpath)
+
+
+def test_cli_cold_warm_byte_identical_and_expect_warm(tmp_path):
+    """The CHECK_FULL gate in miniature: cold and warm JSON output are
+    byte-identical; --expect-warm fails after the tree changes."""
+    write_tree(tmp_path, CACHE_TREE)
+    cold, warm = io.StringIO(), io.StringIO()
+    rc1 = run_lint([str(tmp_path)], fmt="json", root=str(tmp_path),
+                   baseline=str(tmp_path / "nb.json"), out=cold)
+    rc2 = run_lint([str(tmp_path)], fmt="json", root=str(tmp_path),
+                   baseline=str(tmp_path / "nb.json"),
+                   expect_warm=True, out=warm)
+    assert (rc1, rc2) == (0, 0)
+    assert cold.getvalue() == warm.getvalue()
+    (tmp_path / "solo.py").write_text("def alone():\n    return 7\n")
+    rc3 = run_lint([str(tmp_path)], fmt="json", root=str(tmp_path),
+                   baseline=str(tmp_path / "nb.json"),
+                   expect_warm=True, out=io.StringIO())
+    assert rc3 == 1
+
+
+def test_cache_corruption_degrades_to_cold(tmp_path):
+    write_tree(tmp_path, CACHE_TREE)
+    cpath = str(tmp_path / ".graftlint-cache.json")
+    engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    with open(cpath, "w") as fh:
+        fh.write("{not json")
+    r = engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    assert r["cache"] == "cold"
+    r2 = engine.lint([str(tmp_path)], str(tmp_path), cache_path=cpath)
+    assert r2["cache"] == "warm"
+
+
+# ------------------------------------------------ changed-only semantics
+def test_changed_only_is_report_filter_not_analysis_filter(tmp_path):
+    """The analysis always runs whole-program: a jit entry in an
+    UNCHANGED file still drives the host-sync finding in the changed
+    helper, while a violation wholly inside an unchanged file is
+    scoped out of the report."""
+    write_tree(tmp_path, {
+        "helpers.py": CROSS_MODULE_SYNC["helpers.py"],
+        "engine.py": CROSS_MODULE_SYNC["engine.py"],
+        "clock.py": """
+            import time
+
+            def duration():
+                t0 = time.time()
+                return time.time() - t0
+        """,
+    })
+    full = engine.lint([str(tmp_path)], str(tmp_path))
+    assert {(f.rule, f.path) for f in full["new"]} >= {
+        ("jax-host-sync", "helpers.py"),
+        ("thread-walltime-duration", "clock.py"),
+    }
+    scoped = engine.lint(
+        [str(tmp_path)], str(tmp_path), changed_only=True,
+        changed_files=["helpers.py"],
+    )
+    assert scoped["files"] == full["files"]  # analysis was not narrowed
+    assert {(f.rule, f.path) for f in scoped["new"]} == {
+        ("jax-host-sync", "helpers.py"),
+    }
+    assert "engine (engine.py) -> summarize (helpers.py)" in \
+        scoped["new"][0].message
+
+
+def test_changed_only_stale_detection_uses_full_set(tmp_path):
+    """A baseline entry for an unchanged file's finding is NOT reported
+    stale under --changed-only (the finding still exists; it is merely
+    out of scope)."""
+    write_tree(tmp_path, {
+        "clock.py": """
+            import time
+
+            def duration():
+                t0 = time.time()
+                return time.time() - t0
+        """,
+        "clean.py": "X = 1\n",
+    })
+    baseline = tmp_path / "b.json"
+    run_lint([str(tmp_path)], root=str(tmp_path),
+             baseline=str(baseline), update_baseline=True,
+             use_cache=False, out=io.StringIO())
+    r = engine.lint(
+        [str(tmp_path)], str(tmp_path), baseline_path=str(baseline),
+        changed_only=True, changed_files=["clean.py"],
+    )
+    assert r["stale"] == [] and r["new"] == [] and r["exit_code"] == 0
+
+
+# --------------------------------------------- prune-baseline + explain
+def test_cli_prune_baseline_drops_only_stale(tmp_path, capsys):
+    tree = seeded_violation_tree(tmp_path)
+    baseline = tree / "baseline.json"
+    run_lint([str(tree)], root=str(tree), baseline=str(baseline),
+             update_baseline=True, use_cache=False)
+    # fix ONE of the three seeded violations
+    (tree / "thread_mod.py").write_text(
+        "import time\n\n\ndef duration():\n"
+        "    t0 = time.monotonic()\n"
+        "    return time.monotonic() - t0\n"
+    )
+    capsys.readouterr()
+    rc = run_lint([str(tree)], root=str(tree), baseline=str(baseline),
+                  prune_baseline=True, use_cache=False)
+    out = capsys.readouterr().out
+    assert rc == 0 and "pruned 1 stale entry" in out
+    doc = json.load(open(baseline))
+    assert len(doc["findings"]) == 2
+    # still green, and no stale chatter left
+    rc = run_lint([str(tree)], root=str(tree), baseline=str(baseline),
+                  use_cache=False)
+    out = capsys.readouterr().out
+    assert rc == 0 and "stale" not in out
+
+
+def test_cli_prune_baseline_refuses_partial_views(tmp_path):
+    with pytest.raises(ValueError, match="prune-baseline"):
+        run_lint([str(tmp_path)], root=str(tmp_path),
+                 baseline=str(tmp_path / "b.json"),
+                 prune_baseline=True, changed_only=True)
+    with pytest.raises(ValueError, match="prune-baseline"):
+        run_lint([str(tmp_path)], root=str(tmp_path),
+                 baseline=str(tmp_path / "b.json"),
+                 prune_baseline=True, update_baseline=True)
+
+
+def test_cli_explain_prints_both_layer_variants(capsys):
+    from pta_replicator_tpu.analysis.cli import main as cli_main
+
+    assert cli_main(["--explain", "jax-host-sync"]) == 0
+    out = capsys.readouterr().out
+    # the id is shared by the module rule and the interprocedural pass:
+    # --explain documents both
+    assert "rules_jax.HostSyncInJit" in out
+    assert "rules_interproc.InterprocHostSync" in out
+    assert "fires on:" in out and "clean:" in out
+
+    assert cli_main(["--explain", "zz-no-such-rule"]) == 2
+    out = capsys.readouterr().out
+    assert "unknown rule" in out and "jax-key-reuse" in out
+
+
+def test_every_default_rule_carries_explain_examples():
+    """--explain is only useful if every rule ships a firing and a
+    non-firing example — enforced here so new rules can't skip them."""
+    for rule in engine.default_rules():
+        assert rule.example_fire.strip(), rule.id
+        assert rule.example_ok.strip(), rule.id
+
+
+# ------------------------------------------------------------------ SARIF
+def test_cli_sarif_format(tmp_path, capsys):
+    tree = seeded_violation_tree(tmp_path)
+    rc = run_lint([str(tree)], fmt="sarif", root=str(tree),
+                  baseline=str(tree / "nb.json"), use_cache=False)
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    rule_meta_ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert len(rule_meta_ids) == len(set(rule_meta_ids))
+    result_ids = {r["ruleId"] for r in run["results"]}
+    assert result_ids >= {"jax-host-sync", "thread-walltime-duration",
+                          "telemetry-unknown-name"}
+    assert result_ids <= set(rule_meta_ids)
+    for r in run["results"]:
+        assert r["partialFingerprints"]["graftlint/v1"]
+        assert r["locations"][0]["physicalLocation"]["region"][
+            "startLine"] >= 1
+        assert "suppressions" not in r  # none baselined here
