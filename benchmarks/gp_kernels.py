@@ -1,0 +1,460 @@
+"""The raw-speed ladder's committed evidence (docs/performance.md):
+fused likelihood kernels vs the composed build, the numerics-gated
+bf16 rung, and the roofline tile autotuner — fast AND right.
+
+Arms, per (npsr, ntoa, nmodes) scale:
+
+* **fused A/B** — wall time of the composed ReducedGP build+project
+  (materializes the (Np, Nt, Q) ``C0^-1 T`` image) vs the fused
+  single-pass kernel assembly (``ops/pallas_gp.py``):
+  ``fused_speedup`` higher-better, raw ``*_ms`` lower-better
+  (obs/regress.py directions). The honest CPU framing: the fused
+  pass is constrained to a SEQUENTIAL tile scan (the bit-identity
+  contract with the Pallas kernel), which on CPU loses ~10-20% to the
+  composed path's single multithreaded dgemm — measured 0.79-0.86x
+  here. What it buys is the deleted (Np, Nt, Q) intermediate (26 MB
+  at the flagship scale) and a kernel that rides the MXU on TPU,
+  where the bandwidth win is the point. The flagship gate is
+  therefore backend-aware: ``fused_speedup >= 1.3`` on TPU, a
+  regression floor of ``>= 0.5`` on CPU (catches a pathological
+  fused path without pretending CPU is the target).
+* **bit-identity** — the Pallas kernels under interpret mode vs their
+  tiled-XLA fallbacks, byte for byte, f32 AND f64, both kernels
+  (the one-tile-implementation contract; also pinned by
+  tests/test_gp_kernels.py).
+* **oracle** — fused grid log L vs the composed grid (<= 1e-12
+  relative, f64) at every scale, and vs the numpy f64 dense-covariance
+  oracle (<= 1e-8) at the smallest scale.
+* **bf16 drift** — the full ladder flow: arm the numerics observatory,
+  run the fused f64 workload, write the capture, present it to
+  ``precision='bf16'``; drift vs the f64 fused grid must sit within
+  the covariance-family tolerance (1e-3). Also records grid
+  throughput (``evals_per_s_bf16`` vs ``evals_per_s_f64``).
+* **tuner** — ``likelihood/tuner.py`` search over the tile candidates
+  at the flagship scale; the tuned tile is re-measured FRESH at the
+  kernel level (the quantity the roofline objective optimizes) and
+  must hold >= parity with the committed default tile
+  (``tuner_speedup >= 0.95`` — i.e. the search's choice reproduces,
+  it was not a timing fluke), and the pure lookup must return the
+  persisted choice. End-to-end build times at both tiles are
+  recorded as info. ``--tune`` writes the REAL cache
+  (``benchmarks/gp_tuner_cache.json``); otherwise the search uses a
+  scratch file and the committed cache is only read.
+
+Prints one JSON line; committed as ``KERNELS_r20_cpu.json`` and
+ingested into PERF_LEDGER.json. Exit 1 on any gate miss —
+scripts/check.sh runs the --fast configuration on every push.
+
+Usage: python benchmarks/gp_kernels.py [--fast] [--tune] [--out PATH]
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pta_replicator_tpu.batch import synthetic_batch  # noqa: E402
+from pta_replicator_tpu.likelihood import gp, infer, tuner  # noqa: E402
+from pta_replicator_tpu.models.batched import (  # noqa: E402
+    Recipe,
+    gls_noise_model,
+)
+from pta_replicator_tpu.obs import numerics  # noqa: E402
+from pta_replicator_tpu.ops import pallas_gp  # noqa: E402
+from pta_replicator_tpu.utils.provenance import (  # noqa: E402
+    EVIDENCE_SCHEMA_VERSION,
+    provenance_stamp,
+)
+
+#: family tolerance the bf16 rung is held to (the fuzzer's
+#: covariance/total bar — scenarios/fuzz.py FAMILY_TOLERANCES)
+BF16_TOL = 1e-3
+
+GRID = {"rn_log10_amplitude": np.linspace(-14.0, -13.4, 8)}
+
+
+def _scales(fast):
+    # (npsr, ntoa, rn_nmodes, gwb_nmodes); the last is the flagship
+    if fast:
+        return [(4, 384, 8, 6), (6, 768, 12, 8)]
+    return [(4, 512, 10, 8), (8, 1024, 20, 15), (16, 2048, 30, 20)]
+
+
+def _setup(npsr, ntoa, rn_nmodes, gwb_nmodes, seed=3):
+    batch = synthetic_batch(npsr=npsr, ntoa=ntoa, nbackend=2,
+                            seed=seed, dtype=np.float64)
+    nb = len(batch.backend_names)
+    rng = np.random.default_rng(seed)
+    recipe = Recipe(
+        efac=jnp.asarray(rng.uniform(0.9, 1.4, (npsr, nb))),
+        log10_equad=jnp.asarray(rng.uniform(-6.8, -6.2, (npsr, nb))),
+        log10_ecorr=jnp.asarray(rng.uniform(-6.9, -6.4, (npsr, nb))),
+        rn_log10_amplitude=jnp.asarray(
+            rng.uniform(-13.8, -13.2, npsr)
+        ),
+        rn_gamma=jnp.asarray(rng.uniform(3.0, 4.5, npsr)),
+        gwb_log10_amplitude=jnp.asarray(-14.2),
+        gwb_gamma=jnp.asarray(13.0 / 3.0),
+        rn_nmodes=rn_nmodes,
+        gwb_gls_nmodes=gwb_nmodes,
+    )
+    res = jnp.asarray(
+        rng.standard_normal(batch.toas_s.shape) * 1e-6
+    ) * batch.mask
+    return batch, recipe, res
+
+
+def _median_ms(fn, reps):
+    fn()  # warm (compile)
+    walls = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        walls.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(walls))
+
+
+def _rel(a, b):
+    denom = max(float(np.max(np.abs(np.asarray(b)))), 1e-300)
+    return float(np.max(np.abs(np.asarray(a) - np.asarray(b)))) / denom
+
+
+def bit_identity_arm(failures):
+    """Interpret-mode Pallas vs tiled-XLA fallback, byte for byte,
+    both kernels, both dtypes."""
+    out = {}
+    for dtype, tag in ((np.float32, "f32"), (np.float64, "f64")):
+        rng = np.random.default_rng(5)
+        T = jnp.asarray(rng.standard_normal((3, 100, 7)), dtype)
+        mask = rng.random((3, 100)) > 0.1
+        w = jnp.asarray(rng.uniform(0.5, 2.0, (3, 100)) * mask, dtype)
+        r = jnp.asarray(rng.standard_normal((3, 100)) * mask, dtype)
+        wa = pallas_gp.fused_woodbury_xla(T, w, r, tile=32)
+        wb = pallas_gp.fused_woodbury_update(T, w, r, tile=32,
+                                             interpret=True)
+        wood = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(wa, wb)
+        )
+        A = rng.standard_normal((2, 5, 4, 4))
+        D = jnp.asarray(A @ np.swapaxes(A, -1, -2) + 6.0 * np.eye(4),
+                        dtype)
+        E = jnp.asarray(0.2 * rng.standard_normal((2, 4, 4, 4)), dtype)
+        X = jnp.asarray(rng.standard_normal((2, 5, 4, 3)), dtype)
+        ta = pallas_gp.tridiag_factor_solve_xla(D, E, X)
+        tb = pallas_gp.tridiag_factor_solve(D, E, X, interpret=True)
+        tri = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(ta, tb)
+        )
+        out[f"woodbury_bit_identical_{tag}"] = wood
+        out[f"tridiag_bit_identical_{tag}"] = tri
+        if not wood:
+            failures.append(
+                f"bit-identity: fused Woodbury interpret != xla at {tag}"
+            )
+        if not tri:
+            failures.append(
+                f"bit-identity: tridiag interpret != xla at {tag}"
+            )
+    return out
+
+
+def ab_arm(scale, reps, tile, failures, oracle=False):
+    """Composed-vs-fused build+project A/B at one scale + the
+    agreement gates."""
+    npsr, ntoa, rn_nm, gwb_nm = scale
+    batch, recipe, res = _setup(npsr, ntoa, rn_nm, gwb_nm)
+
+    @jax.jit
+    def composed(r):
+        red = gp.ReducedGP.build(batch, recipe, dtype=r.dtype)
+        proj = red.project(r, batch)
+        return red.TNT, proj.rNr, proj.d
+
+    @jax.jit
+    def fused(r):
+        red, proj = gp.ReducedGP.build_fused(
+            batch, recipe, residuals=r, dtype=r.dtype,
+            tile=tile, backend="xla",
+        )
+        return red.TNT, proj.rNr, proj.d
+
+    composed_ms = _median_ms(lambda: composed(res), reps)
+    fused_ms = _median_ms(lambda: fused(res), reps)
+
+    ca, cb, cc = composed(res)
+    fa, fb, fc = fused(res)
+    tnt_rel = _rel(fa, ca)
+    proj_rel = max(_rel(fb, cb), _rel(fc, cc))
+
+    ll = np.asarray(infer.grid_loglikelihood(res, batch, recipe, GRID))
+    llf = np.asarray(infer.grid_loglikelihood(
+        res, batch, recipe, GRID, fused=True, tile=tile, backend="xla"
+    ))
+    grid_rel = float(np.max(np.abs(llf - ll) / np.abs(ll)))
+    tag = f"np{npsr}_nt{ntoa}"
+    if grid_rel > 1e-12 or tnt_rel > 1e-12:
+        failures.append(
+            f"{tag}: fused-vs-composed disagreement (grid {grid_rel:.3e}"
+            f", TNT {tnt_rel:.3e}) > 1e-12"
+        )
+    rec = {
+        "composed_ms": round(composed_ms, 3),
+        "fused_ms": round(fused_ms, 3),
+        "fused_speedup": round(composed_ms / fused_ms, 3),
+        "tnt_rel": tnt_rel,
+        "proj_rel": proj_rel,
+        "grid_rel": grid_rel,
+    }
+    if oracle:
+        import dataclasses
+
+        r2 = dataclasses.replace(
+            recipe,
+            rn_log10_amplitude=jnp.full(
+                npsr, GRID["rn_log10_amplitude"][0]
+            ),
+        )
+        oracle_ll = float(gp.dense_loglikelihood(res, batch, r2))
+        oracle_rel = abs(llf[0] - oracle_ll) / abs(oracle_ll)
+        rec["oracle_rel"] = oracle_rel
+        if oracle_rel > 1e-8:
+            failures.append(
+                f"{tag}: fused-vs-dense-oracle deviation "
+                f"{oracle_rel:.3e} > 1e-8"
+            )
+    return rec, (batch, recipe, res)
+
+
+def bf16_arm(setup, tile, reps, failures):
+    """The full ladder flow: capture -> verdict -> gated bf16 run,
+    drift held to the covariance-family tolerance."""
+    batch, recipe, res = setup
+    ll64 = np.asarray(infer.grid_loglikelihood(
+        res, batch, recipe, GRID, fused=True, tile=tile, backend="xla"
+    ))
+    with tempfile.TemporaryDirectory() as cap:
+        numerics.reset()
+        numerics.arm()
+        try:
+            infer.grid_loglikelihood(
+                res, batch, recipe, GRID, fused=True, tile=tile,
+                backend="xla",
+            )
+            numerics.write(cap)
+        finally:
+            numerics.disarm()
+            numerics.reset()
+        verdict = numerics.ladder_verdict(json.loads(
+            open(os.path.join(cap, "numerics.json")).read()
+        ))
+        sites = {
+            s: verdict.get(s, {"ready": False, "reasons": ["missing"]})
+            for s in gp.FUSED_PRECISION_SITES
+        }
+        not_ready = [s for s, v in sites.items() if not v["ready"]]
+        if not_ready:
+            failures.append(
+                f"bf16: ladder verdict not ready for {not_ready} — "
+                "the gated rung is unreachable on this workload"
+            )
+            return {"ready": False, "not_ready": not_ready}
+        g = int(np.asarray(GRID["rn_log10_amplitude"]).size)
+
+        def run64():
+            return infer.grid_loglikelihood(
+                res, batch, recipe, GRID, fused=True, tile=tile,
+                backend="xla",
+            )
+
+        def run16():
+            return infer.grid_loglikelihood(
+                res, batch, recipe, GRID, fused=True, tile=tile,
+                backend="xla", precision="bf16", numerics_capture=cap,
+            )
+
+        ll16 = np.asarray(run16())
+        drift = float(np.max(np.abs(ll16 - ll64) / np.abs(ll64)))
+        ms64 = _median_ms(run64, reps)
+        ms16 = _median_ms(run16, reps)
+    if drift > BF16_TOL:
+        failures.append(
+            f"bf16: grid drift {drift:.3e} vs f64 fused > {BF16_TOL}"
+            " (covariance-family tolerance)"
+        )
+    return {
+        "ready": True,
+        "bf16_max_drift": drift,
+        "tolerance": BF16_TOL,
+        "evals_per_s_f64": round(g / (ms64 / 1e3), 2),
+        "evals_per_s_bf16": round(g / (ms16 / 1e3), 2),
+    }
+
+
+#: search space for the bench's tuner arm — the module defaults plus
+#: the whole-Nt tile the flagship scale favors on CPU
+TUNER_CANDIDATES = (128, 256, 512, 1024, 2048)
+
+
+def tuner_arm(setup, reps, tune, failures, gate=True):
+    """Search the tile candidates at the flagship scale; re-measure
+    the tuned choice fresh at the kernel level and gate it at >=
+    parity with the committed default tile. ``gate=False`` (the
+    --fast arm) records the re-measurement without failing on it: at
+    the fast scale the tile landscape is flat and scheduler noise
+    picks the winner — the parity contract is the full run's."""
+    batch, recipe, res = setup
+
+    _sigma2, _ecorr2, U, _phi = gls_noise_model(batch, recipe)
+    T = jnp.asarray(U, np.float64)
+    dtype = T.dtype
+    winv = jnp.where(batch.mask > 0, 1.0, 0.0).astype(dtype)
+    r0 = jnp.zeros(batch.mask.shape, dtype)
+
+    def kernel_at(tile):
+        run = jax.jit(
+            lambda a, b, c, t=int(tile):
+            pallas_gp.fused_woodbury_xla(a, b, c, tile=t)
+        )
+        return lambda: run(T, winv, r0)
+
+    def build_at(tile):
+        @jax.jit
+        def run(r, t=int(tile)):
+            red, proj = gp.ReducedGP.build_fused(
+                batch, recipe, residuals=r, dtype=r.dtype,
+                tile=t, backend="xla",
+            )
+            return red.TNT, proj.rNr, proj.d
+
+        return lambda: run(res)
+
+    if tune:
+        cache_path = tuner.DEFAULT_CACHE_PATH
+    else:
+        cache_path = os.path.join(
+            tempfile.mkdtemp(prefix="gp_tuner_"), "cache.json"
+        )
+    choice = tuner.autotune(
+        batch, T, backend="xla", candidates=TUNER_CANDIDATES,
+        reps=reps, cache_path=cache_path,
+    )
+    looked_up = tuner.woodbury_tile(batch, "xla",
+                                    cache_path=cache_path)
+    # fresh kernel-level re-measurement — the quantity the roofline
+    # objective optimized; >= parity means the choice reproduces
+    default_ms = _median_ms(
+        kernel_at(pallas_gp.DEFAULT_WOODBURY_TILE), reps
+    )
+    tuned_ms = _median_ms(kernel_at(looked_up), reps)
+    speedup = default_ms / tuned_ms
+    if looked_up != choice["tile"]:
+        failures.append(
+            f"tuner: lookup returned {looked_up}, search chose "
+            f"{choice['tile']} — the cache round trip is broken"
+        )
+    if gate and speedup < 0.95:
+        failures.append(
+            f"tuner: tuned tile {looked_up} re-measures at "
+            f"{speedup:.2f}x the default kernel — the search choice "
+            "did not reproduce"
+        )
+    return {
+        "tuned_tile": int(looked_up),
+        "default_tile": int(pallas_gp.DEFAULT_WOODBURY_TILE),
+        "kernel_default_ms": round(default_ms, 3),
+        "kernel_tuned_ms": round(tuned_ms, 3),
+        "tuner_speedup": round(speedup, 3),
+        "build_default_ms": round(
+            _median_ms(build_at(pallas_gp.DEFAULT_WOODBURY_TILE),
+                       reps), 3
+        ),
+        "build_tuned_ms": round(_median_ms(build_at(looked_up), reps),
+                                3),
+        "candidates": choice["candidates"],
+        "wrote_committed_cache": bool(tune),
+    }
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    fast = "--fast" in argv
+    tune = "--tune" in argv
+    out_path = None
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    reps = 3 if fast else 5
+    scales = _scales(fast)
+
+    failures = []
+    t0 = time.monotonic()
+    bit_identity = bit_identity_arm(failures)
+    arms = {}
+    flagship_setup = None
+    for i, scale in enumerate(scales):
+        # the committed default tile everywhere: the tuner arm owns
+        # the tuned-vs-default comparison
+        rec, setup = ab_arm(
+            scale, reps, pallas_gp.DEFAULT_WOODBURY_TILE, failures,
+            oracle=(i == 0),
+        )
+        arms[f"np{scale[0]}_nt{scale[1]}"] = rec
+        flagship_setup = setup
+    flagship = arms[f"np{scales[-1][0]}_nt{scales[-1][1]}"]
+    # backend-aware speed gate (module docstring: the honest framing)
+    floor = 1.3 if jax.default_backend() == "tpu" else 0.5
+    if flagship["fused_speedup"] < floor:
+        failures.append(
+            f"flagship: fused_speedup {flagship['fused_speedup']} < "
+            f"{floor} on {jax.default_backend()}"
+        )
+    bf16 = bf16_arm(flagship_setup, pallas_gp.DEFAULT_WOODBURY_TILE,
+                    reps, failures)
+    tuner_rec = tuner_arm(flagship_setup, reps, tune, failures,
+                          gate=not fast)
+
+    rec = {
+        "bench": "gp_kernels",
+        "backend": jax.default_backend(),
+        "fast": fast,
+        "wall_s": round(time.monotonic() - t0, 3),
+        "scales": [list(s) for s in scales],
+        "bit_identity": bit_identity,
+        "arms": arms,
+        "bf16": bf16,
+        "tuner": tuner_rec,
+        "ok": not failures,
+        "failures": failures,
+        **provenance_stamp(
+            EVIDENCE_SCHEMA_VERSION,
+            repo_root=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ),
+        ),
+    }
+    payload = json.dumps(rec)
+    print(payload)
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(payload + "\n")
+    if failures:
+        # CI /dev/nulls stdout (scripts/check.sh); the reason for an
+        # exit 1 must land on stderr or it is invisible
+        for f in failures:
+            print(f"gp_kernels gate miss: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -413,4 +413,84 @@ class GlobalClosureInJit(Rule):
                     )
 
 
-RULES = [HostSyncInJit(), F64LiteralInJit(), KeyReuse(), GlobalClosureInJit()]
+class PallasOrphanFallback(Rule):
+    """A Pallas kernel with no path to verification. The repo's kernel
+    discipline (ops/pallas_cw.py, ops/pallas_gp.py, docs/performance.md)
+    is ONE per-tile implementation shared by the TPU kernel and a tiled
+    XLA fallback, with interpret-mode bit-identity pinned by test —
+    a ``pl.pallas_call`` in a module with neither a top-level ``*_xla``
+    fallback function nor a ``PALLAS_BIT_IDENTITY_TESTS`` marker (the
+    tuple naming its bit-identity tests, for kernels whose fallback
+    lives in a consumer module) is a kernel nothing can cross-check."""
+
+    id = "jax-pallas-orphan-fallback"
+    severity = "error"
+    description = (
+        "pl.pallas_call in a module with neither a shared-tile *_xla "
+        "fallback function nor a PALLAS_BIT_IDENTITY_TESTS marker"
+    )
+    example_fire = (
+        "def _kernel(x_ref, o_ref):\n"
+        "    o_ref[...] = x_ref[...] * 2\n"
+        "def double(x):\n"
+        "    return pl.pallas_call(_kernel, ...)(x)   # no fallback: FIRES\n"
+    )
+    example_ok = (
+        "def double_xla(x, tile=128):  # same tile fn, lax loop\n"
+        "    ...\n"
+        "def double(x):\n"
+        "    return pl.pallas_call(_kernel, ...)(x)\n"
+        "# or: PALLAS_BIT_IDENTITY_TESTS = ('tests/test_x.py::test_bits',)\n"
+    )
+
+    @staticmethod
+    def _is_pallas_call(mod: Module, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        resolved = mod.resolve(node.func) or ""
+        return resolved == "pallas_call" or resolved.endswith(
+            ".pallas_call"
+        )
+
+    def check_module(self, mod: Module) -> Iterable[Finding]:
+        sites = [
+            node for node in ast.walk(mod.tree)
+            if self._is_pallas_call(mod, node)
+        ]
+        if not sites:
+            return
+        has_fallback = any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name.endswith("_xla")
+            for n in mod.tree.body
+        )
+
+        def _marker_target(n: ast.AST) -> bool:
+            if isinstance(n, ast.Assign):
+                return any(
+                    isinstance(t, ast.Name)
+                    and t.id == "PALLAS_BIT_IDENTITY_TESTS"
+                    for t in n.targets
+                )
+            return isinstance(n, ast.AnnAssign) and isinstance(
+                n.target, ast.Name
+            ) and n.target.id == "PALLAS_BIT_IDENTITY_TESTS"
+
+        has_marker = any(_marker_target(n) for n in mod.tree.body)
+        if has_fallback or has_marker:
+            return
+        for node in sites:
+            yield self.finding(
+                mod, node.lineno,
+                "pl.pallas_call with no verification path in this "
+                "module: add a top-level *_xla fallback sharing the "
+                "per-tile implementation, or a module-level "
+                "PALLAS_BIT_IDENTITY_TESTS tuple naming the "
+                "interpret-mode bit-identity tests that pin it",
+            )
+
+
+RULES = [
+    HostSyncInJit(), F64LiteralInJit(), KeyReuse(), GlobalClosureInJit(),
+    PallasOrphanFallback(),
+]

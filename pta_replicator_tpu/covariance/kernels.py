@@ -274,6 +274,69 @@ def block_tridiag_solve(Ld, M, X):
     return _unscan_axis(Z)
 
 
+@functools.lru_cache(maxsize=None)
+def _tridiag_mxu_engine(backend: str):
+    """The jitted MXU-rung engine for :func:`block_tridiag_factor_solve`
+    (``cov.tridiag_mxu`` label, so devprof cost/roofline attribution
+    covers the fused tridiagonal kernel)."""
+    from ..obs import instrumented_jit, names
+    from ..ops import pallas_gp
+
+    if backend == "xla":
+
+        def run(D, E, X):
+            return pallas_gp.tridiag_factor_solve_xla(D, E, X)
+
+    else:
+        interpret = backend == "pallas_interpret"
+
+        def run(D, E, X):
+            return pallas_gp.tridiag_factor_solve(
+                D, E, X, interpret=interpret
+            )
+
+    return instrumented_jit(
+        run, name=names.JIT_COV_TRIDIAG_MXU, retrace_warn=16,
+    )
+
+
+def block_tridiag_factor_solve(D, E, X, backend: str = "auto"):
+    """Fused factor + solve of a block-tridiagonal SPD system: one
+    pass produces ``(Ld, M, Z)`` — the factor blocks of
+    :func:`block_tridiag_cholesky` plus the solution of ``(L L^T) Z =
+    X`` — for (Np, nb, b, b) ``D``/(Np, nb-1, b, b) ``E``/(Np, nb, b,
+    Q) ``X``.
+
+    Rung 1b of the raw-speed ladder (docs/performance.md): the
+    'scan' backend is the composed pair above (bitwise-identical
+    reference — LAPACK per-step Cholesky/solves); 'xla' and
+    'pallas'/'pallas_interpret' run the MXU-tiled scan body of
+    ops/pallas_gp.py, whose per-tile factor/solve is ONE shared
+    implementation (interpret-mode bit-identity pinned by
+    tests/test_gp_kernels.py). 'auto' = pallas on TPU, the composed
+    scan elsewhere — callers that don't opt in never change paths.
+    Factor-once/solve-many callers (covariance/structure.py's banded
+    solver) keep the composed pair; this entry is for the
+    factor+first-solve pattern where the fusion saves a full pass."""
+    if backend == "auto":
+        backend = "pallas" if jax.default_backend() == "tpu" else "scan"
+    if backend == "scan":
+        Ld, M = block_tridiag_cholesky(D, E)
+        return Ld, M, block_tridiag_solve(Ld, M, X)
+    if backend not in ("xla", "pallas", "pallas_interpret"):
+        raise ValueError(
+            f"unknown block_tridiag backend {backend!r}: expected "
+            "'auto', 'scan', 'xla', 'pallas' or 'pallas_interpret'"
+        )
+    Ld, M, Z = _tridiag_mxu_engine(backend)(D, E, X)
+    # same attribution contract as cov.tridiag_pivot: a late block
+    # column driven indefinite inside the fused kernel names the MXU
+    # rung, not its downstream logdet/solve consumer
+    Ld = numerics.probe_cholesky("cov.tridiag_mxu_pivot", Ld)
+    Z = numerics.probe("cov.tridiag_mxu_solve", Z)
+    return Ld, M, Z
+
+
 def block_tridiag_matvec(D, E, X):
     """``C X`` for the block-tridiagonal (D, E) representation and
     (Np, nb, b, Q) operands."""

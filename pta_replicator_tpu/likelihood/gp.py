@@ -63,7 +63,13 @@ from jax.scipy.linalg import cho_solve, solve_triangular
 
 from ..batch import PulsarBatch
 from ..covariance.kernels import _chol_logdet
-from ..models.batched import Recipe, gls_noise_model, white_ecorr_solver
+from ..models.batched import (
+    Recipe,
+    gls_noise_model,
+    white_ecorr_parts,
+    white_ecorr_solver,
+)
+from ..ops import pallas_gp
 # numerics observatory: the (R, R)/(ktm, ktm) Cholesky diagonals below
 # pass through identity probes so an indefinite S (NaN rows from f32
 # conditioning loss) names its factorization site instead of surfacing
@@ -81,6 +87,143 @@ _LOG_2PI = float(np.log(2.0 * np.pi))
 WHITE_NOISE_FIELDS = frozenset(
     {"efac", "log10_equad", "log10_ecorr", "tnequad", "cov_log10_sigma"}
 )
+
+#: The numerics-observatory sites the fused Woodbury-assembly rung
+#: writes (ops/pallas_gp.py outputs). The bf16 precision policy is
+#: refused at runtime unless a capture's ladder verdict says every one
+#: of these is ready — see :func:`require_precision_ready`.
+FUSED_PRECISION_SITES = ("gp.fused_tnt", "gp.fused_d", "gp.fused_rnr")
+
+
+class PrecisionNotReady(RuntimeError):
+    """Raised when ``precision='bf16'`` is requested without a numerics
+    capture whose ladder verdict clears every fused-kernel probe site
+    (docs/numerics.md "the precision ladder"). The remedy is always the
+    same: run the fused path armed (``numerics.arm()`` +
+    ``numerics.write(dir)``) on representative data, then pass that
+    capture via ``numerics_capture=``."""
+
+
+def require_precision_ready(precision, numerics_capture=None):
+    """Validate a ``precision=`` policy against the numerics
+    observatory's ladder verdict — the runtime gate that makes bf16
+    compute opt-in AND evidence-backed rather than a free-floating flag.
+
+    ``precision='highest'`` (the default) always passes.
+    ``precision='bf16'`` requires ``numerics_capture``: a directory
+    containing (or a path to) a ``numerics.json`` written by an armed
+    run of the fused path. The capture's
+    :func:`~pta_replicator_tpu.obs.numerics.ladder_verdict` must mark
+    every :data:`FUSED_PRECISION_SITES` entry ready (zero non-finites,
+    >= 8 bits of bf16 headroom, family drift within tolerance);
+    otherwise :class:`PrecisionNotReady` names the failing sites and
+    reasons. Returns the validated policy string."""
+    if precision in (None, "highest"):
+        return "highest"
+    if precision != "bf16":
+        raise ValueError(
+            f"unknown precision policy {precision!r}: expected one of "
+            f"{pallas_gp.PRECISIONS}"
+        )
+    if numerics_capture is None:
+        raise PrecisionNotReady(
+            "precision='bf16' needs evidence: pass numerics_capture= a "
+            "numerics.json (or its directory) written by an armed run "
+            "of the fused path, so the ladder verdict for "
+            f"{FUSED_PRECISION_SITES} can be checked"
+        )
+    import json
+    import os
+
+    path = os.fspath(numerics_capture)
+    if os.path.isdir(path):
+        path = os.path.join(path, "numerics.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        raise PrecisionNotReady(
+            f"numerics capture {path!r} is unreadable ({exc}); rerun "
+            "the fused path armed and write a fresh capture"
+        ) from exc
+    verdict = numerics.ladder_verdict(doc)
+    missing = [s for s in FUSED_PRECISION_SITES if s not in verdict]
+    if missing:
+        raise PrecisionNotReady(
+            f"numerics capture {path!r} never observed fused sites "
+            f"{missing} — it must come from an armed run of the fused "
+            "path itself, not an unrelated capture"
+        )
+    blocked = {
+        s: verdict[s]["reasons"]
+        for s in FUSED_PRECISION_SITES
+        if not verdict[s]["ready"]
+    }
+    if blocked:
+        raise PrecisionNotReady(
+            f"ladder verdict refuses bf16 for {sorted(blocked)}: "
+            f"{blocked}"
+        )
+    return "bf16"
+
+
+def _resolve_fused_backend(backend: str) -> str:
+    """'auto' -> the platform's native rung ('pallas' on TPU, tiled
+    'xla' elsewhere) — same routing contract as
+    covariance.kernels.blocked_cholesky."""
+    if backend == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    if backend not in ("xla", "pallas", "pallas_interpret"):
+        raise ValueError(
+            f"unknown fused backend {backend!r}: expected 'auto', "
+            "'xla', 'pallas' or 'pallas_interpret'"
+        )
+    return backend
+
+
+def _fused_assembly(T, winv, gain, seg_sum, r, tile, precision, backend):
+    """One fused pass over the TOA axis: ``T^T C0^-1 T``, ``T^T C0^-1
+    r`` and ``r^T C0^-1 r`` without ever materializing ``C0^-1 T``
+    (the (Nt, Q) intermediate of the composed build).
+
+    The kernel (ops/pallas_gp.py) prices the diagonal-N part by
+    accumulating (tile, Q) slabs; the per-epoch ECORR Woodbury
+    correction is exact O(E) algebra applied here OUTSIDE the kernel —
+    epochs are irregular segments, so the correction is a segment-sum
+    (``white_ecorr_parts``'s operator, the SAME algebra the composed
+    solver applies) followed by three small (E, Q) contractions. The
+    bf16 policy applies to the kernel's O(Nt Q^2) bulk; the O(E Q^2)
+    correction and everything downstream stay at the accumulator
+    dtype."""
+    if backend == "pallas":
+        tnt, d, rnr = pallas_gp.fused_woodbury_update(
+            T, winv, r, tile=tile, precision=precision
+        )
+    elif backend == "pallas_interpret":
+        tnt, d, rnr = pallas_gp.fused_woodbury_update(
+            T, winv, r, tile=tile, precision=precision, interpret=True
+        )
+    else:
+        tnt, d, rnr = pallas_gp.fused_woodbury_xla(
+            T, winv, r, tile=tile, precision=precision
+        )
+    if gain is not None:
+        acc = tnt.dtype
+        S = seg_sum(winv[..., None] * T).astype(acc)  # (Np, E, Q)
+        s_r = seg_sum((winv * r)[..., None])[..., 0].astype(acc)
+        g = gain.astype(acc)
+        tnt = tnt - jnp.einsum(
+            "peq,pe,pes->pqs", S, g, S, precision="highest"
+        )
+        d = d - jnp.einsum("peq,pe->pq", S, g * s_r, precision="highest")
+        rnr = rnr - jnp.sum(g * s_r * s_r, axis=-1)
+    # numerics observatory: the fused outputs are exactly the blocks
+    # the reduced likelihood consumes — probing them (identity when
+    # disarmed) is what gives the bf16 ladder verdict its evidence.
+    tnt = numerics.probe("gp.fused_tnt", tnt)
+    d = numerics.probe("gp.fused_d", d)
+    rnr = numerics.probe("gp.fused_rnr", rnr)
+    return tnt, d, rnr
 
 
 def _tm_columns(batch: PulsarBatch, design, dtype):
@@ -254,8 +397,11 @@ class ReducedGP:
 
     #: (Np, Q, Q) T^T C0^-1 T over the stacked columns [Mn, U]
     TNT: jax.Array
-    #: (Np, Nt, Q) C0^-1 T — the projector applied to residual vectors
-    CiT: jax.Array
+    #: (Np, Nt, Q) C0^-1 T — the projector applied to residual
+    #: vectors. None on the fused rung, whose whole point is never
+    #: materializing it (:meth:`project` then uses the retained ``T``
+    #: and the O(Nt) direct C0^-1 apply instead).
+    CiT: Optional[jax.Array]
     #: (Np,) masked log det C0
     logdet_c0: jax.Array
     #: (Np, Nt) white per-TOA variance and (Np, E) per-epoch ECORR
@@ -275,19 +421,54 @@ class ReducedGP:
     #: WHITE_NOISE_FIELDS routes them to the direct path)
     extra: Optional[object] = None
     extra_s2: Optional[jax.Array] = None
+    #: (Np, Nt, Q) stacked column basis [Mn, U] — retained ONLY on the
+    #: fused rung (where CiT is None) so :meth:`project` can form
+    #: T^T C0^-1 r directly; None on the composed path
+    T: Optional[jax.Array] = None
     #: number of leading timing-model columns in the stack
     ktm: int = field(metadata=dict(static=True), default=0)
+    #: True when built by :meth:`build_fused` (routes :meth:`project`
+    #: through the direct O(Nt) apply instead of CiT)
+    fused: bool = field(metadata=dict(static=True), default=False)
+    #: fused-kernel compute policy ('highest' | 'bf16'); 'highest'
+    #: everywhere off the fused rung
+    precision: str = field(metadata=dict(static=True), default="highest")
+    #: fused-kernel TOA tile size (likelihood/tuner.py picks it)
+    tile: int = field(
+        metadata=dict(static=True), default=pallas_gp.DEFAULT_WOODBURY_TILE
+    )
+    #: fused-kernel backend ('xla' | 'pallas' | 'pallas_interpret')
+    backend: str = field(metadata=dict(static=True), default="xla")
 
     @classmethod
     def build(
-        cls, batch: PulsarBatch, recipe: Recipe, design=None, dtype=None
+        cls,
+        batch: PulsarBatch,
+        recipe: Recipe,
+        design=None,
+        dtype=None,
+        fused: bool = False,
+        precision: str = "highest",
+        tile: Optional[int] = None,
+        backend: str = "auto",
     ) -> "ReducedGP":
         """Precompute every Nt-sized block. ``recipe`` fixes the white/
         ECORR noise AND the GP basis layout; its phi values are not
         retained (evaluations supply their own via
-        :func:`phi_for_recipe`)."""
+        :func:`phi_for_recipe`).
+
+        ``fused=True`` (or a non-default ``precision``) routes through
+        :meth:`build_fused` — same blocks, one fused kernel pass, no
+        (Np, Nt, Q) ``CiT`` intermediate. The default path below is
+        bitwise unchanged."""
         from ..covariance.structure import recipe_cov_s2
 
+        if fused or precision != "highest":
+            reduced, _proj = cls.build_fused(
+                batch, recipe, design=design, dtype=dtype,
+                precision=precision, tile=tile, backend=backend,
+            )
+            return reduced
         if dtype is None:
             dtype = batch.toas_s.dtype
         sigma2, ecorr2, U, phi = gls_noise_model(batch, recipe)
@@ -326,6 +507,90 @@ class ReducedGP:
             extra_s2=extra_s2, ktm=ktm,
         )
 
+    @classmethod
+    def build_fused(
+        cls,
+        batch: PulsarBatch,
+        recipe: Recipe,
+        residuals=None,
+        design=None,
+        dtype=None,
+        precision: str = "highest",
+        tile: Optional[int] = None,
+        backend: str = "auto",
+    ):
+        """The fused rung of the speed ladder: one kernel pass
+        (ops/pallas_gp.py) assembles ``T^T C0^-1 T`` — and, when
+        ``residuals`` is given, ``T^T C0^-1 r`` / ``r^T C0^-1 r`` in
+        the same pass — without materializing the (Np, Nt, Q) ``CiT``
+        intermediate the composed :meth:`build` pays for. Returns
+        ``(ReducedGP, GPProjection or None)``.
+
+        ``precision='bf16'`` runs the kernel's O(Nt Q^2) contractions
+        in bf16 with f32 accumulation; callers gate it through
+        :func:`require_precision_ready` first (likelihood/infer.py
+        does). ``tile=None`` asks likelihood/tuner.py for the cached
+        roofline-tuned tile (falling back to the default constant
+        untuned). Only the analytic white+ECORR C0 is fusable — a
+        structured ``noise_cov`` block raises (the composed build
+        handles it)."""
+        if recipe.noise_cov is not None:
+            raise ValueError(
+                "the fused Woodbury rung prices the analytic white/"
+                "ECORR C0 only; a recipe with a structured noise_cov "
+                "block must use the composed ReducedGP.build"
+            )
+        if dtype is None:
+            dtype = batch.toas_s.dtype
+        backend = _resolve_fused_backend(backend)
+        if tile is None:
+            from .tuner import woodbury_tile
+
+            tile = woodbury_tile(batch, backend)
+        sigma2, ecorr2, U, _phi = gls_noise_model(batch, recipe)
+        winv, seg_sum, gain, logdet_c0 = white_ecorr_parts(
+            batch, sigma2, ecorr2, dtype
+        )
+        winv = numerics.probe("solver.winv", winv)
+        logdet_c0 = numerics.probe("solver.logdet_c0", logdet_c0)
+        cols = []
+        zero_col = None
+        ktm = 0
+        if design is not None:
+            Mn, zero_col = _tm_columns(batch, design, dtype)
+            ktm = Mn.shape[-1]
+            cols.append(Mn)
+        if U is not None:
+            cols.append(jnp.asarray(U, dtype))
+        if not cols:
+            raise ValueError(
+                "ReducedGP needs at least one low-rank block (a GP "
+                "noise term in the recipe or a design tensor) — a "
+                "white-noise-only likelihood has no reduced basis; "
+                "call loglikelihood directly"
+            )
+        T = jnp.concatenate(cols, axis=-1)
+        if residuals is None:
+            r = jnp.zeros(batch.mask.shape, dtype)
+        else:
+            r = jnp.asarray(residuals, dtype) * batch.mask
+        TNT, d, rNr = _fused_assembly(
+            T, winv, gain, seg_sum, r, tile, precision, backend
+        )
+        ndof = batch.ntoas.astype(dtype)
+        if zero_col is not None:
+            ndof = ndof - jnp.sum((~zero_col).astype(dtype), axis=-1)
+        reduced = cls(
+            TNT=TNT, CiT=None, logdet_c0=logdet_c0,
+            sigma2=jnp.asarray(sigma2, dtype),
+            ecorr2=None if ecorr2 is None else jnp.asarray(ecorr2, dtype),
+            zero_col=zero_col, ndof=ndof, extra=None, extra_s2=None,
+            T=T, ktm=ktm, fused=True, precision=precision,
+            tile=int(tile), backend=backend,
+        )
+        proj = None if residuals is None else GPProjection(rNr=rNr, d=d)
+        return reduced, proj
+
     @property
     def ngp(self) -> int:
         return int(self.TNT.shape[-1]) - self.ktm
@@ -337,6 +602,33 @@ class ReducedGP:
         the same :func:`white_ecorr_solver` the build used (rebuilt
         from the retained sigma2/ecorr2 — free under jit), so the
         projection and the precompute cannot price different C0s."""
+        if self.fused:
+            # fused rung: CiT was never materialized. T^T C0^-1 r via
+            # the O(Nt) direct apply y = C0^-1 r (white_ecorr_parts —
+            # the SAME algebra the kernel assembly corrected with),
+            # then one (Nt, Q) contraction against the retained T.
+            dtype = self.T.dtype
+            winv, seg_sum, gain, _ld = white_ecorr_parts(
+                batch, self.sigma2, self.ecorr2, dtype
+            )
+            r = jnp.asarray(residuals, dtype) * batch.mask
+            y = winv * r
+            if gain is not None:
+                s_r = seg_sum(y[..., None])[..., 0]
+                picked = jnp.take_along_axis(
+                    gain * s_r, batch.epoch_index, axis=1
+                )
+                y = y - winv * picked
+            rNr = jnp.einsum("pn,pn->p", r, y, precision="highest")
+            d = jnp.einsum("pnq,pn->pq", self.T, y, precision="highest")
+            if self.precision == "bf16":
+                # match the kernel's f32 accumulator dtype so banked
+                # and build-time projections agree exactly
+                rNr = rNr.astype(jnp.float32)
+                d = d.astype(jnp.float32)
+            rNr = numerics.probe("gp.fused_rnr", rNr)
+            d = numerics.probe("gp.fused_d", d)
+            return GPProjection(rNr=rNr, d=d)
         dtype = self.CiT.dtype
         _winv, c0inv, _logdet = white_ecorr_solver(
             batch, self.sigma2, self.ecorr2, dtype,

@@ -102,6 +102,59 @@ def _direct_grid_engine(names: Tuple[str, ...], per_pulsar: bool):
 
 
 @functools.lru_cache(maxsize=None)
+def _fused_build_engine(precision: str, tile: int, backend: str):
+    """Jitted fused ReducedGP precompute+projection (rung 1 of the
+    raw-speed ladder): ONE kernel pass over the TOA axis assembles
+    T^T C0^-1 T / T^T C0^-1 r / r^T C0^-1 r (ops/pallas_gp.py via
+    ``ReducedGP.build_fused``) — no (Np, Nt, Q) CiT intermediate.
+    Labelled ``gp.fused_woodbury`` so devprof cost/roofline accounting
+    attributes the fused kernels. Runs once per grid/bank call; the
+    per-point evaluation then rides the SAME reduced engine as the
+    composed path."""
+    from ..obs import instrumented_jit
+    from ..obs import names as n
+
+    def run(residuals, batch, recipe, design):
+        return gp.ReducedGP.build_fused(
+            batch, recipe, residuals=residuals, design=design,
+            dtype=None if residuals is None else residuals.dtype,
+            precision=precision, tile=tile, backend=backend,
+        )
+
+    return instrumented_jit(
+        run, name=n.JIT_GP_FUSED_WOODBURY, retrace_warn=32,
+    )
+
+
+def _resolve_fused(batch, recipe, fused, precision, tile, backend,
+                   numerics_capture):
+    """Shared fused-path argument resolution for the grid/bank
+    drivers: validate the precision policy against the numerics
+    ladder verdict (:func:`~.gp.require_precision_ready` — bf16 is
+    refused without capture evidence), resolve 'auto' to the
+    platform backend, and look the tile up in the autotuner cache
+    (pure lookup; defaults when untuned). Returns the resolved
+    ``(fused, precision, tile, backend)`` with everything host-side
+    concrete (engine cache keys)."""
+    precision = gp.require_precision_ready(precision, numerics_capture)
+    fused = bool(fused) or precision != "highest"
+    if not fused:
+        return False, "highest", None, None
+    if recipe.noise_cov is not None:
+        raise ValueError(
+            "fused=True prices the analytic white/ECORR C0 only; a "
+            "recipe with a structured noise_cov block must use the "
+            "composed path (fused=False)"
+        )
+    backend = gp._resolve_fused_backend(backend)
+    if tile is None:
+        from .tuner import woodbury_tile
+
+        tile = woodbury_tile(batch, backend)
+    return True, precision, int(tile), backend
+
+
+@functools.lru_cache(maxsize=None)
 def _reduced_grid_engine(names: Tuple[str, ...], per_pulsar: bool):
     """Jitted vmap of the ReducedGP fast path over a (G, P) theta
     block: per point, only the phi priors are re-evaluated (the basis
@@ -146,6 +199,11 @@ def grid_loglikelihood(
     design=None,
     per_pulsar: bool = False,
     chunk: Optional[int] = None,
+    fused: bool = False,
+    precision: str = "highest",
+    tile: Optional[int] = None,
+    backend: str = "auto",
+    numerics_capture=None,
 ):
     """log L over a hyperparameter grid: (G,) totals (or (G, Np) with
     ``per_pulsar``) for aligned 1-D grid axes (Recipe field name ->
@@ -158,9 +216,30 @@ def grid_loglikelihood(
     pays the full per-point rebuild. ``chunk`` bounds the vmapped block
     size (device memory control for huge grids); results are identical
     at any chunking.
+
+    The raw-speed ladder (docs/performance.md) is opt-in: ``fused=True``
+    runs the precompute through the fused Woodbury-assembly kernel
+    (requires a reducible grid — it IS the fast path, made faster);
+    ``precision='bf16'`` additionally runs the kernel's contractions in
+    bf16/f32-accumulate, refused unless ``numerics_capture`` holds a
+    ladder verdict clearing the fused sites
+    (:func:`~.gp.require_precision_ready`). ``tile``/``backend`` pin the
+    kernel tiling (default: autotuner cache, then constants). All
+    defaults keep this function bitwise identical to its pre-ladder
+    behavior.
     """
     dtype = jnp.asarray(residuals).dtype
     names, theta = _theta_block(grid, dtype)
+    fused, precision, tile, backend = _resolve_fused(
+        batch, recipe, fused, precision, tile, backend, numerics_capture
+    )
+    if fused and not _reducible(names, recipe):
+        raise ValueError(
+            f"fused=True requires a reducible grid (phi-only axes of "
+            f"enabled GP blocks); got {names} — the fused rung "
+            "accelerates the ReducedGP precompute, which this grid "
+            "cannot use"
+        )
     G = theta.shape[0]
     step = G if not chunk else max(1, int(chunk))
     # pad the tail block to the full chunk shape (repeat the last row)
@@ -175,9 +254,14 @@ def grid_loglikelihood(
         )
     outs = []
     if _reducible(names, recipe):
-        reduced = gp.ReducedGP.build(batch, recipe, design=design,
-                                     dtype=dtype)
-        proj = reduced.project(residuals, batch)
+        if fused:
+            reduced, proj = _fused_build_engine(precision, tile, backend)(
+                jnp.asarray(residuals, dtype), batch, recipe, design
+            )
+        else:
+            reduced = gp.ReducedGP.build(batch, recipe, design=design,
+                                         dtype=dtype)
+            proj = reduced.project(residuals, batch)
         engine = _reduced_grid_engine(names, per_pulsar)
         for i in range(0, G + pad, step):
             outs.append(engine(theta[i:i + step], reduced, proj, batch,
@@ -199,6 +283,11 @@ def bank_loglikelihood(
     design=None,
     mesh=None,
     prefetch_depth: int = 2,
+    fused: bool = False,
+    precision: str = "highest",
+    tile: Optional[int] = None,
+    backend: str = "auto",
+    numerics_capture=None,
 ):
     """log L of every realization in a residual bank — (R,) without a
     grid, (G, R) with one. ``bank`` is a (R, Np, Nt) array, or a
@@ -212,10 +301,20 @@ def bank_loglikelihood(
     ``mesh`` the projections shard along the 'real' axis
     (realization-bank parallelism — each chip prices its own bank
     rows; R must divide the mesh's 'real' extent).
+
+    ``fused``/``precision``/``tile``/``backend``/``numerics_capture``
+    engage the raw-speed ladder exactly as in
+    :func:`grid_loglikelihood`: the precompute runs through the fused
+    Woodbury kernel, the per-row projections take the direct O(Nt)
+    apply (no CiT), and bf16 is gated on the capture's ladder verdict.
+    Defaults unchanged.
     """
     from .serve import RealizationBank, project_bank
 
     dtype = batch.toas_s.dtype
+    fused, precision, tile, backend = _resolve_fused(
+        batch, recipe, fused, precision, tile, backend, numerics_capture
+    )
     if grid is not None:
         names, theta = _theta_block(grid, dtype)
         if not _reducible(names, recipe):
@@ -225,7 +324,13 @@ def bank_loglikelihood(
                 "white-noise axes per realization via "
                 "grid_loglikelihood instead"
             )
-    reduced = gp.ReducedGP.build(batch, recipe, design=design, dtype=dtype)
+    if fused:
+        reduced, _ = _fused_build_engine(precision, tile, backend)(
+            None, batch, recipe, design
+        )
+    else:
+        reduced = gp.ReducedGP.build(batch, recipe, design=design,
+                                     dtype=dtype)
     if isinstance(bank, RealizationBank):
         proj = project_bank(bank, reduced, batch,
                             prefetch_depth=prefetch_depth, mesh=mesh)

@@ -1,0 +1,293 @@
+"""Roofline-driven tile autotuner for the fused likelihood kernels.
+
+Rung 3 of the raw-speed ladder (docs/performance.md): the fused
+Woodbury-assembly kernel (ops/pallas_gp.py) is tiled along the TOA
+axis, and the right tile is a property of the (backend, shape-bucket,
+device) triple — not something a constant can be right about on both a
+laptop CPU and a TPU pod slice. This module searches the small discrete
+candidate space ONCE per triple, scores each candidate by its measured
+roofline position (obs/devprof.py ``jax.cost.*``/``jax.roofline.*``
+gauges — achieved FLOP/s of the compiled kernel, not a proxy), and
+persists the winner in a fingerprint-keyed JSON cache.
+
+The cache contract mirrors the plane-tile cache
+(parallel/prefetch.py): every entry is keyed by a fingerprint of
+exactly the things that would invalidate it (kernel schema version,
+backend, shape bucket, device kind, candidate set). The split of
+responsibilities is deliberate:
+
+* :func:`woodbury_tile` — the LOOKUP. Called on the build path
+  (``ReducedGP.build_fused`` with ``tile=None``). Never searches,
+  never compiles: a cache hit returns the tuned tile (and bumps
+  ``tuner.cache_hits``); a miss — no file, corrupt file, fingerprint
+  mismatch, foreign device — silently falls back to
+  ``DEFAULT_WOODBURY_TILE``. CI and laptops never pay the search.
+* :func:`autotune` — the SEARCH. Run explicitly (benchmarks/
+  gp_kernels.py ``--tune``) under the ``gp_tune`` span; bumps
+  ``tuner.searches``; writes the cache atomically (tmp + rename,
+  merging entries already present).
+
+Corruption degrades, never raises: an unreadable or schema-mismatched
+cache behaves exactly like no cache (pinned by
+tests/test_gp_kernels.py).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..batch import PulsarBatch
+from ..ops import pallas_gp
+
+#: discrete TOA-tile candidates the search scores — small by design
+#: (the objective is a full compile + timed run per candidate)
+WOODBURY_CANDIDATES = (128, 256, 512)
+
+#: bump when the kernel's tiling semantics change — invalidates every
+#: cached entry at once (the fingerprint folds it in)
+TUNER_SCHEMA_VERSION = 1
+
+#: committed default cache location (repo layout); overridable per call
+#: and via ``PTA_GP_TUNER_CACHE`` for installed-package deployments
+DEFAULT_CACHE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+    "benchmarks",
+    "gp_tuner_cache.json",
+)
+
+
+def _cache_path(cache_path: Optional[str]) -> str:
+    if cache_path is not None:
+        return os.fspath(cache_path)
+    return os.environ.get("PTA_GP_TUNER_CACHE", DEFAULT_CACHE_PATH)
+
+
+def _pow2_bucket(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def shape_bucket(npsr: int, ntoa: int) -> str:
+    """Coarse shape key: each dimension rounded up to a power of two,
+    so nearby problem sizes share one tuned tile instead of fracturing
+    the cache per-dataset. The column count Q is deliberately NOT part
+    of the bucket — the tile partitions the TOA axis, and lookups
+    happen before the basis is ever assembled."""
+    return f"np{_pow2_bucket(npsr)}_nt{_pow2_bucket(ntoa)}"
+
+
+def device_kind() -> str:
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
+
+def fingerprint(
+    backend: str,
+    bucket: str,
+    kind: Optional[str] = None,
+) -> str:
+    """Cache key for one tuned choice: sha256 over everything whose
+    change must invalidate it (kernel schema, backend, shape bucket,
+    device kind — NOT the candidate set, which only bounds how good
+    the tuned choice can be, never whether it is valid). Same refusal
+    contract as the plane-tile cache's workload fingerprint — a stale
+    entry is never *almost* right, it is simply not found."""
+    kind = device_kind() if kind is None else kind
+    blob = json.dumps(
+        {
+            "schema": TUNER_SCHEMA_VERSION,
+            "kernel": "fused_woodbury",
+            "backend": backend,
+            "bucket": bucket,
+            "device_kind": kind,
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def load_cache(cache_path: Optional[str] = None) -> dict:
+    """The cache's ``entries`` dict ({fingerprint: choice}); {} for a
+    missing, unreadable, or wrong-schema file — corruption means
+    untuned, never an exception (the fallback rung is the defaults)."""
+    path = _cache_path(cache_path)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("schema") != TUNER_SCHEMA_VERSION:
+        return {}
+    entries = doc.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def save_cache(entries: dict, cache_path: Optional[str] = None) -> str:
+    """Atomically persist ``entries`` (merged over whatever the file
+    already holds): write-to-tmp + rename, so a crashed search can
+    corrupt at most the tmp file, never the committed cache."""
+    path = _cache_path(cache_path)
+    merged = dict(load_cache(path))
+    merged.update(entries)
+    doc = {"schema": TUNER_SCHEMA_VERSION, "entries": merged}
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def woodbury_tile(
+    batch: PulsarBatch,
+    backend: str,
+    cache_path: Optional[str] = None,
+) -> int:
+    """The TOA tile for ``batch``'s fused Woodbury assembly: the tuned
+    choice when the cache holds one for this (backend, bucket, device)
+    fingerprint, else ``DEFAULT_WOODBURY_TILE``. Pure lookup — never
+    searches, never compiles (see module docstring)."""
+    from ..obs import counter, names
+
+    npsr, ntoa = batch.mask.shape
+    bucket = shape_bucket(npsr, ntoa)
+    entry = load_cache(cache_path).get(fingerprint(backend, bucket))
+    if isinstance(entry, dict) and isinstance(entry.get("tile"), int):
+        counter(names.TUNER_CACHE_HITS, backend=backend).inc()
+        return int(entry["tile"])
+    return pallas_gp.DEFAULT_WOODBURY_TILE
+
+
+def _time_compiled(compiled, args, reps: int) -> float:
+    """Median wall seconds of ``reps`` executions (one warm call
+    first)."""
+    jax.block_until_ready(compiled(*args))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def autotune(
+    batch: PulsarBatch,
+    T,
+    backend: str = "xla",
+    candidates: Sequence[int] = WOODBURY_CANDIDATES,
+    reps: int = 5,
+    cache_path: Optional[str] = None,
+    write: bool = True,
+) -> dict:
+    """Search ``candidates`` for the fastest fused-Woodbury TOA tile on
+    this device and persist the winner.
+
+    For each candidate the kernel is compiled at the search shape,
+    its XLA cost analysis recorded (``jax.cost.*`` gauges via
+    :func:`~pta_replicator_tpu.obs.devprof.record_compiled`), a median
+    execution timed, and the roofline position computed
+    (``jax.roofline.*`` gauges). The objective is achieved FLOP/s —
+    with no cost model (some CPU builds), inverse median time stands
+    in (monotone-equivalent at fixed shape: flops per call is
+    tile-independent). Returns the choice record that was cached."""
+    from ..obs import counter, devprof, names, span
+
+    npsr, ntoa = batch.mask.shape
+    T = jnp.asarray(T)
+    bucket = shape_bucket(npsr, ntoa)
+    key = fingerprint(backend, bucket)
+    dtype = T.dtype
+    winv = jnp.where(batch.mask > 0, 1.0, 0.0).astype(dtype)
+    r = jnp.zeros((npsr, ntoa), dtype)
+
+    with span(names.SPAN_GP_TUNE, backend=backend, bucket=bucket):
+        counter(names.TUNER_SEARCHES, backend=backend).inc()
+        scored = []
+        for tile in candidates:
+            label = f"{names.JIT_GP_FUSED_WOODBURY}[tile={tile}]"
+            if backend == "xla":
+                fn = pallas_gp.fused_woodbury_xla
+                kw = dict(tile=int(tile))
+            else:
+                fn = pallas_gp.fused_woodbury_update
+                kw = dict(
+                    tile=int(tile),
+                    interpret=(backend == "pallas_interpret"),
+                )
+            try:
+                compiled = (
+                    jax.jit(
+                        lambda a, b, c, _fn=fn, _kw=kw: _fn(a, b, c, **_kw)
+                    )
+                    .lower(T, winv, r)
+                    .compile()
+                )
+                cost = devprof.record_compiled(
+                    names.JIT_GP_FUSED_WOODBURY, compiled
+                )
+                elapsed = _time_compiled(compiled, (T, winv, r), reps)
+            except Exception as exc:  # candidate unrunnable, not fatal
+                scored.append(
+                    {"tile": int(tile), "error": f"{type(exc).__name__}: {exc}"}
+                )
+                continue
+            # two normalizations before the cost gauges can be an
+            # objective: (1) XLA's cost analysis prices a scan/grid
+            # BODY once, not x trip count — extrapolate by the step
+            # count or small tiles read as 1/steps the flops of big
+            # ones; (2) a tile larger than Nt pads the grid, and
+            # padded rows are counted work that produces nothing —
+            # score only the unpadded fraction (otherwise a 3x-padded
+            # tile can "win" on busywork).
+            padded = -(-ntoa // int(tile)) * int(tile)
+            steps = padded // int(tile)
+            useful = ntoa / padded
+            flops = float(cost.get("flops", 0.0)) * steps
+            nbytes = cost.get("bytes_accessed")
+            roof = devprof.roofline(
+                label,
+                flops=flops,
+                bytes_accessed=(
+                    None if nbytes is None else float(nbytes) * steps
+                ),
+                elapsed_s=elapsed,
+            )
+            base = roof.get("flops_per_s") or 1.0 / max(elapsed, 1e-12)
+            objective = float(base) * useful
+            scored.append(
+                {
+                    "tile": int(tile),
+                    "median_s": elapsed,
+                    "flops": flops,
+                    "useful_fraction": useful,
+                    "objective_flops_per_s": objective,
+                }
+            )
+        ok = [s for s in scored if "error" not in s]
+        if not ok:
+            raise RuntimeError(
+                f"gp_tune: no runnable tile candidate on backend "
+                f"{backend!r}: {scored}"
+            )
+        best = max(ok, key=lambda s: s["objective_flops_per_s"])
+        choice = {
+            "tile": best["tile"],
+            "backend": backend,
+            "bucket": bucket,
+            "device_kind": device_kind(),
+            "objective_flops_per_s": best["objective_flops_per_s"],
+            "candidates": [int(c) for c in candidates],
+            "scored": scored,
+        }
+        if write:
+            save_cache({key: choice}, cache_path)
+    return choice

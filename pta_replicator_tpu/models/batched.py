@@ -1602,21 +1602,9 @@ def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype,
         return winv, c0inv_mat, numerics.probe(
             "solver.logdet_c0", logdet_c0
         )
-    psr_rows = jnp.arange(batch.npsr)[:, None]
-
-    def seg_sum(x):
-        """Per-pulsar epoch segment sum over TOAs: (Np, Nt, Q) ->
-        (Np, E, Q) (scatter-add; no dense one-hot)."""
-        z = jnp.zeros(
-            (batch.npsr, batch.max_epochs) + x.shape[2:], dtype
-        )
-        return z.at[psr_rows, batch.epoch_index].add(
-            x * batch.mask[..., None]
-        )
-
-    if ecorr2 is not None:
-        s_e = seg_sum(winv[..., None])[..., 0]  # U_ec^T N^-1 U_ec diag
-        gain = ecorr2 / (1.0 + ecorr2 * s_e)  # k/(1 + k s), 0 at k=0
+    winv, seg_sum, gain, logdet_c0 = white_ecorr_parts(
+        batch, sigma2, ecorr2, dtype, winv=winv
+    )
 
     def c0inv_mat(X):
         """(N + ECORR)^-1 X for (Np, Nt, Q) X, per-epoch Woodbury."""
@@ -1629,15 +1617,46 @@ def white_ecorr_solver(batch: PulsarBatch, sigma2, ecorr2, dtype,
         )
         return y - winv[..., None] * picked
 
+    return winv, c0inv_mat, numerics.probe("solver.logdet_c0", logdet_c0)
+
+
+def white_ecorr_parts(batch: PulsarBatch, sigma2, ecorr2, dtype,
+                      winv=None):
+    """The analytic white+ECORR Woodbury pieces WITHOUT the solver
+    closure: the masked N^-1 diagonal, the epoch segment-sum operator,
+    the per-epoch Woodbury gain (None without ECORR) and the masked
+    log-determinant. Split out of :func:`white_ecorr_solver` so the
+    fused Woodbury-assembly rung (likelihood/gp.py over
+    ops/pallas_gp.py) prices the SAME C0 algebra the composed solver
+    applies — the two can never disagree. ``winv`` lets the solver
+    thread its probed diagonal through so the probe stays on the
+    consumed data path."""
+    if winv is None:
+        winv = jnp.where(batch.mask > 0, 1.0 / sigma2, 0.0)
+    psr_rows = jnp.arange(batch.npsr)[:, None]
+
+    def seg_sum(x):
+        """Per-pulsar epoch segment sum over TOAs: (Np, Nt, Q) ->
+        (Np, E, Q) (scatter-add; no dense one-hot)."""
+        z = jnp.zeros(
+            (batch.npsr, batch.max_epochs) + x.shape[2:], dtype
+        )
+        return z.at[psr_rows, batch.epoch_index].add(
+            x * batch.mask[..., None]
+        )
+
+    gain = None
     safe_sigma2 = jnp.where(batch.mask > 0, sigma2, 1.0)
     logdet_c0 = jnp.sum(jnp.log(safe_sigma2) * batch.mask, axis=-1)
     if ecorr2 is not None:
+        s_e = seg_sum(winv[..., None])[..., 0]  # U_ec^T N^-1 U_ec diag
+        gain = ecorr2 / (1.0 + ecorr2 * s_e)  # k/(1 + k s), 0 at k=0
         # log1p: ecorr2 is 0 at padded epochs (epoch_mask applied by
         # gls_noise_model), so those terms vanish exactly
         logdet_c0 = logdet_c0 + jnp.sum(
             jnp.log1p(ecorr2 * s_e) * batch.epoch_mask, axis=-1
         )
-    return winv, c0inv_mat, numerics.probe("solver.logdet_c0", logdet_c0)
+    return winv, seg_sum, gain, logdet_c0
 
 
 def _gls_design_system(batch: PulsarBatch, design, recipe: "Recipe",

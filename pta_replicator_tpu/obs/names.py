@@ -83,6 +83,10 @@ SPAN_LIKELIHOOD_PROJECT = "likelihood_project"
 SPAN_LIKELIHOOD_SUBMIT = "likelihood_submit"
 SPAN_LIKELIHOOD_QUEUE_WAIT = "likelihood_queue_wait"
 SPAN_LIKELIHOOD_RESOLVE = "likelihood_resolve"
+#: one roofline-driven tile-size search of the fused-kernel autotuner
+#: (likelihood/tuner.py autotune) — cache misses only; cache hits are
+#: span-free by design (CI and laptops never pay the search)
+SPAN_GP_TUNE = "gp_tune"
 
 # scenario compiler + differential fuzz harness (scenarios/)
 #: one spec -> (batch, recipe, plan) compile (scenarios/compile.py)
@@ -146,7 +150,7 @@ SPANS = frozenset({
     SPAN_CW_STREAM_STAGE, SPAN_CW_STREAM_RESPONSE,
     SPAN_LIKELIHOOD_BATCH, SPAN_LIKELIHOOD_SERVE, SPAN_LIKELIHOOD_PROJECT,
     SPAN_LIKELIHOOD_SUBMIT, SPAN_LIKELIHOOD_QUEUE_WAIT,
-    SPAN_LIKELIHOOD_RESOLVE,
+    SPAN_LIKELIHOOD_RESOLVE, SPAN_GP_TUNE,
     SPAN_SCENARIO_COMPILE, SPAN_SCENARIO_FUZZ_CASE,
     SPAN_COV_SOLVE, SPAN_COV_SAMPLE,
     SPAN_CLI_REALIZE, SPAN_CLI_INFO, SPAN_CLI_LIKELIHOOD,
@@ -295,6 +299,12 @@ SCENARIO_FUZZ_CASES = "scenario.fuzz_cases"
 SCENARIO_FUZZ_DISAGREEMENTS = "scenario.fuzz_disagreements"
 SCENARIO_SHRINK_STEPS = "scenario.shrink_steps"
 
+# fused-kernel tile autotuner (likelihood/tuner.py): roofline searches
+# actually run (cache misses — labeled backend=/bucket=), and lookups
+# served from the fingerprint-keyed cache file without any search
+TUNER_SEARCHES = "tuner.searches"
+TUNER_CACHE_HITS = "tuner.cache_hits"
+
 # SLO engine (obs/slo.py): per-objective gauges over the rolling
 # windows — the remaining fraction of the error budget (1.0 = untouched,
 # < 0 = blown), the fast/slow-window burn rates (1.0 = consuming budget
@@ -378,6 +388,7 @@ METRICS = frozenset({
     FAULTS_INJECTED,
     STAGES_EDGE_INFLIGHT, STAGES_BUSY_S, STAGES_DRAIN_TIMEOUTS,
     COV_SOLVES, COV_BLOCKED_FRACTION,
+    TUNER_SEARCHES, TUNER_CACHE_HITS,
     SCENARIO_COMPILED, SCENARIO_FUZZ_CASES,
     SCENARIO_FUZZ_DISAGREEMENTS, SCENARIO_SHRINK_STEPS,
     SLO_ERROR_BUDGET_REMAINING, SLO_BURN_RATE_FAST, SLO_BURN_RATE_SLOW,
@@ -421,6 +432,7 @@ STAGES_PREFIX = "stages."
 LIKELIHOOD_PREFIX = "likelihood."
 FAULTS_PREFIX = "faults."
 COV_PREFIX = "cov."
+TUNER_PREFIX = "tuner."
 SCENARIO_PREFIX = "scenario."
 SLO_PREFIX = "slo."
 TRACE_PREFIX = "trace."
@@ -444,12 +456,19 @@ JIT_LIKELIHOOD_REDUCED_ENGINE = "likelihood.reduced_engine"
 #: blocked-Cholesky dense factor+solve engine (covariance/kernels.py
 #: dense_solve) — labelled so devprof cost/roofline accounting applies
 JIT_COV_CHOLESKY = "cov.blocked_cholesky"
+#: fused Woodbury-assembly grid engine (likelihood/infer.py over
+#: ops/pallas_gp.py) — the rung-1 fused likelihood hot path, labelled
+#: so devprof roofline attribution covers the fused kernels
+JIT_GP_FUSED_WOODBURY = "gp.fused_woodbury"
+#: MXU-tiled block-tridiagonal factor/solve engine (covariance/
+#: kernels.py block_tridiag_factor_solve backend routing)
+JIT_COV_TRIDIAG_MXU = "cov.tridiag_mxu"
 
 JIT_LABELS = frozenset({
     JIT_REALIZE_ENGINE, JIT_MESH_CONSTRAINT_ENGINE,
     JIT_MESH_SHARDMAP_ENGINE, JIT_MESH_SHARDMAP_PSR_ENGINE,
     JIT_LIKELIHOOD_ENGINE, JIT_LIKELIHOOD_REDUCED_ENGINE,
-    JIT_COV_CHOLESKY,
+    JIT_COV_CHOLESKY, JIT_GP_FUSED_WOODBURY, JIT_COV_TRIDIAG_MXU,
 })
 
 #: every registered name, for membership checks that don't care about kind

@@ -94,6 +94,11 @@ _HIGHER_BETTER_TOKENS = (
     # series is explicit (solve/factor times ride the *_ms lower-better
     # suffix, oracle deviations ride "disagreement" below).
     "speedup_banded", "speedup_kron",
+    # KERNELS series (benchmarks/gp_kernels.py, PR 20): the fused-vs-
+    # composed reduced-eval throughput ratio and the bf16 arm's
+    # evals/s. "speedup"/"per_s" already match the generic tokens;
+    # spelled out so the raw-speed ladder's gate contract is explicit
+    "fused_speedup", "evals_per_s_bf16",
     # TRACE/SLO series (benchmarks/request_trace.py, PR 14): a falling
     # stitched-trace fraction is a causal-tracing correctness
     # regression, and per-objective error budget remaining is the SLO
@@ -174,6 +179,10 @@ _LOWER_BETTER_TOKENS = ("elapsed", "duration", "stalls", "drain_timeouts",
                         # drift is precision eroding even while every
                         # family still passes its tolerance
                         "nonfinite", "drift",
+                        # KERNELS series (PR 20): the bf16 arm's max
+                        # drift vs the f64 oracle rides "drift" above;
+                        # spelled out for the explicit-contract reason
+                        "bf16_max_drift",
                         # MULTICHIP fused-mesh series (r17): io_write's
                         # exclusive-shadow share of the phase wall
                         # (obs/critpath.py critical_share) — the slice
@@ -216,6 +225,10 @@ _NO_DIRECTION_FRAGMENTS = (
     # of the workload mix), not a score — a dense-heavy bench round
     # must not read as a regression
     "blocked_fraction",
+    # autotuner tile choices (benchmarks/gp_kernels.py, PR 20) are
+    # configuration, not scores: the tuned tile flipping 256 -> 512 on
+    # a new device is the tuner working, not a regression either way
+    "tuned_tile", "default_tile", "tile_size", ".tile",
 )
 
 

@@ -92,6 +92,18 @@ NC_SRC = len(_SRC_PLANES)
 _PSR_PLANES = ("fplus", "fcross", "phi0_p", "rate_p", "pn_p", "amp_p")
 NC_PSR = len(_PSR_PLANES)
 
+#: this module's kernels are cross-checked by consumers in OTHER
+#: modules (the CW scan-tiled jnp path in models/batched.py, the
+#: blocked-Cholesky XLA loop in covariance/kernels.py) rather than a
+#: local *_xla twin — this marker names the interpret-mode tests that
+#: pin them, and satisfies the jax-pallas-orphan-fallback lint rule
+#: (analysis/rules_jax.py)
+PALLAS_BIT_IDENTITY_TESTS = (
+    "tests/test_batched.py::test_cgw_pallas_kernel_matches_scan",
+    "tests/test_covariance.py::"
+    "test_blocked_cholesky_pallas_interpret_bit_identical",
+)
+
 
 def cw_catalog_planes(
     phat,

@@ -1,0 +1,524 @@
+"""Pallas TPU kernels for the GP-likelihood hot path.
+
+Two composites dominate the reduced-likelihood build (likelihood/gp.py,
+ROADMAP item 5 — the arXiv:2607.06834 "lightning-fast" GP-likelihood
+shape): the Woodbury quadratic assembly ``T^T C0^-1 T`` / ``T^T C0^-1 r``
+(today: ``white_ecorr_solver`` materializes the (Np, Nt, Q) image
+``C0^-1 T`` and a separate einsum contracts it away) and the
+block-tridiagonal factor/solve behind the banded covariance rung
+(today: a ``lax.scan`` of batched (b, b) LAPACK steps in
+covariance/kernels.py). Both are re-declared here under the repo's
+one-tile-implementation discipline proven by ``pallas_cw.cov_syrk_update``:
+
+* ONE per-tile function (:func:`gp_tile_terms`,
+  :func:`tridiag_tile_factor_fwd` / :func:`tridiag_tile_solve_bwd`) is
+  shared verbatim by the Pallas kernel body and the tiled-XLA fallback,
+  so the two backends run the same op sequence in the same order and
+  are bit-identical under ``interpret=True`` on CPU (pinned at f32 AND
+  f64 by tests/test_gp_kernels.py);
+* the fused Woodbury kernel accumulates the (Q, Q) Gram block, the
+  (Q,) projection and the residual quadratic tile-by-tile over the Nt
+  grid axis — the (Nt, Q) weighted-design intermediate never
+  materializes in either backend;
+* the block-tridiagonal kernel carries the previous block column's
+  Cholesky factor (and the forward-substitution partial) across the
+  sequential grid in revisited accumulator blocks, with the (b, b)
+  Cholesky and triangular solves hand-rolled from masked einsum /
+  ``where`` steps (:func:`chol_tile`, :func:`tri_solve_tile`) — no
+  ``lax.linalg`` primitive, so the SAME code lowers inside a Mosaic
+  kernel body and in the fallback scan.
+
+Mixed precision (the bf16 rung of the raw-speed ladder,
+docs/performance.md): ``precision="bf16"`` casts the MXU operands of
+the big contractions to bfloat16 with float32 accumulation
+(``preferred_element_type``) while every scalar/diagonal step stays in
+float32. The policy is opt-in and runtime-gated on the numerics
+observatory's ladder verdict — see ``likelihood/gp.py``; nothing in
+this module enforces it, kernels just honor the static flag.
+
+Tile sizes default to the hand constants below; ``likelihood/tuner.py``
+overrides them per (backend, shape-bucket) from its fingerprint-keyed
+cache when a tuned entry exists.
+
+TPU caveats encoded: iota constants are built ≥2-D
+(``lax.broadcasted_iota``; Mosaic refuses 1-D iota), dots carry
+``preferred_element_type``, and the fused kernels' grid axes are
+declared ``arbitrary`` (sequential) because every step accumulates
+into revisited output blocks.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on CPU-only installs of older jaxlibs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+#: hand-tuned defaults — the untuned fallback rung of the autotuner
+#: (likelihood/tuner.py); CI and laptops never pay a search to get here
+DEFAULT_WOODBURY_TILE = 256
+
+#: the precision policies the kernels accept (the string "highest" is
+#: jnp.einsum's own highest-precision spelling; "bf16" is the
+#: numerics-gated mixed rung)
+PRECISIONS = ("highest", "bf16")
+
+
+def _check_precision(precision: str):
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {PRECISIONS}, got {precision!r}"
+        )
+
+
+# ------------------------------------------ fused Woodbury assembly
+
+def gp_tile_terms(t, w, r, precision: str = "highest"):
+    """One Nt-tile of the Woodbury quadratic assembly: given a design
+    tile ``t`` (Np, tile, Q), the masked white inverse-variance tile
+    ``w`` (Np, tile) and the residual tile ``r`` (Np, tile), return the
+    tile's contribution to ``T^T W T`` (Np, Q, Q), ``T^T W r`` (Np, Q)
+    and ``r^T W r`` (Np,). The ONE implementation shared by the Pallas
+    kernel and the XLA fallback — backends must run the same op
+    sequence to be comparable bit-level.
+
+    ``precision="bf16"`` casts the MXU operands of the two design
+    contractions to bfloat16 and accumulates in float32; the scalar
+    quadratic stays float32 (it is O(tile) work and sets the rNr
+    baseline the per-family drift tolerances are measured against).
+    """
+    wr = w * r
+    if precision == "bf16":
+        f32 = jnp.float32
+        tb = t.astype(jnp.bfloat16)
+        tnt = jnp.einsum(
+            "pnq,pns->pqs", tb, (t * w[..., None]).astype(jnp.bfloat16),
+            preferred_element_type=f32,
+        )
+        d = jnp.einsum(
+            "pnq,pn->pq", tb, wr.astype(jnp.bfloat16),
+            preferred_element_type=f32,
+        )
+        q = jnp.einsum(
+            "pn,pn->p", r.astype(f32), wr.astype(f32),
+            preferred_element_type=f32,
+        )
+    else:
+        tnt = jnp.einsum(
+            "pnq,pns->pqs", t, t * w[..., None], precision="highest"
+        )
+        d = jnp.einsum("pnq,pn->pq", t, wr, precision="highest")
+        q = jnp.einsum("pn,pn->p", r, wr, precision="highest")
+    return tnt, d, q
+
+
+def _fused_woodbury_kernel(
+    t_ref, w_ref, r_ref, tnt_ref, d_ref, q_ref, *, precision
+):
+    # every grid step revisits the same (whole-array) output blocks:
+    # zero them once at the first step, then accumulate — the grid axis
+    # is declared sequential ("arbitrary") so the order matches the
+    # fallback scan exactly
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        tnt_ref[...] = jnp.zeros(tnt_ref.shape, tnt_ref.dtype)
+        d_ref[...] = jnp.zeros(d_ref.shape, d_ref.dtype)
+        q_ref[...] = jnp.zeros(q_ref.shape, q_ref.dtype)
+
+    tnt, d, q = gp_tile_terms(
+        t_ref[...], w_ref[...], r_ref[...], precision=precision
+    )
+    tnt_ref[...] += tnt
+    d_ref[...] += d
+    q_ref[...] += q[:, None]
+
+
+def _pad_tiles(T, w, r, tile: int):
+    """Zero-pad the Nt axis to the tile grid — padded rows carry w=0 so
+    they contribute exactly zero to every accumulator in both backends.
+    """
+    n = T.shape[1]
+    pad = (-n) % tile
+    if pad:
+        T = jnp.pad(T, ((0, 0), (0, pad), (0, 0)))
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+        r = jnp.pad(r, ((0, 0), (0, pad)))
+    return T, w, r
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile", "precision", "interpret")
+)
+def fused_woodbury_update(
+    T, w, r,
+    tile: int = DEFAULT_WOODBURY_TILE,
+    precision: str = "highest",
+    interpret: bool = False,
+):
+    """Fused Woodbury quadratic assembly via the Pallas tile kernel:
+    ``(T^T W T, T^T W r, r^T W r)`` in ONE pass over the Nt axis.
+
+    ``T``: (Np, Nt, Q) stacked low-rank columns, ``w``: (Np, Nt) masked
+    white inverse variances (zero at padding), ``r``: (Np, Nt) masked
+    residuals. The (Np, Nt, Q) weighted-design intermediate of the
+    composed path never materializes. ``interpret=True`` runs the
+    kernel on CPU for tests; the epoch-ECORR Woodbury correction is
+    O(E) work applied OUTSIDE the kernel (likelihood/gp.py) — epochs
+    are irregular segments and do not tile over Nt.
+    """
+    _check_precision(precision)
+    npsr, _, q = T.shape
+    acc = jnp.float32 if precision == "bf16" else T.dtype
+    T, w, r = _pad_tiles(T, w, r, tile)
+    grid = (T.shape[1] // tile,)
+    mem = {} if _VMEM is None else dict(memory_space=_VMEM)
+    extra = {}
+    if pltpu is not None and not interpret:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        )
+    tnt, d, rnr = pl.pallas_call(
+        functools.partial(_fused_woodbury_kernel, precision=precision),
+        out_shape=(
+            jax.ShapeDtypeStruct((npsr, q, q), acc),
+            jax.ShapeDtypeStruct((npsr, q), acc),
+            jax.ShapeDtypeStruct((npsr, 1), acc),
+        ),
+        grid=grid,
+        **extra,
+        in_specs=[
+            pl.BlockSpec((npsr, tile, q), lambda i: (0, i, 0), **mem),
+            pl.BlockSpec((npsr, tile), lambda i: (0, i), **mem),
+            pl.BlockSpec((npsr, tile), lambda i: (0, i), **mem),
+        ],
+        out_specs=(
+            pl.BlockSpec((npsr, q, q), lambda i: (0, 0, 0), **mem),
+            pl.BlockSpec((npsr, q), lambda i: (0, 0), **mem),
+            pl.BlockSpec((npsr, 1), lambda i: (0, 0), **mem),
+        ),
+        interpret=interpret,
+    )(T, w, r)
+    return tnt, d, rnr[..., 0]
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "precision"))
+def fused_woodbury_xla(
+    T, w, r,
+    tile: int = DEFAULT_WOODBURY_TILE,
+    precision: str = "highest",
+):
+    """Tiled-XLA fallback for :func:`fused_woodbury_update`: the same
+    :func:`gp_tile_terms` tile, the same zero-init + sequential
+    accumulation order (a ``lax.scan`` carry), hence bit-identical to
+    the kernel under interpret mode. The production default off-TPU —
+    no Mosaic compile path, fuses into the surrounding jit."""
+    _check_precision(precision)
+    npsr, _, q = T.shape
+    acc = jnp.float32 if precision == "bf16" else T.dtype
+    T, w, r = _pad_tiles(T, w, r, tile)
+    nk = T.shape[1] // tile
+
+    def step(carry, inputs):
+        tnt, d, rnr = carry
+        dt, dd, dq = gp_tile_terms(*inputs, precision=precision)
+        return (tnt + dt, d + dd, rnr + dq), None
+
+    init = (
+        jnp.zeros((npsr, q, q), acc),
+        jnp.zeros((npsr, q), acc),
+        jnp.zeros((npsr,), acc),
+    )
+    (tnt, d, rnr), _ = jax.lax.scan(
+        step, init,
+        (
+            jnp.moveaxis(T.reshape(npsr, nk, tile, q), 1, 0),
+            jnp.moveaxis(w.reshape(npsr, nk, tile), 1, 0),
+            jnp.moveaxis(r.reshape(npsr, nk, tile), 1, 0),
+        ),
+    )
+    return tnt, d, rnr
+
+
+# ------------------------------------- block-tridiagonal factor/solve
+
+def _iota_row(n: int):
+    """(n, 1) int32 row-index constant (2-D: Mosaic refuses 1-D iota)."""
+    return jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+
+
+def _iota_col(n: int):
+    """(1, n) int32 column-index constant."""
+    return jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+
+
+def chol_tile(a):
+    """Batched (..., b, b) Cholesky as b right-looking rank-1 steps of
+    masked einsum/where arithmetic — no ``lax.linalg`` primitive, so
+    the SAME implementation runs inside a Pallas kernel body and in the
+    XLA fallback scan (the one-tile-implementation discipline; LAPACK's
+    potrf would differ from any in-kernel algorithm at the ULP level
+    and break the bit-identity contract between backends).
+
+    Stale entries above the diagonal are never read (each step masks to
+    rows >= j before use) and the returned factor is exactly lower
+    triangular by construction. Caller guarantees SPD input, as with
+    ``jnp.linalg.cholesky``.
+    """
+    n = a.shape[-1]
+    dtype = a.dtype
+    rows, cols = _iota_row(n), _iota_col(n)
+
+    def step(j, carry):
+        a_cur, l = carry
+        selc = (cols == j).astype(dtype)  # (1, n) one-hot column j
+        colj = jnp.sum(a_cur * selc, axis=-1)  # (..., n) working column
+        dj = jnp.sum(colj * selc, axis=-1)  # a_cur[j, j]
+        lcol = (
+            colj[..., :, None]
+            * (1.0 / jnp.sqrt(dj))[..., None, None]
+            * (rows >= j).astype(dtype)
+        )  # (..., n, 1) column j of the factor, masked to rows >= j
+        l = l + lcol * selc
+        # the rank-1 update annihilates column j itself (lcol lcol^T's
+        # column j equals colj at rows >= j), so no re-masking is
+        # needed; stale rows < j are never read by later steps
+        a_cur = a_cur - lcol * jnp.swapaxes(lcol, -1, -2)
+        return a_cur, l
+
+    _, l = jax.lax.fori_loop(
+        0, n, step, (a, jnp.zeros_like(a))
+    )
+    return l
+
+
+def tri_solve_tile(l, b, trans: bool = False):
+    """Batched triangular substitution against the (..., b, b) factor
+    ``l`` for (..., b, Q) right-hand sides: ``L y = b`` (forward), or
+    ``L^T z = b`` with ``trans=True`` (backward). Same masked-step
+    construction as :func:`chol_tile`, shared by both backends."""
+    n = l.shape[-1]
+    dtype = l.dtype
+    rows, cols = _iota_row(n), _iota_col(n)
+
+    def sub(j, y):
+        selr = (rows == j).astype(dtype)  # (n, 1) one-hot row j
+        selc = (cols == j).astype(dtype)  # (1, n)
+        dj = jnp.sum(l * selr * selc, axis=(-2, -1))  # l[j, j]
+        rowj = jnp.sum(y * selr, axis=-2)  # (..., Q) rhs row j
+        xj = rowj / dj[..., None]  # (..., Q) solved row j
+        if trans:
+            # column j of L^T is row j of L, eliminated upward
+            colj = jnp.sum(l * selr, axis=-2)  # (..., n)
+            mask = (rows < j).astype(dtype)
+        else:
+            colj = jnp.sum(l * selc, axis=-1)  # (..., n)
+            mask = (rows > j).astype(dtype)
+        y = y - (colj[..., :, None] * mask) * xj[..., None, :]
+        # write the solved row in place
+        return y * (1.0 - selr) + xj[..., None, :] * selr
+
+    if trans:
+        body = lambda i, y: sub(n - 1 - i, y)
+    else:
+        body = sub
+    return jax.lax.fori_loop(0, n, body, b)
+
+
+def tridiag_tile_factor_fwd(d_k, e_k, x_k, l_prev, y_prev):
+    """One forward block-column step of the fused factor+solve: the
+    sub-diagonal factor block ``M_k = E_k L_prev^-T`` (``E_0`` is the
+    zero pad, so ``M_0`` is exactly zero against the identity carry),
+    the Schur complement ``S = D_k - M M^T``, its Cholesky ``L_k``, and
+    the forward-substitution partial ``y_k = L_k^-1 (x_k - M_k
+    y_prev)``. The ONE step shared by the Pallas kernel and the
+    fallback scan — the same algebra as covariance/kernels.py's
+    ``block_tridiag_cholesky``/``block_tridiag_solve`` steps, fused so
+    each block column is read once."""
+    m = jnp.swapaxes(
+        tri_solve_tile(l_prev, jnp.swapaxes(e_k, -1, -2)), -1, -2
+    )
+    s = d_k - jnp.einsum("...ik,...jk->...ij", m, m, precision="highest")
+    l = chol_tile(s)
+    rhs = x_k - jnp.einsum(
+        "...ij,...jq->...iq", m, y_prev, precision="highest"
+    )
+    y = tri_solve_tile(l, rhs)
+    return l, m, y
+
+
+def tridiag_tile_solve_bwd(l_k, m_next, y_k, z_next):
+    """One backward block-column step: ``z_k = L_k^-T (y_k - M_{k+1}^T
+    z_next)`` (``M_{nb}`` is the zero pad). Shared by both backends."""
+    rhs = y_k - jnp.einsum(
+        "...ji,...jq->...iq", m_next, z_next, precision="highest"
+    )
+    return tri_solve_tile(l_k, rhs, trans=True)
+
+
+def _tridiag_fwd_kernel(d_ref, e_ref, x_ref, ld_ref, m_ref, y_ref,
+                        lc_ref, yc_ref):
+    b = d_ref.shape[-1]
+
+    # the carry blocks are revisited every step (index map pinned to
+    # block 0): seed them before the first read, exactly the fallback
+    # scan's init (identity factor, zero partial)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        eye = (
+            (_iota_row(b) == _iota_col(b)).astype(lc_ref.dtype)
+        )
+        lc_ref[...] = jnp.broadcast_to(eye, lc_ref.shape)
+        yc_ref[...] = jnp.zeros(yc_ref.shape, yc_ref.dtype)
+
+    l, m, y = tridiag_tile_factor_fwd(
+        d_ref[:, 0], e_ref[:, 0], x_ref[:, 0], lc_ref[...], yc_ref[...]
+    )
+    ld_ref[:, 0] = l
+    m_ref[:, 0] = m
+    y_ref[:, 0] = y
+    lc_ref[...] = l
+    yc_ref[...] = y
+
+
+def _tridiag_bwd_kernel(ld_ref, mn_ref, y_ref, z_ref, zc_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        zc_ref[...] = jnp.zeros(zc_ref.shape, zc_ref.dtype)
+
+    z = tridiag_tile_solve_bwd(
+        ld_ref[:, 0], mn_ref[:, 0], y_ref[:, 0], zc_ref[...]
+    )
+    z_ref[:, 0] = z
+    zc_ref[...] = z
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def tridiag_factor_solve(D, E, X, interpret: bool = False):
+    """Fused batched block-tridiagonal factor + solve via two Pallas
+    grid passes: ``(Ld, M, Z)`` with ``(L L^T) Z = X`` for (Np, nb, b,
+    b) diagonal blocks ``D``, (Np, nb-1, b, b) sub-diagonal blocks
+    ``E`` and (Np, nb, b, Q) right-hand sides ``X``. The forward pass
+    factors AND forward-substitutes in one sequential sweep over block
+    columns (each ``D_k``/``E_k`` is read exactly once); the backward
+    pass runs the reversed grid. ``block_tridiag_logdet(Ld)`` prices
+    the determinant from the returned factor. ``interpret=True`` runs
+    both kernels on CPU for tests."""
+    npsr, nb, bb, _ = D.shape
+    Q = X.shape[-1]
+    dtype = D.dtype
+    Epad = jnp.concatenate(
+        [jnp.zeros((npsr, 1, bb, bb), dtype), E], axis=1
+    )
+    mem = {} if _VMEM is None else dict(memory_space=_VMEM)
+    extra = {}
+    if pltpu is not None and not interpret:
+        extra["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        )
+    blk = lambda i: (0, i, 0, 0)
+    pinned = lambda i: (0, 0, 0)
+    Ld, M, Y, _, _ = pl.pallas_call(
+        _tridiag_fwd_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((npsr, nb, bb, bb), dtype),
+            jax.ShapeDtypeStruct((npsr, nb, bb, bb), dtype),
+            jax.ShapeDtypeStruct((npsr, nb, bb, Q), dtype),
+            jax.ShapeDtypeStruct((npsr, bb, bb), dtype),  # L carry
+            jax.ShapeDtypeStruct((npsr, bb, Q), dtype),  # y carry
+        ),
+        grid=(nb,),
+        **extra,
+        in_specs=[
+            pl.BlockSpec((npsr, 1, bb, bb), blk, **mem),
+            pl.BlockSpec((npsr, 1, bb, bb), blk, **mem),
+            pl.BlockSpec((npsr, 1, bb, Q), blk, **mem),
+        ],
+        out_specs=(
+            pl.BlockSpec((npsr, 1, bb, bb), blk, **mem),
+            pl.BlockSpec((npsr, 1, bb, bb), blk, **mem),
+            pl.BlockSpec((npsr, 1, bb, Q), blk, **mem),
+            pl.BlockSpec((npsr, bb, bb), pinned, **mem),
+            pl.BlockSpec((npsr, bb, Q), pinned, **mem),
+        ),
+        interpret=interpret,
+    )(D, Epad, X)
+
+    Mnext = jnp.concatenate(
+        [M[:, 1:], jnp.zeros((npsr, 1, bb, bb), dtype)], axis=1
+    )
+    rblk = lambda i: (0, nb - 1 - i, 0, 0)
+    Z, _ = pl.pallas_call(
+        _tridiag_bwd_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((npsr, nb, bb, Q), dtype),
+            jax.ShapeDtypeStruct((npsr, bb, Q), dtype),  # z carry
+        ),
+        grid=(nb,),
+        **extra,
+        in_specs=[
+            pl.BlockSpec((npsr, 1, bb, bb), rblk, **mem),
+            pl.BlockSpec((npsr, 1, bb, bb), rblk, **mem),
+            pl.BlockSpec((npsr, 1, bb, Q), rblk, **mem),
+        ],
+        out_specs=(
+            pl.BlockSpec((npsr, 1, bb, Q), rblk, **mem),
+            pl.BlockSpec((npsr, bb, Q), pinned, **mem),
+        ),
+        interpret=interpret,
+    )(Ld, Mnext, Y)
+    return Ld, M, Z
+
+
+@jax.jit
+def tridiag_factor_solve_xla(D, E, X):
+    """Tiled-XLA fallback for :func:`tridiag_factor_solve`: the same
+    :func:`tridiag_tile_factor_fwd` / :func:`tridiag_tile_solve_bwd`
+    steps in two ``lax.scan`` sweeps — bit-identical to the kernel
+    under interpret mode, and the production default off-TPU."""
+    npsr, nb, bb, _ = D.shape
+    Q = X.shape[-1]
+    dtype = D.dtype
+    Epad = jnp.concatenate(
+        [jnp.zeros((npsr, 1, bb, bb), dtype), E], axis=1
+    )
+    scan_axis = lambda x: jnp.moveaxis(x, 1, 0)
+    unscan = lambda x: jnp.moveaxis(x, 0, 1)
+
+    def fwd(carry, inputs):
+        l_prev, y_prev = carry
+        l, m, y = tridiag_tile_factor_fwd(*inputs, l_prev, y_prev)
+        return (l, y), (l, m, y)
+
+    eye = jnp.broadcast_to(
+        (_iota_row(bb) == _iota_col(bb)).astype(dtype), (npsr, bb, bb)
+    )
+    _, (Ld, M, Y) = jax.lax.scan(
+        fwd,
+        (eye, jnp.zeros((npsr, bb, Q), dtype)),
+        (scan_axis(D), scan_axis(Epad), scan_axis(X)),
+    )
+
+    Mnext = jnp.concatenate(
+        [unscan(M)[:, 1:], jnp.zeros((npsr, 1, bb, bb), dtype)], axis=1
+    )
+
+    def bwd(z_next, inputs):
+        l_k, m_next, y_k = inputs
+        z = tridiag_tile_solve_bwd(l_k, m_next, y_k, z_next)
+        return z, z
+
+    _, Z = jax.lax.scan(
+        bwd,
+        jnp.zeros((npsr, bb, Q), dtype),
+        (Ld, scan_axis(Mnext), Y),
+        reverse=True,
+    )
+    return unscan(Ld), unscan(M), unscan(Z)

@@ -134,6 +134,16 @@ echo "== numerics observatory gate (fast arm) =="
 # CPU-only (docs/numerics.md).
 JAX_PLATFORMS=cpu python benchmarks/numerics_probe.py --fast > /dev/null
 
+echo "== gp fused-kernel gate (fast arm) =="
+# the fast arm of benchmarks/gp_kernels.py: the fused Woodbury
+# assembly must agree with the composed ReducedGP build to f64
+# round-off, the Pallas interpret-mode kernels must be bit-identical
+# to their tiled-XLA fallbacks, and the numerics-gated bf16 mode must
+# sit within its family tolerance against the f64 oracle — exit 1,
+# reasons to stderr. Seconds-scale, fixture-free, CPU-only
+# (docs/performance.md "The raw-speed ladder").
+JAX_PLATFORMS=cpu python benchmarks/gp_kernels.py --fast > /dev/null
+
 echo "== performance ledger gate (windowed regression) =="
 # obs/ledger.py over the committed round artifacts: any direction-
 # classified metric worsening MONOTONICALLY across the last 3 rounds

@@ -205,6 +205,76 @@ def test_global_closure_fires_only_for_jit_readers(tmp_path):
     assert "'CACHE'" in findings[0].message
 
 
+PALLAS_ORPHAN = """
+    from jax.experimental import pallas as pl
+
+    def _double_tile(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2
+
+    def double(x):
+        return pl.pallas_call(_double_tile, out_shape=x)(x)
+"""
+
+
+def test_pallas_orphan_fallback_fires_without_fallback(tmp_path):
+    """jax-pallas-orphan-fallback: a pl.pallas_call in a module with
+    neither a top-level *_xla fallback nor a PALLAS_BIT_IDENTITY_TESTS
+    marker is a kernel nothing can cross-check — one finding per call
+    site."""
+    findings, _ = lint_tree(
+        tmp_path,
+        {"ops/mod.py": PALLAS_ORPHAN},
+        [rules_jax.PallasOrphanFallback()],
+    )
+    assert rule_ids(findings) == ["jax-pallas-orphan-fallback"]
+    assert "*_xla" in findings[0].message
+
+
+def test_pallas_orphan_fallback_passes_with_xla_fallback(tmp_path):
+    """The shared-tile discipline (ops/pallas_gp.py idiom): a top-level
+    ``*_xla`` function in the same module is the verification path."""
+    src = PALLAS_ORPHAN + """
+    def double_xla(x, tile=128):
+        return x * 2
+"""
+    findings, _ = lint_tree(
+        tmp_path, {"ops/mod.py": src}, [rules_jax.PallasOrphanFallback()]
+    )
+    assert findings == []
+
+
+def test_pallas_orphan_fallback_passes_with_marker(tmp_path):
+    """Kernels whose fallback lives in a consumer module (the
+    ops/pallas_cw.py shape) declare their bit-identity tests in a
+    module-level PALLAS_BIT_IDENTITY_TESTS tuple instead."""
+    src = PALLAS_ORPHAN + """
+    PALLAS_BIT_IDENTITY_TESTS = (
+        "tests/test_mod.py::test_double_bit_identical",
+    )
+"""
+    findings, _ = lint_tree(
+        tmp_path, {"ops/mod.py": src}, [rules_jax.PallasOrphanFallback()]
+    )
+    assert findings == []
+
+
+def test_pallas_orphan_fallback_suppression(tmp_path):
+    src = """
+        from jax.experimental import pallas as pl
+
+        def _k(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        def ident(x):
+            return pl.pallas_call(_k, out_shape=x)(x)  # graftlint: disable=jax-pallas-orphan-fallback
+    """
+    findings, suppressed = lint_tree(
+        tmp_path, {"ops/mod.py": src}, [rules_jax.PallasOrphanFallback()]
+    )
+    assert findings == []
+    assert rule_ids(suppressed) == ["jax-pallas-orphan-fallback"]
+
+
 # --------------------------------------------------------- thread rules
 def test_unlocked_global_mutation_fires(tmp_path):
     src = """

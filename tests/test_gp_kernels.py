@@ -1,0 +1,350 @@
+"""ops/pallas_gp.py + the raw-speed ladder (docs/performance.md):
+interpret-mode bit-identity between the Pallas kernels and their
+tiled-XLA fallbacks at f32 AND f64, fused-vs-composed agreement at f64
+round-off, the numerics-gated bf16 refusal/acceptance contract, the
+tile autotuner's cache degradation ladder, and the default-path
+bitwise pin. Fixture-free (synthetic batches), CPU-only."""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pta_replicator_tpu.batch import synthetic_batch
+from pta_replicator_tpu.covariance import kernels as cov_kernels
+from pta_replicator_tpu.likelihood import gp, infer, tuner
+from pta_replicator_tpu.models.batched import Recipe
+from pta_replicator_tpu.obs import numerics
+from pta_replicator_tpu.ops import pallas_gp
+
+
+def _recipe(batch, seed=0):
+    nb = len(batch.backend_names)
+    rng = np.random.default_rng(seed)
+    return Recipe(
+        efac=jnp.asarray(rng.uniform(0.9, 1.4, (batch.npsr, nb))),
+        log10_equad=jnp.asarray(
+            rng.uniform(-6.8, -6.2, (batch.npsr, nb))
+        ),
+        log10_ecorr=jnp.asarray(
+            rng.uniform(-6.9, -6.4, (batch.npsr, nb))
+        ),
+        rn_log10_amplitude=jnp.asarray(
+            rng.uniform(-13.8, -13.2, batch.npsr)
+        ),
+        rn_gamma=jnp.asarray(rng.uniform(3.0, 4.5, batch.npsr)),
+        gwb_log10_amplitude=jnp.asarray(-14.2),
+        gwb_gamma=jnp.asarray(13.0 / 3.0),
+        rn_nmodes=8,
+        gwb_gls_nmodes=6,
+    )
+
+
+@pytest.fixture(scope="module")
+def setup():
+    batch = synthetic_batch(
+        npsr=6, ntoa=180, nbackend=2, seed=3, dtype=jnp.float64
+    )
+    recipe = _recipe(batch)
+    rng = np.random.default_rng(11)
+    res = jnp.asarray(
+        rng.standard_normal(batch.toas_s.shape) * 1e-6
+    ) * batch.mask
+    return batch, recipe, res
+
+
+_GRID = {"rn_log10_amplitude": np.linspace(-14.0, -13.4, 4)}
+
+
+def _woodbury_operands(dtype, npsr=3, nt=100, q=7, seed=2):
+    rng = np.random.default_rng(seed)
+    T = jnp.asarray(rng.standard_normal((npsr, nt, q)), dtype)
+    mask = rng.random((npsr, nt)) > 0.1
+    w = jnp.asarray(rng.uniform(0.5, 2.0, (npsr, nt)) * mask, dtype)
+    r = jnp.asarray(rng.standard_normal((npsr, nt)) * mask, dtype)
+    return T, w, r
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+def test_fused_woodbury_interpret_bit_identical(dtype):
+    """The one-tile-implementation contract: the Pallas kernel under
+    interpret mode and the tiled-XLA scan produce byte-identical
+    accumulators at f32 AND f64 (same tile fn, same zero-init, same
+    sequential order — nothing left to round differently)."""
+    T, w, r = _woodbury_operands(dtype)
+    ref = pallas_gp.fused_woodbury_xla(T, w, r, tile=32)
+    ker = pallas_gp.fused_woodbury_update(T, w, r, tile=32,
+                                          interpret=True)
+    for a, b in zip(ref, ker):
+        assert a.dtype == dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_woodbury_tile_padding_exact():
+    """A tile that does not divide Nt zero-pads with w=0 rows — the
+    ragged grid must agree with the divisible grid to f64 round-off."""
+    T, w, r = _woodbury_operands(jnp.float64, nt=97)
+    a = pallas_gp.fused_woodbury_xla(T, w, r, tile=32)
+    b = pallas_gp.fused_woodbury_xla(T, w, r, tile=97)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=1e-13, atol=1e-15
+        )
+
+
+def _tridiag_operands(dtype, npsr=2, nb=5, b=4, q=3, seed=4):
+    rng = np.random.default_rng(seed)
+    A = rng.standard_normal((npsr, nb, b, b))
+    D = jnp.asarray(
+        A @ np.swapaxes(A, -1, -2) + 6.0 * np.eye(b), dtype
+    )
+    E = jnp.asarray(
+        0.2 * rng.standard_normal((npsr, nb - 1, b, b)), dtype
+    )
+    X = jnp.asarray(rng.standard_normal((npsr, nb, b, q)), dtype)
+    return D, E, X
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+def test_tridiag_interpret_bit_identical(dtype):
+    """Same contract for the block-tridiagonal factor+solve kernel:
+    interpret-mode Pallas == XLA scan, byte for byte, both dtypes."""
+    D, E, X = _tridiag_operands(dtype)
+    ref = pallas_gp.tridiag_factor_solve_xla(D, E, X)
+    ker = pallas_gp.tridiag_factor_solve(D, E, X, interpret=True)
+    for a, b in zip(ref, ker):
+        assert a.dtype == dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_block_tridiag_factor_solve_matches_composed():
+    """covariance/kernels.py::block_tridiag_factor_solve: the fused
+    xla/pallas_interpret backends agree with the composed
+    cholesky+solve scan reference, and the solve is correct against a
+    dense reconstruction."""
+    D, E, X = _tridiag_operands(jnp.float64)
+    Ld0, M0, Z0 = cov_kernels.block_tridiag_factor_solve(
+        D, E, X, backend="scan"
+    )
+    for backend in ("xla", "pallas_interpret"):
+        Ld, M, Z = cov_kernels.block_tridiag_factor_solve(
+            D, E, X, backend=backend
+        )
+        np.testing.assert_allclose(np.asarray(Z), np.asarray(Z0),
+                                   rtol=1e-12, atol=1e-14)
+        np.testing.assert_allclose(np.asarray(Ld), np.asarray(Ld0),
+                                   rtol=1e-12, atol=1e-14)
+    # dense correctness: assemble C and check C @ Z == X
+    npsr, nb, b, _ = D.shape
+    n = nb * b
+    C = np.zeros((npsr, n, n))
+    for k in range(nb):
+        C[:, k * b:(k + 1) * b, k * b:(k + 1) * b] = np.asarray(D[:, k])
+        if k:
+            Ek = np.asarray(E[:, k - 1])
+            C[:, k * b:(k + 1) * b, (k - 1) * b:k * b] = Ek
+            C[:, (k - 1) * b:k * b, k * b:(k + 1) * b] = np.swapaxes(
+                Ek, -1, -2
+            )
+    Zf = np.asarray(Z0).reshape(npsr, n, -1)
+    Xf = np.asarray(X).reshape(npsr, n, -1)
+    np.testing.assert_allclose(C @ Zf, Xf, rtol=1e-9, atol=1e-11)
+    with pytest.raises(ValueError):
+        cov_kernels.block_tridiag_factor_solve(D, E, X, backend="nope")
+
+
+def test_fused_build_matches_composed(setup):
+    """Rung 1 acceptance: the fused ReducedGP build agrees with the
+    composed build to f64 round-off (<= 1e-12 relative) on the grid
+    driver, and the fused bank driver agrees with the composed one."""
+    batch, recipe, res = setup
+    ll = np.asarray(
+        infer.grid_loglikelihood(res, batch, recipe, _GRID)
+    )
+    llf = np.asarray(
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True, backend="xla"
+        )
+    )
+    np.testing.assert_allclose(llf, ll, rtol=1e-12)
+    bank = jnp.stack([res, 0.5 * res])
+    bll = np.asarray(infer.bank_loglikelihood(bank, batch, recipe))
+    bllf = np.asarray(
+        infer.bank_loglikelihood(
+            bank, batch, recipe, fused=True, backend="xla"
+        )
+    )
+    np.testing.assert_allclose(bllf, bll, rtol=1e-12)
+
+
+def test_fused_interpret_backend_matches_xla(setup):
+    """The interpret backend threads the Pallas kernel through the
+    whole build — same numbers as the xla backend end to end."""
+    batch, recipe, res = setup
+    a = np.asarray(
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True, backend="xla"
+        )
+    )
+    b = np.asarray(
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True,
+            backend="pallas_interpret",
+        )
+    )
+    np.testing.assert_allclose(b, a, rtol=1e-14)
+
+
+def test_default_path_bitwise_pin(setup):
+    """The ladder is opt-in: the default driver call is byte-identical
+    to an explicit fused=False call, and the default build still
+    produces the composed projector (CiT materialized, fused flag
+    off) — no new kernel on the path nobody asked to change."""
+    batch, recipe, res = setup
+    a = np.asarray(infer.grid_loglikelihood(res, batch, recipe, _GRID))
+    b = np.asarray(
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=False,
+            precision="highest", tile=None, backend="auto",
+        )
+    )
+    np.testing.assert_array_equal(a, b)
+    reduced = gp.ReducedGP.build(batch, recipe)
+    assert reduced.fused is False
+    assert reduced.CiT is not None
+
+
+def test_bf16_refused_without_verdict(setup):
+    """Rung 2's gate: precision='bf16' without a numerics capture (or
+    with a capture that never saw the fused sites) raises
+    PrecisionNotReady — never silently computes in bf16."""
+    batch, recipe, res = setup
+    with pytest.raises(gp.PrecisionNotReady):
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True, precision="bf16"
+        )
+    with pytest.raises(gp.PrecisionNotReady):
+        gp.require_precision_ready("bf16", None)
+    with pytest.raises(ValueError):
+        gp.require_precision_ready("fp8")
+    assert gp.require_precision_ready(None) == "highest"
+    assert gp.require_precision_ready("highest") == "highest"
+
+
+def test_bf16_refused_on_unready_capture(tmp_path, setup):
+    """A capture file that exists but lacks ready verdicts for the
+    fused sites is refused with the sites named in the message."""
+    batch, recipe, res = setup
+    (tmp_path / "numerics.json").write_text(json.dumps(
+        {"schema": 0, "sites": {}}
+    ))
+    with pytest.raises(gp.PrecisionNotReady) as exc:
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True, precision="bf16",
+            numerics_capture=str(tmp_path),
+        )
+    assert "gp.fused" in str(exc.value)
+
+
+def test_bf16_accepted_with_armed_capture(tmp_path, setup):
+    """The full ladder flow: arm the observatory, run the fused f64
+    workload so the gp.fused_* sites accumulate evidence, write the
+    capture, then present it — bf16 is accepted and agrees with the
+    f64 fused result within the covariance-family tolerance."""
+    batch, recipe, res = setup
+    ll64 = np.asarray(
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True, backend="xla"
+        )
+    )
+    numerics.reset()
+    numerics.arm()
+    try:
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True, backend="xla"
+        )
+        numerics.write(str(tmp_path))
+    finally:
+        numerics.disarm()
+        numerics.reset()
+    verdict = numerics.ladder_verdict(
+        json.loads((tmp_path / "numerics.json").read_text())
+    )
+    for site in gp.FUSED_PRECISION_SITES:
+        assert verdict[site]["ready"], (site, verdict[site])
+    ll16 = np.asarray(
+        infer.grid_loglikelihood(
+            res, batch, recipe, _GRID, fused=True, precision="bf16",
+            backend="xla", numerics_capture=str(tmp_path),
+        )
+    )
+    rel = np.max(np.abs(ll16 - ll64) / np.abs(ll64))
+    assert rel < 1e-3, rel
+
+
+def test_tuner_cache_hit_miss_and_corruption(tmp_path, setup):
+    """Rung 3's degradation ladder: a tuned entry is looked up by
+    fingerprint; a missing file, a wrong-schema file, and outright
+    garbage all silently fall back to the committed default tile."""
+    batch, _, _ = setup
+    npsr, ntoa = batch.mask.shape
+    path = str(tmp_path / "cache.json")
+    # miss: no file
+    assert tuner.woodbury_tile(batch, "xla", cache_path=path) == \
+        pallas_gp.DEFAULT_WOODBURY_TILE
+    # hit: a tuned entry under the live fingerprint
+    key = tuner.fingerprint("xla", tuner.shape_bucket(npsr, ntoa))
+    tuner.save_cache({key: {"tile": 128}}, cache_path=path)
+    assert tuner.woodbury_tile(batch, "xla", cache_path=path) == 128
+    # a different backend misses the same entry
+    assert tuner.woodbury_tile(batch, "pallas", cache_path=path) == \
+        pallas_gp.DEFAULT_WOODBURY_TILE
+    # wrong schema: behaves like no cache
+    (tmp_path / "cache.json").write_text(
+        json.dumps({"schema": -1, "entries": {key: {"tile": 128}}})
+    )
+    assert tuner.woodbury_tile(batch, "xla", cache_path=path) == \
+        pallas_gp.DEFAULT_WOODBURY_TILE
+    assert tuner.load_cache(path) == {}
+    # garbage: behaves like no cache
+    (tmp_path / "cache.json").write_text("{not json")
+    assert tuner.woodbury_tile(batch, "xla", cache_path=path) == \
+        pallas_gp.DEFAULT_WOODBURY_TILE
+
+
+def test_autotune_writes_cache_the_lookup_reads(tmp_path, setup):
+    """The search persists a choice the pure lookup then returns —
+    the tuned tile survives the round trip through the file."""
+    batch, _, _ = setup
+    path = str(tmp_path / "cache.json")
+    T = jnp.asarray(
+        np.random.default_rng(0).standard_normal(
+            (batch.npsr, batch.mask.shape[1], 5)
+        )
+    )
+    choice = tuner.autotune(
+        batch, T, backend="xla", candidates=(32, 64), reps=1,
+        cache_path=path,
+    )
+    assert choice["tile"] in (32, 64)
+    assert tuner.woodbury_tile(batch, "xla", cache_path=path) == \
+        choice["tile"]
+
+
+def test_build_fused_rejects_noise_cov(setup):
+    """The fused build serves the diagonal white/ECORR shape only —
+    a recipe with a dense noise covariance is a loud ValueError, not
+    a silent wrong answer."""
+    from pta_replicator_tpu.covariance.structure import dense_from_times
+
+    batch, recipe, _ = setup
+    op = dense_from_times(
+        np.asarray(batch.toas_s), np.asarray(batch.mask),
+        corr_s=60 * 86400.0, dtype=jnp.float64,
+    )
+    bad = dataclasses.replace(recipe, noise_cov=op)
+    with pytest.raises(ValueError):
+        gp.ReducedGP.build_fused(batch, bad)
